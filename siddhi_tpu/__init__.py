@@ -1,0 +1,25 @@
+"""siddhi_tpu — a TPU-native stream-processing / complex-event-processing framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of Siddhi (the reference CEP
+engine, see SURVEY.md): SiddhiQL queries are *compiled* into fused XLA programs that
+run over micro-batched columnar event tensors with device-resident carried state
+(window ring buffers, dense NFA token matrices, keyed aggregate stores) — instead of
+the reference's per-event interpreter over pooled object graphs
+(reference: modules/siddhi-core/.../core/stream/StreamJunction.java,
+query/processor/*).
+
+Timestamps are int64 milliseconds (matching the reference's `long` timestamps), so
+x64 is enabled at import. All other arrays use explicit 32-bit (or narrower) dtypes;
+nothing in the framework materialises float64 (TPU has no f64 ALU).
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from siddhi_tpu.core.manager import SiddhiManager  # noqa: E402,F401
+from siddhi_tpu.core.types import AttrType  # noqa: E402,F401
+
+__version__ = "0.1.0"
+
+__all__ = ["SiddhiManager", "AttrType", "__version__"]

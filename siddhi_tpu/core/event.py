@@ -1,0 +1,185 @@
+"""Columnar event substrate.
+
+Replaces the reference's pooled linked-list event representation
+(reference: core/event/ComplexEvent.java:48-53, event/stream/StreamEvent.java:37-120,
+event/ComplexEventChunk.java:29-246) with a fixed-capacity columnar `EventBatch`:
+one device array per attribute plus timestamp / kind / validity lanes. The four
+reference event types CURRENT/EXPIRED/TIMER/RESET become an int8 `kind` lane;
+pool-borrowing becomes padding to a static batch capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.core.types import (
+    PHYSICAL_DTYPE,
+    AttrType,
+    InternTable,
+    null_value,
+)
+
+# ComplexEvent.Type equivalents (reference: core/event/ComplexEvent.java:48-53).
+KIND_CURRENT = 0
+KIND_EXPIRED = 1
+KIND_TIMER = 2
+KIND_RESET = 3
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class EventBatch:
+    """A fixed-capacity micro-batch of events for one stream.
+
+    ts:    [B] int64 — epoch milliseconds (reference StreamEvent.timestamp)
+    kind:  [B] int8  — KIND_* lane
+    valid: [B] bool  — row occupancy (padding rows are False)
+    cols:  {attr_name: [B] array} in schema order
+    """
+
+    ts: jax.Array
+    kind: jax.Array
+    valid: jax.Array
+    cols: dict[str, jax.Array]
+
+    @property
+    def capacity(self) -> int:
+        return self.ts.shape[-1]
+
+    def col_list(self) -> list[jax.Array]:
+        return list(self.cols.values())
+
+
+class StreamSchema:
+    """Typed stream definition (reference: query-api definition/StreamDefinition.java)."""
+
+    def __init__(self, stream_id: str, attrs: Sequence[tuple[str, AttrType]]):
+        self.stream_id = stream_id
+        self.attrs: list[tuple[str, AttrType]] = list(attrs)
+        self.attr_names = [n for n, _ in self.attrs]
+        self.attr_types = {n: t for n, t in self.attrs}
+        if len(self.attr_types) != len(self.attrs):
+            raise ValueError(f"duplicate attribute in stream '{stream_id}'")
+
+    def type_of(self, name: str) -> AttrType:
+        try:
+            return self.attr_types[name]
+        except KeyError:
+            raise KeyError(
+                f"no attribute '{name}' in stream '{self.stream_id}' "
+                f"(has {self.attr_names})"
+            ) from None
+
+    def index_of(self, name: str) -> int:
+        return self.attr_names.index(name)
+
+    def __repr__(self) -> str:
+        return f"StreamSchema({self.stream_id}, {self.attrs})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, StreamSchema)
+            and self.stream_id == other.stream_id
+            and self.attrs == other.attrs
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.stream_id, tuple(self.attrs)))
+
+    # ---- host <-> device conversion -------------------------------------
+
+    def empty_batch(self, capacity: int) -> EventBatch:
+        cols = {
+            name: jnp.zeros((capacity,), dtype=PHYSICAL_DTYPE[t])
+            for name, t in self.attrs
+        }
+        return EventBatch(
+            ts=jnp.zeros((capacity,), dtype=jnp.int64),
+            kind=jnp.zeros((capacity,), dtype=jnp.int8),
+            valid=jnp.zeros((capacity,), dtype=jnp.bool_),
+            cols=cols,
+        )
+
+    def to_batch(
+        self,
+        timestamps: Sequence[int],
+        rows: Sequence[Sequence[Any]],
+        interner: InternTable,
+        capacity: int | None = None,
+        kinds: Sequence[int] | None = None,
+    ) -> EventBatch:
+        """Pack host events into a padded columnar batch (numpy staging)."""
+        n = len(rows)
+        cap = capacity if capacity is not None else n
+        if n > cap:
+            raise ValueError(f"{n} events exceed batch capacity {cap}")
+        ts = np.zeros((cap,), dtype=np.int64)
+        ts[:n] = np.asarray(list(timestamps), dtype=np.int64)
+        kind = np.zeros((cap,), dtype=np.int8)
+        if kinds is not None:
+            kind[:n] = np.asarray(list(kinds), dtype=np.int8)
+        valid = np.zeros((cap,), dtype=np.bool_)
+        valid[:n] = True
+        cols: dict[str, jax.Array] = {}
+        for j, (name, t) in enumerate(self.attrs):
+            dt = PHYSICAL_DTYPE[t]
+            arr = np.full((cap,), null_value(t), dtype=np.dtype(dt))
+            for i in range(n):
+                v = rows[i][j]
+                if t in (AttrType.STRING, AttrType.OBJECT):
+                    arr[i] = interner.intern(v)
+                elif v is None:
+                    arr[i] = null_value(t)
+                else:
+                    arr[i] = v
+            cols[name] = jnp.asarray(arr)
+        return EventBatch(
+            ts=jnp.asarray(ts), kind=jnp.asarray(kind), valid=jnp.asarray(valid), cols=cols
+        )
+
+    def from_batch(
+        self, batch: EventBatch, interner: InternTable
+    ) -> list[tuple[int, int, tuple]]:
+        """Unpack valid rows to host `(timestamp, kind, data_tuple)` triples."""
+        valid = np.asarray(batch.valid)
+        ts = np.asarray(batch.ts)
+        kind = np.asarray(batch.kind)
+        host_cols = {n: np.asarray(c) for n, c in batch.cols.items()}
+        out: list[tuple[int, int, tuple]] = []
+        for i in np.nonzero(valid)[0]:
+            row = []
+            for name, t in self.attrs:
+                v = host_cols[name][i]
+                row.append(decode_value(v, t, interner))
+            out.append((int(ts[i]), int(kind[i]), tuple(row)))
+        return out
+
+
+def decode_value(v, t: AttrType, interner: InternTable):
+    """Device scalar -> host Python value (reversing interning / null sentinels)."""
+    if t in (AttrType.STRING, AttrType.OBJECT):
+        return interner.lookup(int(v))
+    if t is AttrType.BOOL:
+        return bool(v)
+    if t in (AttrType.FLOAT, AttrType.DOUBLE):
+        f = float(v)
+        return None if np.isnan(f) else f
+    iv = int(v)
+    if iv == int(null_value(t)):
+        return None
+    return iv
+
+
+def concat_batches(a: EventBatch, b: EventBatch) -> EventBatch:
+    """Concatenate two batches of the same stream (static shapes)."""
+    return EventBatch(
+        ts=jnp.concatenate([a.ts, b.ts]),
+        kind=jnp.concatenate([a.kind, b.kind]),
+        valid=jnp.concatenate([a.valid, b.valid]),
+        cols={n: jnp.concatenate([a.cols[n], b.cols[n]]) for n in a.cols},
+    )

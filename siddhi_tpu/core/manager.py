@@ -1,0 +1,59 @@
+"""SiddhiManager — top-level entry point.
+
+Reference: core/SiddhiManager.java:45-243 — create/validate/shutdown app runtimes,
+registry of extensions, persistence stores, data sources. Here it also owns the
+host-side intern table shared by all apps it creates.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+from siddhi_tpu.core.types import InternTable
+from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+
+class SiddhiManager:
+    def __init__(self) -> None:
+        self.interner = InternTable()
+        self.persistence_store = None
+        self._runtimes: dict[str, object] = {}
+
+    # app: SiddhiQL source text or a programmatic SiddhiApp AST
+    def create_siddhi_app_runtime(self, app: Union[str, SiddhiApp]):
+        from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+        from siddhi_tpu.core.app_runtime import SiddhiAppRuntime
+
+        if isinstance(app, str):
+            app = SiddhiCompiler.parse(app)
+        runtime = SiddhiAppRuntime(app, self)
+        old = self._runtimes.get(runtime.name)
+        if old is not None:
+            old.shutdown()
+        self._runtimes[runtime.name] = runtime
+        return runtime
+
+    def get_siddhi_app_runtime(self, name: str):
+        return self._runtimes.get(name)
+
+    def validate_siddhi_app(self, app: Union[str, SiddhiApp]) -> None:
+        """Parse + compile, then dispose (reference: SiddhiManager.validateSiddhiApp)."""
+        runtime = self.create_siddhi_app_runtime(app)
+        runtime.shutdown()
+        del self._runtimes[runtime.name]
+
+    def set_persistence_store(self, store) -> None:
+        self.persistence_store = store
+
+    def persist(self) -> None:
+        for rt in self._runtimes.values():
+            rt.persist()
+
+    def restore_last_state(self) -> None:
+        for rt in self._runtimes.values():
+            rt.restore_last_revision()
+
+    def shutdown(self) -> None:
+        for rt in list(self._runtimes.values()):
+            rt.shutdown()
+        self._runtimes.clear()

@@ -1,0 +1,145 @@
+"""Stream / table / window / trigger / function / aggregation definitions.
+
+Reference: siddhi-query-api .../definition/*.java (StreamDefinition, TableDefinition,
+WindowDefinition, TriggerDefinition, FunctionDefinition, AggregationDefinition,
+Attribute) and aggregation/TimePeriod.java.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from siddhi_tpu.query_api.annotation import Annotation
+from siddhi_tpu.query_api.expression import Expression, Variable
+from siddhi_tpu.core.types import AttrType
+
+
+@dataclasses.dataclass
+class Attribute:
+    name: str
+    type: AttrType
+
+
+@dataclasses.dataclass
+class AbstractDefinition:
+    id: str
+    attributes: list[Attribute] = dataclasses.field(default_factory=list)
+    annotations: list[Annotation] = dataclasses.field(default_factory=list)
+
+    def attribute(self, name: str, type_: AttrType) -> "AbstractDefinition":
+        self.attributes.append(Attribute(name, type_))
+        return self
+
+    def annotation(self, ann: Annotation) -> "AbstractDefinition":
+        self.annotations.append(ann)
+        return self
+
+    @property
+    def attribute_names(self) -> list[str]:
+        return [a.name for a in self.attributes]
+
+
+class StreamDefinition(AbstractDefinition):
+    pass
+
+
+class TableDefinition(AbstractDefinition):
+    pass
+
+
+@dataclasses.dataclass
+class WindowDefinition(AbstractDefinition):
+    """`define window W(...) length(10) output all events`
+    (reference: definition/WindowDefinition.java)."""
+
+    window: Optional["WindowSpec"] = None
+    output_events: str = "all"  # current | expired | all
+
+
+@dataclasses.dataclass
+class WindowSpec:
+    """A window invocation `ns:name(params)` attached to a stream or window def."""
+
+    namespace: Optional[str]
+    name: str
+    parameters: list[Expression] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TriggerDefinition:
+    """`define trigger T at every 5 sec | 'cron' | 'start'`
+    (reference: definition/TriggerDefinition.java)."""
+
+    id: str
+    at_every_ms: Optional[int] = None
+    at_cron: Optional[str] = None
+    at_start: bool = False
+    annotations: list[Annotation] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class FunctionDefinition:
+    """`define function f[lang] return type { body }`
+    (reference: definition/FunctionDefinition.java)."""
+
+    id: str
+    language: str
+    return_type: AttrType
+    body: str
+    annotations: list[Annotation] = dataclasses.field(default_factory=list)
+
+
+class Duration(enum.Enum):
+    """reference: query-api aggregation/TimePeriod.java SEC..YEARS"""
+
+    SECONDS = 1_000
+    MINUTES = 60_000
+    HOURS = 3_600_000
+    DAYS = 86_400_000
+    MONTHS = -2  # calendar-based; resolved by time conversion util
+    YEARS = -1
+
+    @property
+    def millis(self) -> int:
+        if self.value < 0:
+            raise ValueError(f"{self.name} is calendar-based")
+        return self.value
+
+
+DURATION_ORDER = [
+    Duration.SECONDS,
+    Duration.MINUTES,
+    Duration.HOURS,
+    Duration.DAYS,
+    Duration.MONTHS,
+    Duration.YEARS,
+]
+
+
+@dataclasses.dataclass
+class TimePeriod:
+    """`every sec ... year` range or explicit list."""
+
+    durations: list[Duration]
+
+    @staticmethod
+    def range(start: Duration, end: Duration) -> "TimePeriod":
+        i, j = DURATION_ORDER.index(start), DURATION_ORDER.index(end)
+        if i > j:
+            raise ValueError(f"invalid time period {start}..{end}")
+        return TimePeriod(DURATION_ORDER[i : j + 1])
+
+
+@dataclasses.dataclass
+class AggregationDefinition:
+    """`define aggregation A from S select ... group by ... aggregate by ts every ...`
+    (reference: definition/AggregationDefinition.java)."""
+
+    id: str
+    basic_single_input_stream: "object" = None  # SingleInputStream (import cycle)
+    selector: "object" = None  # Selector
+    aggregate_attribute: Optional[Variable] = None
+    time_period: Optional[TimePeriod] = None
+    annotations: list[Annotation] = dataclasses.field(default_factory=list)

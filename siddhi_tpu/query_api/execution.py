@@ -1,0 +1,364 @@
+"""Execution elements: queries, input streams (single/join/state), pattern state
+elements, handlers, selectors, output streams/rates, partitions, store queries.
+
+Reference: siddhi-query-api .../execution/** (Query.java, StoreQuery.java,
+partition/Partition.java, query/input/state/*StateElement.java,
+query/selection/Selector.java, query/output/stream/*, query/output/ratelimit/*).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Union
+
+from siddhi_tpu.query_api.annotation import Annotation
+from siddhi_tpu.query_api.definition import WindowSpec
+from siddhi_tpu.query_api.expression import Expression, Variable
+
+
+# ---------------------------------------------------------------------------
+# stream handlers (filter / window / stream function)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Filter:
+    expression: Expression
+
+
+@dataclasses.dataclass
+class WindowHandler:
+    window: WindowSpec
+
+
+@dataclasses.dataclass
+class StreamFunctionHandler:
+    namespace: Optional[str]
+    name: str
+    parameters: list[Expression]
+
+
+StreamHandler = Union[Filter, WindowHandler, StreamFunctionHandler]
+
+
+# ---------------------------------------------------------------------------
+# input streams
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SingleInputStream:
+    stream_id: str
+    alias: Optional[str] = None  # `as e1`
+    handlers: list[StreamHandler] = dataclasses.field(default_factory=list)
+    is_inner: bool = False  # `#innerStream` inside partitions
+    is_fault: bool = False  # `!faultStream`
+
+    @property
+    def ref(self) -> str:
+        """Name by which expressions refer to this stream."""
+        return self.alias or self.stream_id
+
+    def filter(self, e: Expression) -> "SingleInputStream":
+        self.handlers.append(Filter(e))
+        return self
+
+    def window(self, ns: Optional[str], name: str, *params: Expression) -> "SingleInputStream":
+        self.handlers.append(WindowHandler(WindowSpec(ns, name, list(params))))
+        return self
+
+
+class JoinType(enum.Enum):
+    JOIN = "join"  # inner
+    LEFT_OUTER = "left outer join"
+    RIGHT_OUTER = "right outer join"
+    FULL_OUTER = "full outer join"
+
+
+class JoinEventTrigger(enum.Enum):
+    LEFT = "left"
+    RIGHT = "right"
+    ALL = "all"
+
+
+@dataclasses.dataclass
+class JoinInputStream:
+    left: SingleInputStream
+    join_type: JoinType
+    right: SingleInputStream
+    on: Optional[Expression] = None
+    trigger: JoinEventTrigger = JoinEventTrigger.ALL
+    within: Optional[Expression] = None  # aggregation joins
+    per: Optional[Expression] = None
+    unidirectional: Optional[str] = None  # 'left' | 'right' | None
+
+
+# ---------------------------------------------------------------------------
+# pattern / sequence state elements
+# (reference: execution/query/input/state/{Stream,Next,Every,Count,Logical,
+#  AbsentStream}StateElement.java)
+# ---------------------------------------------------------------------------
+
+
+class StateElement:
+    pass
+
+
+@dataclasses.dataclass
+class StreamStateElement(StateElement):
+    stream: SingleInputStream
+    within: Optional[int] = None  # ms
+
+
+@dataclasses.dataclass
+class AbsentStreamStateElement(StreamStateElement):
+    waiting_time_ms: Optional[int] = None  # `not S for 5 sec`
+
+
+@dataclasses.dataclass
+class CountStateElement(StateElement):
+    stream: StreamStateElement
+    min_count: int = 0
+    max_count: int = -1  # -1 == ANY / unbounded
+
+    ANY = -1
+
+
+@dataclasses.dataclass
+class NextStateElement(StateElement):
+    state: StateElement
+    next: StateElement
+
+
+@dataclasses.dataclass
+class EveryStateElement(StateElement):
+    state: StateElement
+
+
+class LogicalType(enum.Enum):
+    AND = "and"
+    OR = "or"
+
+
+@dataclasses.dataclass
+class LogicalStateElement(StateElement):
+    left: StateElement
+    type: LogicalType
+    right: StateElement
+
+
+class StateStreamType(enum.Enum):
+    PATTERN = "pattern"
+    SEQUENCE = "sequence"
+
+
+@dataclasses.dataclass
+class StateInputStream:
+    type: StateStreamType
+    state: StateElement
+    within_ms: Optional[int] = None
+
+
+InputStream = Union[SingleInputStream, JoinInputStream, StateInputStream]
+
+
+# ---------------------------------------------------------------------------
+# selector
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class OutputAttribute:
+    rename: Optional[str]
+    expression: Expression
+
+    @property
+    def name(self) -> str:
+        if self.rename:
+            return self.rename
+        if isinstance(self.expression, Variable):
+            return self.expression.attribute
+        raise ValueError(f"unnamed non-variable projection: {self.expression}")
+
+
+class OrderDir(enum.Enum):
+    ASC = "asc"
+    DESC = "desc"
+
+
+@dataclasses.dataclass
+class OrderByAttribute:
+    variable: Variable
+    order: OrderDir = OrderDir.ASC
+
+
+@dataclasses.dataclass
+class Selector:
+    selection_list: list[OutputAttribute] = dataclasses.field(default_factory=list)
+    group_by: list[Variable] = dataclasses.field(default_factory=list)
+    having: Optional[Expression] = None
+    order_by: list[OrderByAttribute] = dataclasses.field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    select_all: bool = False  # `select *`
+
+    def select(self, rename: Optional[str], e: Expression) -> "Selector":
+        self.selection_list.append(OutputAttribute(rename, e))
+        return self
+
+
+# ---------------------------------------------------------------------------
+# output streams & rate limiting
+# ---------------------------------------------------------------------------
+
+
+class OutputEventsFor(enum.Enum):
+    CURRENT = "current events"
+    EXPIRED = "expired events"
+    ALL = "all events"
+
+
+@dataclasses.dataclass
+class OutputStream:
+    output_events: OutputEventsFor = OutputEventsFor.CURRENT
+
+
+@dataclasses.dataclass
+class InsertIntoStream(OutputStream):
+    target: str = ""
+    is_inner: bool = False
+    is_fault: bool = False
+
+
+@dataclasses.dataclass
+class ReturnStream(OutputStream):
+    pass
+
+
+@dataclasses.dataclass
+class DeleteStream(OutputStream):
+    target: str = ""
+    on: Optional[Expression] = None
+
+
+@dataclasses.dataclass
+class UpdateSetAttribute:
+    table_variable: Variable
+    expression: Expression
+
+
+@dataclasses.dataclass
+class UpdateStream(OutputStream):
+    target: str = ""
+    on: Optional[Expression] = None
+    set_attributes: Optional[list[UpdateSetAttribute]] = None
+
+
+@dataclasses.dataclass
+class UpdateOrInsertStream(OutputStream):
+    target: str = ""
+    on: Optional[Expression] = None
+    set_attributes: Optional[list[UpdateSetAttribute]] = None
+
+
+class OutputRateType(enum.Enum):
+    ALL = "all"
+    FIRST = "first"
+    LAST = "last"
+
+
+@dataclasses.dataclass
+class EventOutputRate:
+    events: int
+    type: OutputRateType = OutputRateType.ALL
+
+
+@dataclasses.dataclass
+class TimeOutputRate:
+    millis: int
+    type: OutputRateType = OutputRateType.ALL
+
+
+@dataclasses.dataclass
+class SnapshotOutputRate:
+    millis: int
+
+
+OutputRate = Union[EventOutputRate, TimeOutputRate, SnapshotOutputRate, None]
+
+
+# ---------------------------------------------------------------------------
+# query / partition / store query
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Query:
+    input_stream: InputStream = None
+    selector: Selector = dataclasses.field(default_factory=Selector)
+    output_stream: OutputStream = dataclasses.field(default_factory=ReturnStream)
+    output_rate: OutputRate = None
+    annotations: list[Annotation] = dataclasses.field(default_factory=list)
+
+    @staticmethod
+    def query() -> "Query":
+        return Query()
+
+    def from_(self, s: InputStream) -> "Query":
+        self.input_stream = s
+        return self
+
+    def select(self, sel: Selector) -> "Query":
+        self.selector = sel
+        return self
+
+    def insert_into(self, target: str, for_: OutputEventsFor = OutputEventsFor.CURRENT) -> "Query":
+        self.output_stream = InsertIntoStream(output_events=for_, target=target)
+        return self
+
+
+@dataclasses.dataclass
+class ValuePartitionType:
+    stream_id: str
+    expression: Expression
+
+
+@dataclasses.dataclass
+class RangePartitionProperty:
+    partition_key: str
+    condition: Expression
+
+
+@dataclasses.dataclass
+class RangePartitionType:
+    stream_id: str
+    ranges: list[RangePartitionProperty]
+
+
+@dataclasses.dataclass
+class Partition:
+    partition_types: list[Union[ValuePartitionType, RangePartitionType]] = dataclasses.field(
+        default_factory=list
+    )
+    queries: list[Query] = dataclasses.field(default_factory=list)
+    annotations: list[Annotation] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class InputStore:
+    store_id: str
+    on: Optional[Expression] = None
+    within: Optional[tuple[Expression, Optional[Expression]]] = None
+    per: Optional[Expression] = None
+
+
+@dataclasses.dataclass
+class StoreQuery:
+    """One-shot pull query (reference: execution/query/StoreQuery.java)."""
+
+    input_store: Optional[InputStore] = None
+    selector: Selector = dataclasses.field(default_factory=Selector)
+    # for store insert/update/delete forms
+    output_stream: Optional[OutputStream] = None
+    select_expression_rows: Optional[list] = None

@@ -1,0 +1,88 @@
+"""Event substrate tests (analog of reference managment/EventTestCase.java unit suite)."""
+
+import numpy as np
+
+from siddhi_tpu.core.event import (
+    KIND_CURRENT,
+    KIND_EXPIRED,
+    EventBatch,
+    StreamSchema,
+    concat_batches,
+)
+from siddhi_tpu.core.types import AttrType, InternTable
+
+
+def make_schema():
+    return StreamSchema(
+        "StockStream",
+        [("symbol", AttrType.STRING), ("price", AttrType.FLOAT), ("volume", AttrType.INT)],
+    )
+
+
+def test_round_trip():
+    schema = make_schema()
+    interner = InternTable()
+    rows = [("WSO2", 55.6, 100), ("IBM", 75.6, 10)]
+    batch = schema.to_batch([1000, 2000], rows, interner, capacity=4)
+    assert batch.capacity == 4
+    out = schema.from_batch(batch, interner)
+    assert out == [
+        (1000, KIND_CURRENT, ("WSO2", 55.599998474121094, 100)),
+        (2000, KIND_CURRENT, ("IBM", 75.5999984741211, 10)),
+    ] or [r[2][0] for r in out] == ["WSO2", "IBM"]
+    assert len(out) == 2
+    assert out[0][0] == 1000 and out[1][0] == 2000
+    assert out[0][2][0] == "WSO2" and out[1][2][0] == "IBM"
+    assert abs(out[0][2][1] - 55.6) < 1e-4
+    assert out[0][2][2] == 100
+
+
+def test_null_handling():
+    schema = make_schema()
+    interner = InternTable()
+    batch = schema.to_batch([1], [(None, None, None)], interner, capacity=2)
+    (ts, kind, row), = schema.from_batch(batch, interner)
+    assert row == (None, None, None)
+
+
+def test_kinds_and_padding():
+    schema = make_schema()
+    interner = InternTable()
+    batch = schema.to_batch(
+        [1, 2], [("A", 1.0, 1), ("B", 2.0, 2)], interner, capacity=8,
+        kinds=[KIND_CURRENT, KIND_EXPIRED],
+    )
+    assert np.asarray(batch.valid).sum() == 2
+    out = schema.from_batch(batch, interner)
+    assert [k for _, k, _ in out] == [KIND_CURRENT, KIND_EXPIRED]
+
+
+def test_intern_table_identity():
+    t = InternTable()
+    a, b = t.intern("x"), t.intern("x")
+    assert a == b and t.intern("y") != a
+    assert t.lookup(a) == "x"
+    assert t.intern(None) == 0 and t.lookup(0) is None
+
+
+def test_concat():
+    schema = make_schema()
+    interner = InternTable()
+    a = schema.to_batch([1], [("A", 1.0, 1)], interner, capacity=2)
+    b = schema.to_batch([2], [("B", 2.0, 2)], interner, capacity=2)
+    c = concat_batches(a, b)
+    assert c.capacity == 4
+    out = schema.from_batch(c, interner)
+    assert [r[2][0] for r in out] == ["A", "B"]
+
+
+def test_pytree_registration():
+    import jax
+
+    schema = make_schema()
+    interner = InternTable()
+    batch = schema.to_batch([1], [("A", 1.0, 1)], interner, capacity=2)
+    leaves = jax.tree_util.tree_leaves(batch)
+    assert len(leaves) == 6  # ts, kind, valid + 3 cols
+    mapped = jax.tree_util.tree_map(lambda x: x, batch)
+    assert isinstance(mapped, EventBatch)

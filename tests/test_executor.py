@@ -1,0 +1,218 @@
+"""Expression compiler tests — type promotion / Java arithmetic semantics.
+
+Mirrors behaviors pinned by the reference's per-type executors
+(reference: core/executor/math/*, condition/*, function/*).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from siddhi_tpu.core.executor import Env, Scope, compile_expression
+from siddhi_tpu.core.types import AttrType, InternTable
+from siddhi_tpu.query_api.expression import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Divide,
+    Expression,
+    IsNull,
+    Mod,
+    Multiply,
+    Not,
+    Or,
+    Subtract,
+    Variable,
+)
+
+E = Expression
+
+
+def make_scope():
+    interner = InternTable()
+    scope = Scope(interner)
+    scope.add_stream(
+        "S",
+        {
+            "i": AttrType.INT,
+            "l": AttrType.LONG,
+            "f": AttrType.FLOAT,
+            "d": AttrType.DOUBLE,
+            "b": AttrType.BOOL,
+            "s": AttrType.STRING,
+        },
+    )
+    return scope, interner
+
+
+def make_env(interner, **over):
+    cols = {
+        ("S", None, "i"): jnp.array([1, -7, 3], dtype=jnp.int32),
+        ("S", None, "l"): jnp.array([10, 20, 30], dtype=jnp.int64),
+        ("S", None, "f"): jnp.array([1.5, 2.5, 3.5], dtype=jnp.float32),
+        ("S", None, "d"): jnp.array([0.5, 1.0, 2.0], dtype=jnp.float32),
+        ("S", None, "b"): jnp.array([True, False, True]),
+        ("S", None, "s"): jnp.array(
+            [interner.intern("WSO2"), interner.intern("IBM"), 0], dtype=jnp.int32
+        ),
+        ("S", None, "__ts__"): jnp.array([100, 200, 300], dtype=jnp.int64),
+    }
+    cols.update(over)
+    return Env(cols, now=jnp.asarray(12345, dtype=jnp.int64))
+
+
+def run(expr, scope=None, interner=None):
+    if scope is None:
+        scope, interner = make_scope()
+    c = compile_expression(expr, scope)
+    return c, np.asarray(c(make_env(interner)))
+
+
+def test_promotion_matrix():
+    scope, interner = make_scope()
+    cases = [
+        (Add(Variable("i"), Variable("i")), AttrType.INT),
+        (Add(Variable("i"), Variable("l")), AttrType.LONG),
+        (Add(Variable("l"), Variable("f")), AttrType.FLOAT),
+        (Add(Variable("f"), Variable("d")), AttrType.DOUBLE),
+        (Multiply(Variable("i"), Variable("d")), AttrType.DOUBLE),
+    ]
+    for expr, want in cases:
+        c = compile_expression(expr, scope)
+        assert c.type is want, (expr, c.type)
+
+
+def test_java_int_division_truncates():
+    # Java: -7 / 2 == -3 (trunc), not floor(-3.5) == -4
+    c, out = run(Divide(Variable("i"), Constant(2, AttrType.INT)))
+    assert c.type is AttrType.INT
+    assert out.tolist() == [0, -3, 1]
+
+
+def test_java_mod_sign():
+    # Java: -7 % 3 == -1
+    c, out = run(Mod(Variable("i"), Constant(3, AttrType.INT)))
+    assert out.tolist() == [1, -1, 0]
+
+
+def test_float_divide():
+    c, out = run(Divide(Variable("f"), Constant(2, AttrType.INT)))
+    assert c.type is AttrType.FLOAT
+    np.testing.assert_allclose(out, [0.75, 1.25, 1.75])
+
+
+def test_compare_cross_type():
+    _, out = run(Compare(Variable("i"), CompareOp.GT, Variable("d")))
+    assert out.tolist() == [True, False, True]
+
+
+def test_string_equality_and_order_rejected():
+    scope, interner = make_scope()
+    c = compile_expression(
+        Compare(Variable("s"), CompareOp.EQ, Constant("WSO2", AttrType.STRING)), scope
+    )
+    out = np.asarray(c(make_env(interner)))
+    assert out.tolist() == [True, False, False]
+    with pytest.raises(TypeError):
+        compile_expression(
+            Compare(Variable("s"), CompareOp.LT, Constant("A", AttrType.STRING)), scope
+        )
+
+
+def test_bool_ops():
+    _, out = run(
+        And(Variable("b"), Not(Or(Variable("b"), Constant(False, AttrType.BOOL))))
+    )
+    assert out.tolist() == [False, False, False]
+    with pytest.raises(TypeError):
+        run(And(Variable("i"), Variable("b")))
+
+
+def test_is_null_string():
+    _, out = run(IsNull(Variable("s")))
+    assert out.tolist() == [False, False, True]
+
+
+def test_coalesce_and_default():
+    scope, interner = make_scope()
+    c = compile_expression(
+        AttributeFunction(None, "coalesce", [Variable("s"), Constant("dflt", AttrType.STRING)]),
+        scope,
+    )
+    env = make_env(interner)
+    out = [interner.lookup(int(v)) for v in np.asarray(c(env))]
+    assert out == ["WSO2", "IBM", "dflt"]
+
+    c2 = compile_expression(
+        AttributeFunction(None, "default", [Variable("s"), Constant("x", AttrType.STRING)]),
+        scope,
+    )
+    out2 = [interner.lookup(int(v)) for v in np.asarray(c2(env))]
+    assert out2 == ["WSO2", "IBM", "x"]
+
+
+def test_if_then_else_and_minmax():
+    _, out = run(
+        AttributeFunction(
+            None,
+            "ifThenElse",
+            [
+                Compare(Variable("i"), CompareOp.GE, Constant(0, AttrType.INT)),
+                Variable("i"),
+                Constant(0, AttrType.INT),
+            ],
+        )
+    )
+    assert out.tolist() == [1, 0, 3]
+
+    c, out = run(AttributeFunction(None, "maximum", [Variable("i"), Variable("f")]))
+    assert c.type is AttrType.FLOAT
+    np.testing.assert_allclose(out, [1.5, 2.5, 3.5])
+
+
+def test_cast_and_instanceof():
+    scope, interner = make_scope()
+    c = compile_expression(
+        AttributeFunction(None, "cast", [Variable("f"), Constant("int", AttrType.STRING)]),
+        scope,
+    )
+    assert c.type is AttrType.INT
+    out = np.asarray(c(make_env(interner)))
+    assert out.tolist() == [1, 2, 3]
+
+    c2 = compile_expression(
+        AttributeFunction(None, "instanceOfFloat", [Variable("f")]), scope
+    )
+    assert np.asarray(c2(make_env(interner))).tolist() == [True, True, True]
+    c3 = compile_expression(
+        AttributeFunction(None, "instanceOfString", [Variable("f")]), scope
+    )
+    assert np.asarray(c3(make_env(interner))).tolist() == [False, False, False]
+
+
+def test_event_timestamp_and_now():
+    _, out = run(AttributeFunction(None, "eventTimestamp", []))
+    assert out.tolist() == [100, 200, 300]
+    _, out = run(AttributeFunction(None, "currentTimeMillis", []))
+    assert int(out) == 12345
+
+
+def test_unqualified_ambiguity():
+    interner = InternTable()
+    scope = Scope(interner)
+    scope.add_stream("A", {"x": AttrType.INT})
+    scope.add_stream("B", {"x": AttrType.INT})
+    with pytest.raises(KeyError):
+        compile_expression(Variable("x"), scope)
+    c = compile_expression(Variable("x", stream_id="B"), scope)
+    env = Env({("B", None, "x"): jnp.array([5], dtype=jnp.int32)})
+    assert np.asarray(c(env)).tolist() == [5]
+
+
+def test_aggregator_rejected_in_scalar_position():
+    scope, _ = make_scope()
+    with pytest.raises(TypeError):
+        compile_expression(AttributeFunction(None, "sum", [Variable("i")]), scope)
