@@ -102,13 +102,16 @@ class JoinInputStream:
 
 
 class StateElement:
-    pass
+    """Base; every element may carry a `within_ms` bound
+    (reference: query-api execution/query/input/state/StateElement.java)."""
+
+    within_ms: Optional[int]
 
 
 @dataclasses.dataclass
 class StreamStateElement(StateElement):
     stream: SingleInputStream
-    within: Optional[int] = None  # ms
+    within_ms: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -121,6 +124,7 @@ class CountStateElement(StateElement):
     stream: StreamStateElement
     min_count: int = 0
     max_count: int = -1  # -1 == ANY / unbounded
+    within_ms: Optional[int] = None
 
     ANY = -1
 
@@ -129,11 +133,13 @@ class CountStateElement(StateElement):
 class NextStateElement(StateElement):
     state: StateElement
     next: StateElement
+    within_ms: Optional[int] = None
 
 
 @dataclasses.dataclass
 class EveryStateElement(StateElement):
     state: StateElement
+    within_ms: Optional[int] = None
 
 
 class LogicalType(enum.Enum):
@@ -146,6 +152,7 @@ class LogicalStateElement(StateElement):
     left: StateElement
     type: LogicalType
     right: StateElement
+    within_ms: Optional[int] = None
 
 
 class StateStreamType(enum.Enum):
@@ -348,6 +355,7 @@ class Partition:
 @dataclasses.dataclass
 class InputStore:
     store_id: str
+    alias: Optional[str] = None
     on: Optional[Expression] = None
     within: Optional[tuple[Expression, Optional[Expression]]] = None
     per: Optional[Expression] = None

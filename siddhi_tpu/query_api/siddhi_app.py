@@ -83,12 +83,8 @@ class SiddhiApp:
     @property
     def name(self) -> str:
         for a in self.annotations:
-            if a.name.lower() == "app":
-                v = a.element("name")
-                if v:
-                    return v
-            if a.name.lower() == "name":
-                v = a.element(None)
+            if a.name.lower() in ("app:name", "app", "name"):
+                v = a.element("name") or a.element(None)
                 if v:
                     return v
         return "SiddhiApp"

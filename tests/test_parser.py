@@ -1,0 +1,423 @@
+"""SiddhiQL parser tests — grammar -> AST round trips.
+
+Mirrors the reference's siddhi-query-compiler test strategy (grammar -> AST
+assertions) over the SiddhiQL surface in SiddhiQL.g4.
+"""
+
+import pytest
+
+from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+from siddhi_tpu.core.errors import SiddhiParserError
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.query_api.definition import Duration
+from siddhi_tpu.query_api.execution import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    DeleteStream,
+    EventOutputRate,
+    EveryStateElement,
+    Filter,
+    InsertIntoStream,
+    JoinInputStream,
+    JoinType,
+    LogicalStateElement,
+    LogicalType,
+    NextStateElement,
+    OutputEventsFor,
+    OutputRateType,
+    Partition,
+    RangePartitionType,
+    SingleInputStream,
+    SnapshotOutputRate,
+    StateInputStream,
+    StateStreamType,
+    StreamStateElement,
+    TimeOutputRate,
+    UpdateOrInsertStream,
+    UpdateStream,
+    ValuePartitionType,
+    WindowHandler,
+)
+from siddhi_tpu.query_api.expression import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    In,
+    IsNull,
+    Multiply,
+    Or,
+    Variable,
+)
+
+
+def parse(s):
+    return SiddhiCompiler.parse(s)
+
+
+def test_define_stream():
+    app = parse("define stream StockStream (symbol string, price float, volume long);")
+    d = app.stream_definitions["StockStream"]
+    assert [(a.name, a.type) for a in d.attributes] == [
+        ("symbol", AttrType.STRING),
+        ("price", AttrType.FLOAT),
+        ("volume", AttrType.LONG),
+    ]
+
+
+def test_case_insensitive_keywords_and_comments():
+    app = parse(
+        """
+        -- line comment
+        DEFINE STREAM S (a INT, b BOOL); /* block
+        comment */
+        FROM S SELECT a INSERT INTO Out;
+        """
+    )
+    assert "S" in app.stream_definitions
+    assert len(app.execution_elements) == 1
+
+
+def test_app_annotations_and_info():
+    app = parse(
+        """
+        @app:name('MyApp') @app:statistics('true')
+        define stream S (a int);
+        @info(name = 'query1')
+        from S select a insert into Out;
+        """
+    )
+    assert app.name == "MyApp"
+    q = app.execution_elements[0]
+    assert q.annotations[0].name == "info"
+    assert q.annotations[0].element("name") == "query1"
+
+
+def test_filter_query_structure():
+    app = parse(
+        """
+        define stream cseEventStream (symbol string, price float, volume long);
+        from cseEventStream[volume < 150] select symbol, price insert into outputStream;
+        """
+    )
+    q = app.execution_elements[0]
+    s = q.input_stream
+    assert isinstance(s, SingleInputStream)
+    assert isinstance(s.handlers[0], Filter)
+    cond = s.handlers[0].expression
+    assert isinstance(cond, Compare) and cond.op is CompareOp.LT
+    assert q.selector.selection_list[0].name == "symbol"
+    out = q.output_stream
+    assert isinstance(out, InsertIntoStream) and out.target == "outputStream"
+
+
+def test_window_and_stream_function_handlers():
+    app = parse(
+        """
+        define stream S (a int, b string);
+        from S[a > 10]#window.length(5) select a, sum(a) as total insert into O;
+        """
+    )
+    s = app.execution_elements[0].input_stream
+    assert isinstance(s.handlers[0], Filter)
+    w = s.handlers[1]
+    assert isinstance(w, WindowHandler)
+    assert w.window.name == "length"
+    assert w.window.parameters[0].value == 5
+    agg = app.execution_elements[0].selector.selection_list[1]
+    assert agg.rename == "total"
+    assert isinstance(agg.expression, AttributeFunction)
+
+
+def test_time_constants():
+    assert SiddhiCompiler.parse_time_constant("1 min 30 sec") == 90_000
+    assert SiddhiCompiler.parse_time_constant("2 hours") == 7_200_000
+    assert SiddhiCompiler.parse_time_constant("500 milliseconds") == 500
+    e = SiddhiCompiler.parse_expression("1 min")
+    assert isinstance(e, Constant) and e.value == 60_000 and e.type is AttrType.LONG
+
+
+def test_expression_precedence():
+    e = SiddhiCompiler.parse_expression("a + b * 2 > 5 and c == 'x' or not d")
+    assert isinstance(e, Or)
+    assert isinstance(e.left, And)
+    gt = e.left.left
+    assert isinstance(gt, Compare) and gt.op is CompareOp.GT
+    assert isinstance(gt.left, Add) and isinstance(gt.left.right, Multiply)
+
+
+def test_literals():
+    cases = {
+        "42": (42, AttrType.INT),
+        "42L": (42, AttrType.LONG),
+        "4.2f": (4.2, AttrType.FLOAT),
+        "4.2": (4.2, AttrType.DOUBLE),
+        "4.2d": (4.2, AttrType.DOUBLE),
+        "-7": (-7, AttrType.INT),
+        "true": (True, AttrType.BOOL),
+        "'str'": ("str", AttrType.STRING),
+    }
+    for src, (val, t) in cases.items():
+        e = SiddhiCompiler.parse_expression(src)
+        assert isinstance(e, Constant) and e.value == val and e.type is t, src
+
+
+def test_is_null_and_in():
+    e = SiddhiCompiler.parse_expression("price is null")
+    assert isinstance(e, IsNull) and isinstance(e.expression, Variable)
+    e2 = SiddhiCompiler.parse_expression("symbol == 'x' in MyTable")
+    assert isinstance(e2, In) and e2.source_id == "MyTable"
+
+
+def test_join_query():
+    app = parse(
+        """
+        define stream A (symbol string, price float);
+        define stream B (symbol string, qty int);
+        from A#window.length(10) as l join B#window.time(1 min) as r
+            on l.symbol == r.symbol
+        select l.symbol as s, r.qty insert into J;
+        """
+    )
+    j = app.execution_elements[0].input_stream
+    assert isinstance(j, JoinInputStream)
+    assert j.join_type is JoinType.JOIN
+    assert j.left.alias == "l" and j.right.alias == "r"
+    assert isinstance(j.on, Compare)
+    v = j.on.left
+    assert isinstance(v, Variable) and v.stream_id == "l" and v.attribute == "symbol"
+
+
+def test_outer_joins_and_unidirectional():
+    app = parse(
+        """
+        define stream A (x int); define stream B (x int);
+        from A#window.length(2) unidirectional left outer join B#window.length(2)
+            on A.x == B.x select A.x insert into O;
+        """
+    )
+    j = app.execution_elements[0].input_stream
+    assert j.join_type is JoinType.LEFT_OUTER
+    assert j.unidirectional == "left"
+
+
+def test_pattern_every_within():
+    app = parse(
+        """
+        define stream A (v int); define stream B (v int);
+        from every e1=A[v > 10] -> e2=B[v > e1.v] within 1 min
+        select e1.v as v1, e2.v as v2 insert into O;
+        """
+    )
+    st = app.execution_elements[0].input_stream
+    assert isinstance(st, StateInputStream)
+    assert st.type is StateStreamType.PATTERN
+    chain = st.state
+    assert isinstance(chain, NextStateElement)
+    assert isinstance(chain.state, EveryStateElement)
+    first = chain.state.state
+    assert isinstance(first, StreamStateElement)
+    assert first.stream.alias == "e1"
+    second = chain.next
+    # within attaches to the last term
+    assert second.within_ms == 60_000
+    # filter referencing e1.v
+    f = second.stream.handlers[0]
+    assert isinstance(f.expression, Compare)
+    assert f.expression.right.stream_id == "e1"
+
+
+def test_pattern_count_and_collect():
+    app = parse(
+        """
+        define stream A (v int); define stream B (v int);
+        from e1=A[v>0]<2:5> -> e2=B select e1[0].v as f, e1[last].v as l insert into O;
+        """
+    )
+    st = app.execution_elements[0].input_stream
+    cnt = st.state.state
+    assert isinstance(cnt, CountStateElement)
+    assert (cnt.min_count, cnt.max_count) == (2, 5)
+    sel = app.execution_elements[0].selector
+    v0 = sel.selection_list[0].expression
+    assert v0.stream_index == 0
+    vl = sel.selection_list[1].expression
+    assert vl.stream_index == Variable.LAST
+
+
+def test_pattern_logical_and_absent():
+    app = parse(
+        """
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from e1=A and e2=B -> not C for 2 sec select e1.v insert into O;
+        """
+    )
+    st = app.execution_elements[0].input_stream
+    chain = st.state
+    logical = chain.state
+    assert isinstance(logical, LogicalStateElement)
+    assert logical.type is LogicalType.AND
+    absent = chain.next
+    assert isinstance(absent, AbsentStreamStateElement)
+    assert absent.waiting_time_ms == 2000
+
+
+def test_sequence_with_kleene():
+    app = parse(
+        """
+        define stream A (v int); define stream B (v int);
+        from every e1=A, e2=A[v > e1.v]+, e3=B select e1.v insert into O;
+        """
+    )
+    st = app.execution_elements[0].input_stream
+    assert st.type is StateStreamType.SEQUENCE
+    # chain: Next(Next(Every(e1), Count(e2,1,ANY)), e3)
+    inner = st.state.state
+    assert isinstance(inner.next, CountStateElement)
+    assert inner.next.min_count == 1
+    assert inner.next.max_count == CountStateElement.ANY
+
+
+def test_output_rates():
+    app = parse(
+        """
+        define stream S (a int);
+        from S select a output last every 3 events insert into O1;
+        from S select a output every 2 sec insert into O2;
+        from S select a output snapshot every 1 sec insert into O3;
+        """
+    )
+    r1, r2, r3 = [q.output_rate for q in app.execution_elements]
+    assert isinstance(r1, EventOutputRate) and r1.events == 3 and r1.type is OutputRateType.LAST
+    assert isinstance(r2, TimeOutputRate) and r2.millis == 2000
+    assert isinstance(r3, SnapshotOutputRate) and r3.millis == 1000
+
+
+def test_group_by_having_order_limit():
+    app = parse(
+        """
+        define stream S (sym string, p float, v int);
+        from S#window.lengthBatch(4)
+        select sym, avg(p) as ap group by sym, v having ap > 10
+        order by sym desc limit 5 offset 1
+        insert all events into O;
+        """
+    )
+    sel = app.execution_elements[0].selector
+    assert [g.attribute for g in sel.group_by] == ["sym", "v"]
+    assert sel.having is not None
+    assert sel.order_by[0].variable.attribute == "sym"
+    assert sel.order_by[0].order.value == "desc"
+    assert sel.limit == 5 and sel.offset == 1
+    assert app.execution_elements[0].output_stream.output_events is OutputEventsFor.ALL
+
+
+def test_table_crud_outputs():
+    app = parse(
+        """
+        define stream S (sym string, p float);
+        define table T (sym string, p float);
+        from S select sym, p insert into T;
+        from S delete T on T.sym == sym;
+        from S update T set T.p = p on T.sym == sym;
+        from S update or insert into T set T.p = p on T.sym == sym;
+        """
+    )
+    outs = [q.output_stream for q in app.execution_elements]
+    assert isinstance(outs[1], DeleteStream) and outs[1].target == "T"
+    assert isinstance(outs[2], UpdateStream)
+    assert outs[2].set_attributes[0].table_variable.stream_id == "T"
+    assert isinstance(outs[3], UpdateOrInsertStream)
+
+
+def test_partition():
+    app = parse(
+        """
+        define stream S (sym string, p float);
+        partition with (sym of S)
+        begin
+            from S select sym, sum(p) as t insert into #inner;
+            from #inner select sym, t insert into Out;
+        end;
+        """
+    )
+    part = app.execution_elements[0]
+    assert isinstance(part, Partition)
+    assert isinstance(part.partition_types[0], ValuePartitionType)
+    assert len(part.queries) == 2
+    assert part.queries[0].output_stream.is_inner
+    assert part.queries[1].input_stream.is_inner
+
+
+def test_range_partition():
+    app = parse(
+        """
+        define stream S (p float);
+        partition with (p < 10 as 'low' or p >= 10 as 'high' of S)
+        begin from S select p insert into O; end;
+        """
+    )
+    pt = app.execution_elements[0].partition_types[0]
+    assert isinstance(pt, RangePartitionType)
+    assert [r.partition_key for r in pt.ranges] == ["low", "high"]
+
+
+def test_definitions_window_trigger_function_aggregation():
+    app = parse(
+        """
+        define window W (a int) length(5) output all events;
+        define trigger T at every 5 sec;
+        define trigger T2 at 'start';
+        define function f[javascript] return int { return 1; };
+        define stream S (sym string, p float, ts long);
+        define aggregation Agg from S select sym, avg(p) as ap group by sym
+            aggregate by ts every sec ... year;
+        """
+    )
+    assert app.window_definitions["W"].window.name == "length"
+    assert app.trigger_definitions["T"].at_every_ms == 5000
+    assert app.trigger_definitions["T2"].at_start
+    assert app.function_definitions["f"].language == "javascript"
+    agg = app.aggregation_definitions["Agg"]
+    assert agg.time_period.durations[0] is Duration.SECONDS
+    assert agg.time_period.durations[-1] is Duration.YEARS
+    assert agg.aggregate_attribute.attribute == "ts"
+
+
+def test_store_query():
+    sq = SiddhiCompiler.parse_store_query(
+        "from T on p > 5 select sym, p order by p desc limit 2"
+    )
+    assert sq.input_store.store_id == "T"
+    assert isinstance(sq.input_store.on, Compare)
+    assert sq.selector.limit == 2
+
+
+def test_parse_errors_have_location():
+    with pytest.raises(SiddhiParserError) as ei:
+        parse("define stream S (a int)\nfrom S select ^ insert into O;")
+    assert "line" in str(ei.value)
+
+
+def test_select_star_passthrough():
+    app = parse(
+        "define stream S (a int); from S insert into O; from S select * insert into P;"
+    )
+    assert app.execution_elements[0].selector.select_all
+    assert app.execution_elements[1].selector.select_all
+
+
+def test_triple_quoted_string_annotation():
+    app = parse(
+        '''
+        @sink(type='log', @map(type='json', @payload("""{"v":{{a}}}""")))
+        define stream S (a int);
+        '''
+    )
+    sink = app.stream_definitions["S"].annotations[0]
+    assert sink.name == "sink"
+    m = sink.annotations[0]
+    assert m.name == "map"
+    assert m.annotations[0].elements[0][1] == '{"v":{{a}}}'
