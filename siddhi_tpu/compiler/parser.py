@@ -248,11 +248,7 @@ class Parser:
                 sep = self.next().type
                 parts.append(sep + self.name())
             if self.accept("="):
-                key = "".join(
-                    p if i == 0 else p for i, p in enumerate(parts)
-                )
-                val = self._property_value()
-                return (key, val)
+                return ("".join(parts), self._property_value())
             self.pos = start
         return (None, self._property_value())
 
@@ -458,12 +454,14 @@ class Parser:
             elif depth < 0:
                 break
             i += 1
+        if saw_join:
+            # JOIN at depth 0 can only be a join query (filters keep and/or and
+            # commas inside brackets; aggregation joins add within-clause commas)
+            return "join"
         if saw_comma and (saw_arrow or saw_assign or starts_every_or_not or saw_logical):
             return "sequence"
-        if saw_arrow or saw_assign or starts_every_or_not or (saw_logical and not saw_join):
+        if saw_arrow or saw_assign or starts_every_or_not or saw_logical:
             return "pattern"
-        if saw_join:
-            return "join"
         if saw_comma:
             return "sequence"
         return "standard"
@@ -590,31 +588,23 @@ class Parser:
         return elem
 
     def _pattern_source(self, sep: str) -> StateElement:
-        # absent: not S[...] (for t)?
+        left = self._single_or_absent(sep)
+        if self.at_kw("and", "or"):
+            op = LogicalType(self.next().text.lower())
+            right = self._single_or_absent(sep)
+            return LogicalStateElement(left, op, right)
+        return left
+
+    def _single_or_absent(self, sep: str) -> StateElement:
+        # absent source: not S[...] (for t)?  — absent may appear on either or
+        # both sides of a logical element (reference: logical_absent_stateful)
         if self.accept_kw("not"):
             s = self._basic_source()
             waiting = None
             if self.accept_kw("for"):
                 waiting = self._time_value()
-            absent = AbsentStreamStateElement(stream=s, waiting_time_ms=waiting)
-            if self.at_kw("and", "or"):
-                op = LogicalType(self.next().text.lower())
-                other = self._pattern_single(sep)
-                return LogicalStateElement(absent, op, other)
-            return absent
-        left = self._pattern_single(sep)
-        if self.at_kw("and", "or"):
-            op = LogicalType(self.next().text.lower())
-            if self.accept_kw("not"):
-                s = self._basic_source()
-                waiting = None
-                if self.accept_kw("for"):
-                    waiting = self._time_value()
-                right: StateElement = AbsentStreamStateElement(stream=s, waiting_time_ms=waiting)
-            else:
-                right = self._pattern_single(sep)
-            return LogicalStateElement(left, op, right)
-        return left
+            return AbsentStreamStateElement(stream=s, waiting_time_ms=waiting)
+        return self._pattern_single(sep)
 
     def _pattern_single(self, sep: str) -> StateElement:
         # (event '=')? basic_source ('<' collect '>' | * + ?)?
@@ -925,7 +915,7 @@ class Parser:
         if t.type == "INT":
             # time constant? INT followed by a time unit identifier
             if self.peek(1).type == "ID" and self.peek(1).text.lower() in TIME_UNITS:
-                return Constant(self._time_value(), AttrType.LONG)
+                return TimeConstant(self._time_value())
             self.next()
             return Constant(int(t.value), AttrType.INT)
         if t.type == "LONG":

@@ -106,9 +106,10 @@ def tokenize(src: str) -> list[Token]:
                     depth -= 1
                     if depth == 0:
                         break
-                elif src[j] == '"':
+                elif src[j] in "'\"":
+                    q = src[j]
                     j += 1
-                    while j < n and src[j] != '"':
+                    while j < n and src[j] != q:
                         j += 1
                 j += 1
             if depth != 0:
@@ -151,6 +152,8 @@ def tokenize(src: str) -> list[Token]:
             else:
                 suffix = ""
             if suffix == "L":
+                if seen_dot or seen_exp:
+                    raise err(f"invalid long literal {body + 'L'!r}")
                 toks.append(Token("LONG", int(body), tl, tc))
             elif suffix == "F":
                 toks.append(Token("FLOAT", float(body), tl, tc))

@@ -1,0 +1,220 @@
+"""Attribute aggregators: streaming sum/count/avg/min/max/stdDev/... over batches.
+
+Reference: query/selector/attribute/aggregator/*.java — per-event add on CURRENT,
+remove on EXPIRED, zero on RESET, type-specialized inner classes. Batched here:
+per-event running outputs become reset-aware prefix reductions (ops/prefix.py);
+min/max/distinct under an upstream window use the window's membership matrix
+(exact expiry accounting) instead of incremental remove, which is the TPU-shaped
+equivalent of the reference's value-deque bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from siddhi_tpu.core.executor import CompiledExpr, Env
+from siddhi_tpu.core.types import AttrType, PHYSICAL_DTYPE, null_value
+from siddhi_tpu.ops.prefix import extreme_identity, running_extreme, running_sum
+
+
+@dataclasses.dataclass
+class FlowInfo:
+    """Per-batch signals handed to aggregators by the selector.
+
+    sign:   [B] +1 valid CURRENT, -1 valid EXPIRED, 0 otherwise
+    active: [B] valid CURRENT rows
+    reset:  [B] valid RESET rows
+    member / member_env: optional [B, K] window membership matrix (row i = the
+        window contents as seen just after event i) and an Env over the K-long
+        window columns — provided by window stages for exact min/max/distinct.
+    """
+
+    sign: jnp.ndarray
+    active: jnp.ndarray
+    reset: jnp.ndarray
+    member: Optional[jnp.ndarray] = None
+    member_env: Optional[Env] = None
+
+
+class CompiledAggregator:
+    """One aggregator instance in a selector; owns a slice of query state."""
+
+    type: AttrType
+
+    def init(self):  # -> pytree of device arrays
+        raise NotImplementedError
+
+    def apply(self, state, flow: FlowInfo, env: Env):  # -> (state', [B] col)
+        raise NotImplementedError
+
+
+def _null_arr(t: AttrType):
+    return jnp.asarray(null_value(t), dtype=PHYSICAL_DTYPE[t])
+
+
+class SumAggregator(CompiledAggregator):
+    """sum(): LONG for int/long input, DOUBLE for float/double
+    (reference: SumAttributeAggregator.java type matrix)."""
+
+    def __init__(self, arg: CompiledExpr):
+        self.arg = arg
+        self.type = (
+            AttrType.LONG if arg.type in (AttrType.INT, AttrType.LONG) else AttrType.DOUBLE
+        )
+        self.dtype = PHYSICAL_DTYPE[self.type]
+
+    def init(self):
+        return jnp.zeros((), dtype=self.dtype)
+
+    def apply(self, state, flow: FlowInfo, env: Env):
+        x = self.arg(env).astype(self.dtype)
+        contrib = jnp.where(flow.sign != 0, x * flow.sign.astype(self.dtype), 0)
+        run, carry = running_sum(contrib, flow.reset, state)
+        return carry, run
+
+
+class CountAggregator(CompiledAggregator):
+    type = AttrType.LONG
+
+    def init(self):
+        return jnp.zeros((), dtype=jnp.int64)
+
+    def apply(self, state, flow: FlowInfo, env: Env):
+        run, carry = running_sum(flow.sign.astype(jnp.int64), flow.reset, state)
+        return carry, run
+
+
+class AvgAggregator(CompiledAggregator):
+    """DOUBLE average; null (NaN) when count == 0, matching the reference
+    (reference: AvgAttributeAggregator.java:164-166 returns null on count 0)."""
+
+    type = AttrType.DOUBLE
+
+    def __init__(self, arg: CompiledExpr):
+        self.arg = arg
+
+    def init(self):
+        z = jnp.zeros((), dtype=jnp.float32)
+        return {"sum": z, "count": z}
+
+    def apply(self, state, flow: FlowInfo, env: Env):
+        x = self.arg(env).astype(jnp.float32)
+        sgn = flow.sign.astype(jnp.float32)
+        s_run, s_carry = running_sum(jnp.where(flow.sign != 0, x * sgn, 0.0), flow.reset, state["sum"])
+        c_run, c_carry = running_sum(sgn, flow.reset, state["count"])
+        out = jnp.where(c_run != 0, s_run / jnp.where(c_run != 0, c_run, 1.0), jnp.nan)
+        return {"sum": s_carry, "count": c_carry}, out
+
+
+class StdDevAggregator(CompiledAggregator):
+    """Population std-dev from running sum/sumsq/count
+    (reference: StdDevAttributeAggregator.java)."""
+
+    type = AttrType.DOUBLE
+
+    def __init__(self, arg: CompiledExpr):
+        self.arg = arg
+
+    def init(self):
+        z = jnp.zeros((), dtype=jnp.float32)
+        return {"sum": z, "sumsq": z, "count": z}
+
+    def apply(self, state, flow: FlowInfo, env: Env):
+        x = self.arg(env).astype(jnp.float32)
+        sgn = flow.sign.astype(jnp.float32)
+        s_run, s_c = running_sum(jnp.where(flow.sign != 0, x * sgn, 0.0), flow.reset, state["sum"])
+        q_run, q_c = running_sum(jnp.where(flow.sign != 0, x * x * sgn, 0.0), flow.reset, state["sumsq"])
+        c_run, c_c = running_sum(sgn, flow.reset, state["count"])
+        safe_n = jnp.where(c_run != 0, c_run, 1.0)
+        mean = s_run / safe_n
+        var = jnp.maximum(q_run / safe_n - mean * mean, 0.0)
+        out = jnp.where(c_run != 0, jnp.sqrt(var), jnp.nan)
+        return {"sum": s_c, "sumsq": q_c, "count": c_c}, out
+
+
+class ExtremeAggregator(CompiledAggregator):
+    """min/max. Exact under windows via the membership matrix; running
+    (monotone) otherwise. minForever/maxForever always run monotone
+    (reference: MinForeverAttributeAggregator.java ignores expiry)."""
+
+    def __init__(self, arg: CompiledExpr, is_min: bool, forever: bool):
+        self.arg = arg
+        self.type = arg.type
+        self.dtype = PHYSICAL_DTYPE[arg.type]
+        self.is_min = is_min
+        self.forever = forever
+
+    def init(self):
+        return extreme_identity(self.dtype, self.is_min)
+
+    def apply(self, state, flow: FlowInfo, env: Env):
+        ident = extreme_identity(self.dtype, self.is_min)
+        if not self.forever and flow.member is not None:
+            vals = self.arg(flow.member_env).astype(self.dtype)
+            masked = jnp.where(flow.member, vals[None, :], ident)
+            red = masked.min(axis=-1) if self.is_min else masked.max(axis=-1)
+            return state, jnp.where(red == ident, _null_arr(self.type), red)
+        reset = jnp.zeros_like(flow.reset) if self.forever else flow.reset
+        run, carry = running_extreme(
+            self.arg(env).astype(self.dtype), flow.active, reset, state, self.is_min
+        )
+        return carry, jnp.where(run == ident, _null_arr(self.type), run)
+
+
+class DistinctCountAggregator(CompiledAggregator):
+    """distinctCount under a window: per-event distinct member values via the
+    membership matrix (reference: DistinctCountAttributeAggregator.java keeps a
+    value->count map; the window columns make this a pure reduction here)."""
+
+    type = AttrType.LONG
+
+    def __init__(self, arg: CompiledExpr):
+        self.arg = arg
+
+    def init(self):
+        return jnp.zeros((), dtype=jnp.int64)
+
+    def apply(self, state, flow: FlowInfo, env: Env):
+        if flow.member is None:
+            raise NotImplementedError(
+                "distinctCount requires an upstream window (unbounded distinct "
+                "state is capacity-unbounded; the reference grows a map forever)"
+            )
+        vals = self.arg(flow.member_env)
+        k = vals.shape[-1]
+        eq = vals[None, :] == vals[:, None]  # [K, K]
+        earlier = jnp.tril(jnp.ones((k, k), dtype=bool), k=-1)
+        # member j is a duplicate within row i if some earlier member j' < j
+        # holds an equal value
+        dup = ((eq & earlier)[None, :, :] & flow.member[:, None, :]).any(axis=-1)
+        firsts = flow.member & ~dup
+        return state, firsts.sum(axis=-1).astype(jnp.int64)
+
+
+def build_aggregator(name: str, args: list[CompiledExpr]) -> CompiledAggregator:
+    low = name.lower()
+    if low == "count":
+        return CountAggregator()
+    if not args:
+        raise TypeError(f"aggregator '{name}' needs an argument")
+    arg = args[0]
+    if low == "sum":
+        return SumAggregator(arg)
+    if low == "avg":
+        return AvgAggregator(arg)
+    if low == "stddev":
+        return StdDevAggregator(arg)
+    if low == "min":
+        return ExtremeAggregator(arg, is_min=True, forever=False)
+    if low == "max":
+        return ExtremeAggregator(arg, is_min=False, forever=False)
+    if low == "minforever":
+        return ExtremeAggregator(arg, is_min=True, forever=True)
+    if low == "maxforever":
+        return ExtremeAggregator(arg, is_min=False, forever=True)
+    if low == "distinctcount":
+        return DistinctCountAggregator(arg)
+    raise TypeError(f"unknown aggregator '{name}'")

@@ -1,0 +1,213 @@
+"""SiddhiAppRuntime: app assembly and lifecycle.
+
+Reference: core/SiddhiAppRuntime.java:88-696 + util/parser/SiddhiAppParser.java —
+holds junction/query/table/window/aggregation maps, wires receivers into
+junctions, start/shutdown ordering, callback registration, store-query API.
+Here "parse" is compile: each query becomes a jitted device program; junctions
+are host fan-out points between compiled programs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.errors import DefinitionNotExistError, SiddhiAppCreationError
+from siddhi_tpu.core.event import (
+    Event,
+    EventBatch,
+    KIND_CURRENT,
+    KIND_EXPIRED,
+    StreamSchema,
+)
+from siddhi_tpu.core.query_runtime import QueryRuntime
+from siddhi_tpu.core.stream_junction import (
+    InputHandler,
+    StreamJunction,
+    system_clock_ms,
+)
+from siddhi_tpu.query_api.annotation import find_annotation
+from siddhi_tpu.query_api.execution import (
+    InsertIntoStream,
+    OutputEventsFor,
+    Partition,
+    Query,
+    SingleInputStream,
+)
+from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+DEFAULT_BATCH = 64
+
+
+class SiddhiAppRuntime:
+    def __init__(self, app: SiddhiApp, manager) -> None:
+        self.app = app
+        self.manager = manager
+        self.interner = manager.interner
+        self.name = app.name
+        self.clock = system_clock_ms
+        self._running = False
+        self._lock = threading.RLock()
+
+        self.stream_schemas: dict[str, StreamSchema] = {}
+        self.junctions: dict[str, StreamJunction] = {}
+        self.queries: dict[str, QueryRuntime] = {}
+
+        batch_ann = find_annotation(app.annotations, "app:batch")
+        self.batch_size = int(batch_ann.element("size", str(DEFAULT_BATCH))) if batch_ann else DEFAULT_BATCH
+
+        for sid, d in app.stream_definitions.items():
+            self.stream_schemas[sid] = StreamSchema(
+                sid, [(a.name, a.type) for a in d.attributes]
+            )
+
+        unnamed = 0
+        for elem in app.execution_elements:
+            if isinstance(elem, Query):
+                info = find_annotation(elem.annotations, "info")
+                qid = (info.element("name") if info else None) or f"query{unnamed}"
+                unnamed += 1
+                self._add_query(qid, elem)
+            elif isinstance(elem, Partition):
+                raise SiddhiAppCreationError("partitions land in M10")
+
+    # ---- assembly --------------------------------------------------------
+
+    def _junction(self, stream_id: str) -> StreamJunction:
+        j = self.junctions.get(stream_id)
+        if j is None:
+            schema = self.stream_schemas.get(stream_id)
+            if schema is None:
+                raise DefinitionNotExistError(f"stream '{stream_id}' is not defined")
+            j = StreamJunction(schema, self.interner, self.batch_size)
+            self.junctions[stream_id] = j
+        return j
+
+    def _add_query(self, qid: str, query: Query) -> None:
+        if qid in self.queries:
+            raise SiddhiAppCreationError(f"duplicate query name '{qid}'")
+        stream = query.input_stream
+        if not isinstance(stream, SingleInputStream):
+            raise SiddhiAppCreationError(
+                f"{type(stream).__name__} queries land in later milestones"
+            )
+        in_schema = self.stream_schemas.get(stream.stream_id)
+        if in_schema is None:
+            raise DefinitionNotExistError(
+                f"stream '{stream.stream_id}' is not defined"
+            )
+        qr = QueryRuntime(query, qid, in_schema, self.interner)
+        self.queries[qid] = qr
+
+        out = query.output_stream
+        if isinstance(out, InsertIntoStream):
+            target = out.target
+            existing = self.stream_schemas.get(target)
+            inferred = qr.out_schema
+            if existing is None:
+                self.stream_schemas[target] = inferred
+            elif [t for _, t in existing.attrs] != [t for _, t in inferred.attrs]:
+                raise SiddhiAppCreationError(
+                    f"insert into '{target}': selector output {inferred.attrs} "
+                    f"does not match defined stream {existing.attrs}"
+                )
+            target_junction = self._junction(target)
+            transform = _make_insert_transform(out.output_events)
+            rename = _make_rename(inferred, self.stream_schemas[target])
+
+            def publish(out_batch: EventBatch, now: int, _t=target_junction) -> None:
+                _t.publish_batch(rename(transform(out_batch)), now)
+
+            qr.publish_fn = publish
+
+        decode = self._decode
+        in_junction = self._junction(stream.stream_id)
+
+        def receive(batch: EventBatch, now: int, _qr=qr) -> None:
+            out_batch = _qr.receive(batch, now)
+            _qr.route_output(out_batch, now, decode)
+
+        in_junction.subscribe(receive)
+
+    def _decode(self, schema: StreamSchema, batch: EventBatch):
+        return schema.from_batch(batch, self.interner)
+
+    # ---- public API (reference: SiddhiAppRuntime callbacks/handlers) -----
+
+    def get_input_handler(self, stream_id: str) -> InputHandler:
+        return InputHandler(self._junction(stream_id), lambda: self.clock())
+
+    input_handler = get_input_handler
+
+    def add_callback(self, name: str, callback: Callable) -> None:
+        """Stream callback `cb(events: list[Event])` or query callback
+        `cb(timestamp, in_events, removed_events)` — dispatched on arity by
+        target: stream name vs @info query name (reference: addCallback overloads).
+        """
+        if name in self.queries:
+            qr = self.queries[name]
+
+            def qcb(ts, ins, removed, _cb=callback):
+                _cb(
+                    ts,
+                    [Event(t, d) for t, _, d in ins] if ins else None,
+                    [Event(t, d) for t, _, d in removed] if removed else None,
+                )
+
+            qr.query_callbacks.append(qcb)
+            return
+        if name in self.stream_schemas:
+            j = self._junction(name)
+            j.add_stream_callback(
+                lambda rows, _cb=callback: _cb([Event(t, d) for t, d in rows])
+            )
+            return
+        raise DefinitionNotExistError(f"no stream or query named '{name}'")
+
+    def start(self) -> None:
+        self._running = True
+
+    def shutdown(self) -> None:
+        self._running = False
+
+    def persist(self):  # M11
+        raise NotImplementedError("persistence lands in M11")
+
+    def restore_last_revision(self):  # M11
+        raise NotImplementedError("persistence lands in M11")
+
+
+def _make_insert_transform(output_events: OutputEventsFor):
+    @jax.jit
+    def t(batch: EventBatch) -> EventBatch:
+        if output_events is OutputEventsFor.CURRENT:
+            keep = batch.kind == KIND_CURRENT
+        elif output_events is OutputEventsFor.EXPIRED:
+            keep = batch.kind == KIND_EXPIRED
+        else:
+            keep = jnp.ones_like(batch.valid)
+        return EventBatch(
+            ts=batch.ts,
+            kind=jnp.zeros_like(batch.kind),  # inserted events become CURRENT
+            valid=batch.valid & keep,
+            cols=batch.cols,
+        )
+
+    return t
+
+
+def _make_rename(src: StreamSchema, dst: StreamSchema):
+    """Map selector output column names onto the target stream's attribute names
+    (positional, like the reference's insert-into meta mapping)."""
+    if src.attr_names == dst.attr_names:
+        return lambda b: b
+    dst_names = dst.attr_names
+
+    def rename(b: EventBatch) -> EventBatch:
+        cols = dict(zip(dst_names, b.cols.values()))
+        return EventBatch(b.ts, b.kind, b.valid, cols)
+
+    return rename

@@ -10,6 +10,7 @@ pool-borrowing becomes padding to a static batch capacity.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 from typing import Any, Sequence
 
@@ -29,6 +30,9 @@ KIND_CURRENT = 0
 KIND_EXPIRED = 1
 KIND_TIMER = 2
 KIND_RESET = 3
+
+# Host-side event (reference: core/event/Event.java — timestamp + Object[] data).
+Event = collections.namedtuple("Event", ["timestamp", "data"])
 
 
 @jax.tree_util.register_dataclass
@@ -125,6 +129,12 @@ class StreamSchema:
             kind[:n] = np.asarray(list(kinds), dtype=np.int8)
         valid = np.zeros((cap,), dtype=np.bool_)
         valid[:n] = True
+        for i, r in enumerate(rows):
+            if len(r) != len(self.attrs):
+                raise ValueError(
+                    f"stream '{self.stream_id}' expects {len(self.attrs)} "
+                    f"attributes {self.attr_names}, got {len(r)}: {r!r}"
+                )
         cols: dict[str, jax.Array] = {}
         for j, (name, t) in enumerate(self.attrs):
             dt = PHYSICAL_DTYPE[t]

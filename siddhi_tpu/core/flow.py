@@ -1,0 +1,58 @@
+"""The Flow — the trace-time object threaded through a compiled query chain.
+
+The reference threads `ComplexEventChunk`s through a linked `Processor` chain
+(reference: query/processor/Processor.java); here the chain is a compile-time
+composition of stages, each a pure function over this Flow during jit tracing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from siddhi_tpu.core.event import EventBatch, KIND_CURRENT, KIND_EXPIRED, KIND_RESET
+from siddhi_tpu.core.executor import Env, TS_ATTR, VarKey
+
+
+@dataclasses.dataclass
+class Flow:
+    """batch: events flowing through (padding/filtered rows have valid=False)
+    refs: stream refs whose attributes the batch columns carry (cols keyed
+          (ref, None, attr) in `extra`; primary single-stream cols live in
+          batch.cols under plain attr names for ref `ref`)
+    member/member_env: window membership view (see aggregators.FlowInfo)
+    """
+
+    batch: EventBatch
+    ref: str
+    now: jnp.ndarray  # scalar int64 wall/playback clock
+    extra_cols: dict[VarKey, jnp.ndarray] = dataclasses.field(default_factory=dict)
+    member: Optional[jnp.ndarray] = None
+    member_env: Optional[Env] = None
+
+    def env(self) -> Env:
+        cols: dict[VarKey, jnp.ndarray] = {
+            (self.ref, None, name): arr for name, arr in self.batch.cols.items()
+        }
+        cols[(self.ref, None, TS_ATTR)] = self.batch.ts
+        cols.update(self.extra_cols)
+        return Env(cols, now=self.now)
+
+    # ---- kind masks ----
+    @property
+    def current(self) -> jnp.ndarray:
+        return self.batch.valid & (self.batch.kind == KIND_CURRENT)
+
+    @property
+    def expired(self) -> jnp.ndarray:
+        return self.batch.valid & (self.batch.kind == KIND_EXPIRED)
+
+    @property
+    def reset(self) -> jnp.ndarray:
+        return self.batch.valid & (self.batch.kind == KIND_RESET)
+
+    @property
+    def sign(self) -> jnp.ndarray:
+        return self.current.astype(jnp.int8) - self.expired.astype(jnp.int8)

@@ -1,0 +1,186 @@
+"""Per-query compilation and runtime container.
+
+Reference: query/QueryRuntime.java:45-200 wires receiver -> processor chain ->
+selector -> rate limiter -> callback as runtime objects. Here the whole chain is
+compiled once into a single pure jax step function
+`(state, in_batch, now) -> (state', out_batch)` and jitted; the runtime object
+owns the device state and the host-side output routing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import (
+    EventBatch,
+    KIND_CURRENT,
+    KIND_EXPIRED,
+    KIND_TIMER,
+    StreamSchema,
+)
+from siddhi_tpu.core.executor import Scope, compile_expression
+from siddhi_tpu.core.flow import Flow
+from siddhi_tpu.core.selector import CompiledSelector
+from siddhi_tpu.core.types import AttrType, InternTable
+from siddhi_tpu.query_api.annotation import find_annotation
+from siddhi_tpu.query_api.execution import (
+    Filter,
+    InsertIntoStream,
+    OutputEventsFor,
+    Query,
+    ReturnStream,
+    SingleInputStream,
+    StreamFunctionHandler,
+    WindowHandler,
+)
+
+
+class CompiledSingleChain:
+    """filter* [window] filter* stages over one input stream (M3: filters only;
+    window stages attach in M4)."""
+
+    def __init__(
+        self,
+        stream: SingleInputStream,
+        schema: StreamSchema,
+        scope: Scope,
+        window_factory: Optional[Callable] = None,
+    ):
+        self.schema = schema
+        self.ref = stream.alias or stream.stream_id
+        self.filters = []
+        self.window = None
+        for h in stream.handlers:
+            if isinstance(h, Filter):
+                cond = compile_expression(h.expression, scope)
+                if cond.type is not AttrType.BOOL:
+                    raise SiddhiAppCreationError("filter must be a boolean expression")
+                self.filters.append((cond, self.window is not None))
+            elif isinstance(h, WindowHandler):
+                if self.window is not None:
+                    raise SiddhiAppCreationError("only one window per stream")
+                if window_factory is None:
+                    raise SiddhiAppCreationError(
+                        "windows are not available at this site"
+                    )
+                self.window = window_factory(h.window, schema, self.ref)
+            elif isinstance(h, StreamFunctionHandler):
+                raise SiddhiAppCreationError(
+                    f"stream function '{h.name}' not supported yet"
+                )
+
+    def init_state(self):
+        return self.window.init_state() if self.window is not None else ()
+
+    def apply(self, state, flow: Flow):
+        pre = [c for c, after in self.filters if not after]
+        post = [c for c, after in self.filters if after]
+        flow = self._filter(flow, pre)
+        if self.window is not None:
+            state, flow = self.window.apply(state, flow)
+        flow = self._filter(flow, post)
+        return state, flow
+
+    @staticmethod
+    def _filter(flow: Flow, conds) -> Flow:
+        if not conds:
+            return flow
+        env = flow.env()
+        mask = None
+        for c in conds:
+            m = c(env)
+            mask = m if mask is None else (mask & m)
+        is_timer = flow.batch.kind == KIND_TIMER  # timers bypass filters
+        valid = flow.batch.valid & (is_timer | mask)
+        batch = EventBatch(flow.batch.ts, flow.batch.kind, valid, flow.batch.cols)
+        return Flow(
+            batch, flow.ref, flow.now, flow.extra_cols, flow.member, flow.member_env
+        )
+
+
+class QueryRuntime:
+    """Compiled query + device state + host output routing."""
+
+    def __init__(
+        self,
+        query: Query,
+        query_id: str,
+        in_schema: StreamSchema,
+        interner: InternTable,
+        window_factory: Optional[Callable] = None,
+    ):
+        self.query = query
+        self.query_id = query_id
+        self.in_schema = in_schema
+        stream = query.input_stream
+        assert isinstance(stream, SingleInputStream)
+        self.ref = stream.alias or stream.stream_id
+
+        scope = Scope(interner)
+        scope.add_stream(self.ref, in_schema.attr_types)
+        if self.ref != in_schema.stream_id:
+            scope.add_stream(in_schema.stream_id, in_schema.attr_types)
+        scope.default_ref = self.ref
+
+        self.chain = CompiledSingleChain(stream, in_schema, scope, window_factory)
+        self.selector = CompiledSelector(query.selector, scope, in_schema.attrs)
+
+        out = query.output_stream
+        if isinstance(out, InsertIntoStream):
+            target = out.target
+        else:
+            target = f"__ret_{query_id}"
+        self.out_schema = StreamSchema(target, self.selector.out_attrs)
+        self.output_events = out.output_events
+
+        # host-side sinks wired by the app runtime
+        self.query_callbacks: list[Callable] = []
+        self.publish_fn: Optional[Callable] = None
+
+        self._step = jax.jit(self._step_impl)
+        self.state = None
+
+    # ---- device program --------------------------------------------------
+
+    def init_state(self):
+        return {"chain": self.chain.init_state(), "sel": self.selector.init_state()}
+
+    def _step_impl(self, state, batch: EventBatch, now):
+        flow = Flow(batch=batch, ref=self.ref, now=now)
+        chain_state, flow = self.chain.apply(state["chain"], flow)
+        sel_state, out = self.selector.apply(state["sel"], flow)
+        return {"chain": chain_state, "sel": sel_state}, out
+
+    # ---- host side -------------------------------------------------------
+
+    def receive(self, batch: EventBatch, now: int) -> EventBatch:
+        if self.state is None:
+            self.state = self.init_state()
+        self.state, out = self._step(self.state, batch, jnp.asarray(now, dtype=jnp.int64))
+        return out
+
+    def route_output(self, out: EventBatch, now: int, decode) -> None:
+        """Dispatch a step's output to query callbacks / downstream junction.
+
+        `decode` = app-runtime host decoder (batch -> event triples).
+        """
+        if self.query_callbacks:
+            events = decode(self.out_schema, out)
+            if events:
+                ins = [e for e in events if e[1] == KIND_CURRENT]
+                removed = [e for e in events if e[1] == KIND_EXPIRED]
+                want = self.output_events
+                if want is OutputEventsFor.CURRENT:
+                    removed = []
+                elif want is OutputEventsFor.EXPIRED:
+                    ins = []
+                if ins or removed:
+                    ts = events[-1][0]
+                    for cb in self.query_callbacks:
+                        cb(ts, ins or None, removed or None)
+        if self.publish_fn is not None:
+            self.publish_fn(out, now)

@@ -1,0 +1,161 @@
+"""Selector compilation: projection + aggregation + having (+ group-by in M5).
+
+Reference: query/selector/QuerySelector.java:44-430 — attribute processors over
+each event, aggregator state mutation, having filter, then output. Here the
+whole selector is one vectorized transform over the Flow; aggregator calls inside
+selection expressions are lifted out, computed as running columns, and re-injected
+as synthetic attributes of a pseudo-stream "__agg__".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from siddhi_tpu.core.aggregators import CompiledAggregator, FlowInfo, build_aggregator
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import EventBatch, KIND_CURRENT, KIND_EXPIRED
+from siddhi_tpu.core.executor import (
+    CompiledExpr,
+    Env,
+    Scope,
+    compile_expression,
+    is_aggregator,
+)
+from siddhi_tpu.core.flow import Flow
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.query_api.execution import OutputAttribute, Selector
+from siddhi_tpu.query_api.expression import AttributeFunction, Expression, Variable
+
+_AGG_REF = "__agg__"
+
+
+def _lift_aggregators(expr: Expression, found: list[AttributeFunction]) -> Expression:
+    """Replace aggregator calls with Variables into the __agg__ pseudo-stream."""
+    if is_aggregator(expr):
+        found.append(expr)
+        return Variable(f"a{len(found) - 1}", stream_id=_AGG_REF)
+    if dataclasses.is_dataclass(expr):
+        kwargs = {}
+        changed = False
+        for f in dataclasses.fields(expr):
+            v = getattr(expr, f.name)
+            if isinstance(v, Expression):
+                nv = _lift_aggregators(v, found)
+                changed |= nv is not v
+                kwargs[f.name] = nv
+            elif isinstance(v, list) and v and isinstance(v[0], Expression):
+                nv = [_lift_aggregators(x, found) for x in v]
+                changed |= any(a is not b for a, b in zip(nv, v))
+                kwargs[f.name] = nv
+            else:
+                kwargs[f.name] = v
+        if changed:
+            return type(expr)(**kwargs)
+    return expr
+
+
+class CompiledSelector:
+    """Stateful selector stage: (state, Flow) -> (state, output EventBatch)."""
+
+    def __init__(
+        self,
+        selector: Selector,
+        scope: Scope,
+        input_attrs: list[tuple[str, AttrType]] | None = None,
+    ):
+        self.selector = selector
+        sel_list = list(selector.selection_list)
+        if selector.select_all:
+            if input_attrs is None:
+                raise SiddhiAppCreationError("select * unsupported for this input")
+            sel_list = [OutputAttribute(None, Variable(n)) for n, _ in input_attrs]
+
+        # lift aggregator calls out of the selection expressions
+        agg_calls: list[AttributeFunction] = []
+        lifted = [(oa.name, _lift_aggregators(oa.expression, agg_calls)) for oa in sel_list]
+        self.aggregators: list[CompiledAggregator] = []
+        agg_types: dict[str, AttrType] = {}
+        for i, call in enumerate(agg_calls):
+            args = [compile_expression(p, scope) for p in call.parameters]
+            agg = build_aggregator(call.name, args)
+            self.aggregators.append(agg)
+            agg_types[f"a{i}"] = agg.type
+
+        inner = scope.child()
+        inner.add_stream(_AGG_REF, agg_types)
+        if inner.default_ref == _AGG_REF:
+            inner.default_ref = scope.default_ref
+
+        self.projections: list[tuple[str, CompiledExpr]] = []
+        names = set()
+        for name, expr in lifted:
+            if name in names:
+                raise SiddhiAppCreationError(f"duplicate output attribute '{name}'")
+            names.add(name)
+            self.projections.append((name, compile_expression(expr, inner)))
+
+        self.out_attrs: list[tuple[str, AttrType]] = [
+            (n, c.type) for n, c in self.projections
+        ]
+
+        # having can reference output attrs (by name) or input attrs
+        # (reference: QuerySelector having executor compiled over output meta)
+        self.having = None
+        if selector.having is not None:
+            hav_scope = inner.child()
+            hav_scope.add_stream("__out__", dict(self.out_attrs))
+            hav_scope.default_ref = scope.default_ref
+            lifted_h = _lift_aggregators(selector.having, agg_calls)
+            if len(agg_calls) > len(self.aggregators):
+                for i in range(len(self.aggregators), len(agg_calls)):
+                    call = agg_calls[i]
+                    args = [compile_expression(p, scope) for p in call.parameters]
+                    agg = build_aggregator(call.name, args)
+                    self.aggregators.append(agg)
+                    agg_types[f"a{i}"] = agg.type
+                inner.add_stream(_AGG_REF, agg_types)  # refresh
+            self.having = compile_expression(lifted_h, hav_scope)
+            if self.having.type is not AttrType.BOOL:
+                raise SiddhiAppCreationError("having must be a boolean expression")
+
+    def init_state(self):
+        return [a.init() for a in self.aggregators]
+
+    def apply(self, state, flow: Flow):
+        env = flow.env()
+        info = FlowInfo(
+            sign=flow.sign,
+            active=flow.current,
+            reset=flow.reset,
+            member=flow.member,
+            member_env=flow.member_env,
+        )
+        new_state = []
+        agg_cols: dict = {}
+        for i, agg in enumerate(self.aggregators):
+            s, col = agg.apply(state[i], info, env)
+            new_state.append(s)
+            agg_cols[(_AGG_REF, None, f"a{i}")] = col
+        env2 = Env({**env.columns, **agg_cols}, now=flow.now)
+
+        out_cols = {}
+        out_col_keys = {}
+        for name, cexpr in self.projections:
+            col = cexpr(env2)
+            col = jnp.broadcast_to(col, flow.batch.valid.shape)
+            out_cols[name] = col
+            out_col_keys[("__out__", None, name)] = col
+
+        valid = flow.batch.valid & (
+            (flow.batch.kind == KIND_CURRENT) | (flow.batch.kind == KIND_EXPIRED)
+        )
+        if self.having is not None:
+            env3 = Env({**env2.columns, **out_col_keys}, now=flow.now)
+            valid = valid & self.having(env3)
+
+        out = EventBatch(
+            ts=flow.batch.ts, kind=flow.batch.kind, valid=valid, cols=out_cols
+        )
+        return new_state, out

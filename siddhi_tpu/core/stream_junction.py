@@ -1,0 +1,103 @@
+"""Stream junctions and input handlers — host-side event routing.
+
+Reference: stream/StreamJunction.java:58-404 (per-stream pub/sub fan-out) and
+stream/input/InputManager.java / InputHandler.java. The device does all per-event
+math; the junction packs host events into fixed-capacity columnar micro-batches
+and fans them out to subscriber step functions. Synchronous dispatch mirrors the
+reference's default pass-through mode; @async batching rides the same path via
+send_batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from siddhi_tpu.core.event import EventBatch, StreamSchema
+from siddhi_tpu.core.types import InternTable
+
+# subscriber: fn(batch: EventBatch, now_ms: int) -> None
+Subscriber = Callable[[EventBatch, int], None]
+
+
+class StreamJunction:
+    def __init__(
+        self,
+        schema: StreamSchema,
+        interner: InternTable,
+        batch_size: int = 64,
+    ):
+        self.schema = schema
+        self.interner = interner
+        self.batch_size = batch_size
+        self.subscribers: list[Subscriber] = []
+        self.stream_callbacks: list[Callable] = []
+        self.lock = threading.Lock()
+        self.on_publish_stats: Callable[[int], None] | None = None
+
+    def subscribe(self, fn: Subscriber) -> None:
+        self.subscribers.append(fn)
+
+    def add_stream_callback(self, fn: Callable) -> None:
+        self.stream_callbacks.append(fn)
+
+    # ---- publishing ------------------------------------------------------
+
+    def publish_batch(self, batch: EventBatch, now: int) -> None:
+        """Fan a device batch out to all subscribers (already this stream's schema)."""
+        with self.lock:
+            if self.on_publish_stats is not None:
+                self.on_publish_stats(int(np.asarray(batch.valid).sum()))
+            for fn in self.subscribers:
+                fn(batch, now)
+            if self.stream_callbacks:
+                events = self.schema.from_batch(batch, self.interner)
+                if events:
+                    rows = [(ts, data) for ts, kind, data in events]
+                    for cb in self.stream_callbacks:
+                        cb(rows)
+
+    def send_rows(
+        self,
+        timestamps: Sequence[int],
+        rows: Sequence[Sequence[Any]],
+        now: int | None = None,
+    ) -> None:
+        """Pack host rows and publish, chunking to the junction batch size."""
+        n = len(rows)
+        for ofs in range(0, max(n, 1), self.batch_size):
+            ts_chunk = list(timestamps[ofs : ofs + self.batch_size])
+            row_chunk = list(rows[ofs : ofs + self.batch_size])
+            if not row_chunk:
+                return
+            batch = self.schema.to_batch(
+                ts_chunk, row_chunk, self.interner, capacity=self.batch_size
+            )
+            self.publish_batch(batch, now if now is not None else (ts_chunk[-1] if ts_chunk else 0))
+
+
+class InputHandler:
+    """Reference: stream/input/InputHandler.java:27-68."""
+
+    def __init__(self, junction: StreamJunction, clock: Callable[[], int]):
+        self.junction = junction
+        self.clock = clock
+
+    def send(self, data: Sequence[Any], timestamp: int | None = None) -> None:
+        ts = timestamp if timestamp is not None else self.clock()
+        self.junction.send_rows([ts], [tuple(data)], now=self.clock())
+
+    def send_many(
+        self, rows: Sequence[Sequence[Any]], timestamps: Sequence[int] | None = None
+    ) -> None:
+        if timestamps is None:
+            t = self.clock()
+            timestamps = [t] * len(rows)
+        self.junction.send_rows(list(timestamps), [tuple(r) for r in rows], now=self.clock())
+
+
+def system_clock_ms() -> int:
+    return int(time.time() * 1000)

@@ -1,0 +1,85 @@
+"""Reset-aware running (prefix) reductions over a batch.
+
+The reference updates aggregator state one event at a time, emitting the running
+value after each event and zeroing state on RESET events
+(reference: query/selector/attribute/aggregator/*.java — add/remove on
+CURRENT/EXPIRED, reset on RESET). Batched on TPU, the per-event running values
+become prefix reductions with reset barriers. For the (small, padded) batch axis
+we use an O(B^2) lower-triangular mask formulation: it is one matmul / masked
+reduction, which the MXU/VPU eat for B <= ~1024, and it keeps everything static.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def last_reset_index(reset: jnp.ndarray) -> jnp.ndarray:
+    """For each position i, the largest j <= i with reset[j], else -1. [B] int32."""
+    idx = jnp.arange(reset.shape[-1], dtype=jnp.int32)
+    marked = jnp.where(reset, idx, jnp.int32(-1))
+    return jnp.maximum.accumulate(marked)
+
+
+def window_mask(reset: jnp.ndarray) -> jnp.ndarray:
+    """[B, B] bool: M[i, j] True iff event j contributes to the running value at
+    i — j <= i and j strictly after the last reset at or before i."""
+    idx = jnp.arange(reset.shape[-1], dtype=jnp.int32)
+    lr = last_reset_index(reset)
+    return (idx[None, :] <= idx[:, None]) & (idx[None, :] > lr[:, None])
+
+
+def running_sum(
+    contrib: jnp.ndarray, reset: jnp.ndarray, base: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Running sum after each event with reset barriers.
+
+    contrib: [B] signed contributions (0 for invalid/timer rows)
+    reset:   [B] bool reset-event marks
+    base:    scalar carried sum from prior batches
+    returns: ([B] running values, scalar new carry)
+    """
+    m = window_mask(reset)
+    run = (jnp.where(m, contrib[None, :], 0)).sum(axis=-1)
+    no_reset_yet = last_reset_index(reset) < 0
+    run = run + jnp.where(no_reset_yet, base, jnp.zeros_like(base))
+    return run, run[-1]
+
+
+def running_extreme(
+    values: jnp.ndarray,
+    active: jnp.ndarray,
+    reset: jnp.ndarray,
+    base: jnp.ndarray,
+    is_min: bool,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Running min/max (no removal — forever semantics / non-windowed).
+
+    values: [B]; active: [B] bool (valid CURRENT rows); base: scalar carry
+    (identity = +/-inf or int extreme when nothing seen yet).
+    """
+    ident = extreme_identity(values.dtype, is_min)
+    m = window_mask(reset)
+    masked = jnp.where(m & active[None, :], values[None, :], ident)
+    red = masked.min(axis=-1) if is_min else masked.max(axis=-1)
+    base_eff = jnp.where(last_reset_index(reset) < 0, base, ident)
+    run = jnp.minimum(red, base_eff) if is_min else jnp.maximum(red, base_eff)
+    return run, run[-1]
+
+
+def extreme_identity(dtype, is_min: bool) -> jnp.ndarray:
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if is_min else info.min, dtype=dtype)
+
+
+def compact(valid: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable-compaction permutation: indices that move valid rows to the front.
+
+    returns (perm [B] int32, count scalar int32). Gather with `perm` then mask
+    rows >= count.
+    """
+    perm = jnp.argsort(~valid, stable=True).astype(jnp.int32)
+    count = valid.sum(dtype=jnp.int32)
+    return perm, count
