@@ -51,6 +51,9 @@ class SiddhiAppRuntime:
         self.clock = system_clock_ms
         self._running = False
         self._lock = threading.RLock()
+        from siddhi_tpu.core.scheduler import SystemTimeScheduler
+
+        self._scheduler = SystemTimeScheduler()
 
         self.stream_schemas: dict[str, StreamSchema] = {}
         self.junctions: dict[str, StreamJunction] = {}
@@ -127,13 +130,39 @@ class SiddhiAppRuntime:
         in_junction = self._junction(stream.stream_id)
 
         def receive(batch: EventBatch, now: int, _qr=qr) -> None:
-            out_batch = _qr.receive(batch, now)
+            out_batch, aux = _qr.receive(batch, now)
             _qr.route_output(out_batch, now, decode)
+            self._maybe_schedule(_qr, aux)
 
         in_junction.subscribe(receive)
 
+        if qr.needs_scheduler:
+            def fire(t_ms: int, _qr=qr, _schema=in_schema) -> None:
+                nulls = tuple(None for _ in _schema.attrs)
+                from siddhi_tpu.core.event import KIND_TIMER
+
+                batch = _schema.to_batch(
+                    [t_ms], [nulls], self.interner,
+                    capacity=self.batch_size, kinds=[KIND_TIMER],
+                )
+                out_batch, aux = _qr.receive(batch, t_ms)
+                _qr.route_output(out_batch, t_ms, decode)
+                self._maybe_schedule(_qr, aux)
+
+            qr.timer_target = fire
+
     def _decode(self, schema: StreamSchema, batch: EventBatch):
         return schema.from_batch(batch, self.interner)
+
+    def _maybe_schedule(self, qr: QueryRuntime, aux: dict) -> None:
+        if not qr.needs_scheduler or "next_timer" not in aux:
+            return
+        from siddhi_tpu.core.windows import NO_TIMER
+
+        t = int(aux["next_timer"])
+        if t < int(NO_TIMER):
+            self._scheduler.start()
+            self._scheduler.notify_at(t, qr.timer_target)
 
     # ---- public API (reference: SiddhiAppRuntime callbacks/handlers) -----
 
@@ -172,6 +201,7 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         self._running = False
+        self._scheduler.shutdown()
 
     def persist(self):  # M11
         raise NotImplementedError("persistence lands in M11")

@@ -31,6 +31,8 @@ class Flow:
     extra_cols: dict[VarKey, jnp.ndarray] = dataclasses.field(default_factory=dict)
     member: Optional[jnp.ndarray] = None
     member_env: Optional[Env] = None
+    # device scalars surfaced to the host after the step (e.g. next_timer)
+    aux: dict = dataclasses.field(default_factory=dict)
 
     def env(self) -> Env:
         cols: dict[VarKey, jnp.ndarray] = {

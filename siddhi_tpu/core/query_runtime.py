@@ -9,6 +9,7 @@ owns the device state and the host-side output routing.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Optional
 
 import jax
@@ -98,7 +99,8 @@ class CompiledSingleChain:
         valid = flow.batch.valid & (is_timer | mask)
         batch = EventBatch(flow.batch.ts, flow.batch.kind, valid, flow.batch.cols)
         return Flow(
-            batch, flow.ref, flow.now, flow.extra_cols, flow.member, flow.member_env
+            batch, flow.ref, flow.now, flow.extra_cols, flow.member,
+            flow.member_env, flow.aux,
         )
 
 
@@ -126,6 +128,12 @@ class QueryRuntime:
             scope.add_stream(in_schema.stream_id, in_schema.attr_types)
         scope.default_ref = self.ref
 
+        if window_factory is None:
+            from siddhi_tpu.core.windows import make_window
+
+            def window_factory(spec, schema, ref, _scope=scope):
+                return make_window(spec, schema, ref, _scope)
+
         self.chain = CompiledSingleChain(stream, in_schema, scope, window_factory)
         self.selector = CompiledSelector(query.selector, scope, in_schema.attrs)
 
@@ -140,8 +148,12 @@ class QueryRuntime:
         # host-side sinks wired by the app runtime
         self.query_callbacks: list[Callable] = []
         self.publish_fn: Optional[Callable] = None
+        self.needs_scheduler = (
+            self.chain.window is not None and self.chain.window.needs_scheduler
+        )
 
         self._step = jax.jit(self._step_impl)
+        self._receive_lock = threading.RLock()
         self.state = None
 
     # ---- device program --------------------------------------------------
@@ -153,15 +165,18 @@ class QueryRuntime:
         flow = Flow(batch=batch, ref=self.ref, now=now)
         chain_state, flow = self.chain.apply(state["chain"], flow)
         sel_state, out = self.selector.apply(state["sel"], flow)
-        return {"chain": chain_state, "sel": sel_state}, out
+        return {"chain": chain_state, "sel": sel_state}, out, flow.aux
 
     # ---- host side -------------------------------------------------------
 
-    def receive(self, batch: EventBatch, now: int) -> EventBatch:
-        if self.state is None:
-            self.state = self.init_state()
-        self.state, out = self._step(self.state, batch, jnp.asarray(now, dtype=jnp.int64))
-        return out
+    def receive(self, batch: EventBatch, now: int) -> tuple[EventBatch, dict]:
+        with self._receive_lock:
+            if self.state is None:
+                self.state = self.init_state()
+            self.state, out, aux = self._step(
+                self.state, batch, jnp.asarray(now, dtype=jnp.int64)
+            )
+        return out, aux
 
     def route_output(self, out: EventBatch, now: int, decode) -> None:
         """Dispatch a step's output to query callbacks / downstream junction.

@@ -35,7 +35,9 @@ class StreamJunction:
         self.batch_size = batch_size
         self.subscribers: list[Subscriber] = []
         self.stream_callbacks: list[Callable] = []
-        self.lock = threading.Lock()
+        # RLock: a query may legally insert into its own input stream
+        # (reference allows self-feeding junctions); recursion stays on-thread
+        self.lock = threading.RLock()
         self.on_publish_stats: Callable[[int], None] | None = None
 
     def subscribe(self, fn: Subscriber) -> None:

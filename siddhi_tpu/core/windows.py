@@ -1,0 +1,593 @@
+"""Window processors — device-resident ring buffers with batched emission.
+
+Reference: query/processor/stream/window/*.java (17 built-ins). The reference
+mutates per-event queues inside synchronized blocks; here each window is a pure
+stage over the Flow with a fixed-capacity slot-indexed ring as carried state, and
+the interleaved CURRENT/EXPIRED/RESET emission order of the reference is
+reproduced by assigning every candidate output event a sort key
+(trigger_row, kind, seq) and lexsorting — one vectorized program, no per-event
+control flow.
+
+Emission-order contracts reproduced (validated against the reference sources):
+- length: per arrival when full, evictee EXPIRED emitted before the CURRENT
+  (LengthWindowProcessor.java:102-138 insertBeforeCurrent)
+- time/externalTime: all due EXPIREDs flush before the triggering CURRENT
+  (TimeWindowProcessor.java:79+)
+- lengthBatch/timeBatch: on flush, prev-batch EXPIREDs, then RESET, then the
+  bucket's CURRENTs (LengthBatchWindowProcessor.java:108-160)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import (
+    EventBatch,
+    KIND_CURRENT,
+    KIND_EXPIRED,
+    KIND_RESET,
+    KIND_TIMER,
+    StreamSchema,
+)
+from siddhi_tpu.core.executor import Env, Scope, TS_ATTR, compile_expression
+from siddhi_tpu.core.flow import Flow
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.query_api.definition import WindowSpec
+from siddhi_tpu.query_api.expression import Constant
+
+BIG = jnp.iinfo(jnp.int32).max
+NO_TIMER = jnp.iinfo(jnp.int64).max
+
+DEFAULT_TIME_CAPACITY = 1024
+
+
+def _const_param(spec: WindowSpec, i: int, what: str) -> int:
+    if i >= len(spec.parameters) or not isinstance(spec.parameters[i], Constant):
+        raise SiddhiAppCreationError(f"window {spec.name}: parameter {i} must be a constant {what}")
+    return int(spec.parameters[i].value)
+
+
+class WindowStage:
+    """Base: (state, Flow) -> (state', Flow') with out-capacity growth."""
+
+    needs_scheduler = False
+
+    def init_state(self):
+        raise NotImplementedError
+
+    def apply(self, state, flow: Flow):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# sliding family: length / time / timeLength / externalTime / delay
+# ---------------------------------------------------------------------------
+
+
+class SlidingWindow(WindowStage):
+    """Generic ring: capacity W (always length-evicts at W) plus optional time
+    predicate over a per-event 'window time' (event ts, or an attribute for
+    externalTime). Covers length(N) [W=N], time(T), timeLength(T, N),
+    externalTime(tsAttr, T).
+
+    Overflow policy for time windows: if more than W events are simultaneously
+    live, the oldest are evicted EARLY — they are still emitted as EXPIRED (the
+    capacity eviction rides the same candidate path), so downstream aggregates
+    stay exactly consistent; only the expiry *time* is early. The reference has
+    no such bound (unbounded Java queues); raise DEFAULT_TIME_CAPACITY or the
+    per-window capacity if early expiry is observed."""
+
+    def __init__(
+        self,
+        schema: StreamSchema,
+        ref: str,
+        capacity: int,
+        duration_ms: Optional[int] = None,
+        time_attr: Optional[str] = None,
+        use_scheduler: bool = False,
+    ):
+        self.schema = schema
+        self.ref = ref
+        self.w = int(capacity)
+        self.t = duration_ms
+        self.time_attr = time_attr
+        self.needs_scheduler = use_scheduler
+
+    def init_state(self):
+        w = self.w
+        return {
+            "cols": {n: jnp.zeros((w,), a.dtype) for n, a in self.schema.empty_batch(1).cols.items()},
+            "ts": jnp.zeros((w,), jnp.int64),
+            "wts": jnp.zeros((w,), jnp.int64),
+            "seq": jnp.full((w,), -1, jnp.int64),
+            "total": jnp.zeros((), jnp.int64),
+        }
+
+    def apply(self, state, flow: Flow):
+        b = flow.batch
+        bsz = b.capacity
+        w = self.w
+        k = w + bsz
+        total = state["total"]
+
+        valid_cur = b.valid & (b.kind == KIND_CURRENT)
+        is_timer = b.valid & (b.kind == KIND_TIMER)
+        # window-time of each batch row
+        if self.time_attr is not None:
+            bwts = b.cols[self.time_attr].astype(jnp.int64)
+        else:
+            bwts = b.ts
+        rank = jnp.cumsum(valid_cur) - valid_cur.astype(jnp.int32)
+        c = valid_cur.sum(dtype=jnp.int32)
+        seq_batch = jnp.where(valid_cur, total + rank, jnp.int64(-1))
+
+        # element view: ring slots then batch rows
+        elem_ts = jnp.concatenate([state["ts"], b.ts])
+        elem_wts = jnp.concatenate([state["wts"], bwts])
+        elem_seq = jnp.concatenate([state["seq"], seq_batch])
+        elem_cols = {
+            n: jnp.concatenate([state["cols"][n], b.cols[n]]) for n in b.cols
+        }
+        present = elem_seq >= 0
+        own_row = jnp.concatenate(
+            [jnp.full((w,), -1, jnp.int32), jnp.arange(bsz, dtype=jnp.int32)]
+        )
+
+        # --- eviction triggers ---
+        # capacity/length: evicted by the insertion of seq_e + W
+        trig_rank = (elem_seq + w - total).astype(jnp.int32)
+        len_trig_valid = present & (trig_rank >= 0) & (trig_rank < c)
+        perm = jnp.argsort(~valid_cur, stable=True).astype(jnp.int32)  # rank -> row
+        trig_row_len = jnp.where(
+            len_trig_valid, perm[jnp.clip(trig_rank, 0, bsz - 1)], BIG
+        )
+
+        if self.t is not None:
+            trigger_ok = valid_cur | is_timer
+            due = (
+                trigger_ok[None, :]
+                & present[:, None]
+                & (bwts[None, :] - elem_wts[:, None] >= self.t)
+                & (jnp.arange(bsz, dtype=jnp.int32)[None, :] >= own_row[:, None])
+            )
+            has_time_trig = due.any(axis=1)
+            trig_row_time = jnp.where(has_time_trig, jnp.argmax(due, axis=1).astype(jnp.int32), BIG)
+        else:
+            trig_row_time = jnp.full((k,), BIG, jnp.int32)
+
+        trig_row = jnp.minimum(trig_row_len, trig_row_time)
+        evict = present & (trig_row < BIG)
+
+        # --- candidate assembly: K expired + B current candidates ---
+        death_key = jnp.where(evict, trig_row * 2, BIG)
+        birth_key = jnp.where(own_row >= 0, own_row * 2 + 1, -1)
+
+        cand_key = jnp.concatenate(
+            [death_key, jnp.where(valid_cur, jnp.arange(bsz, dtype=jnp.int32) * 2 + 1, BIG)]
+        )
+        cand_elem = jnp.concatenate(
+            [jnp.arange(k, dtype=jnp.int32), jnp.arange(w, k, dtype=jnp.int32)]
+        )
+        cand_is_exp = jnp.concatenate(
+            [jnp.ones((k,), bool), jnp.zeros((bsz,), bool)]
+        )
+        cand_valid = jnp.concatenate([evict, valid_cur])
+        cand_seq = elem_seq[cand_elem]
+
+        order = jnp.lexsort((cand_seq, jnp.where(cand_valid, cand_key, BIG)))
+        out_n = k + bsz
+        o_elem = cand_elem[order]
+        o_exp = cand_is_exp[order]
+        o_valid = cand_valid[order]
+        o_key = jnp.where(o_valid, cand_key[order], BIG)
+
+        trigger_ts = b.ts  # trigger row's event ts stands in for "currentTime"
+        o_trig_row = jnp.clip(o_key // 2, 0, bsz - 1)
+        out = EventBatch(
+            ts=jnp.where(o_exp, trigger_ts[o_trig_row], elem_ts[o_elem]),
+            kind=jnp.where(o_exp, jnp.int8(KIND_EXPIRED), jnp.int8(KIND_CURRENT)),
+            valid=o_valid,
+            cols={n: elem_cols[n][o_elem] for n in elem_cols},
+        )
+
+        # --- membership matrix for exact min/max/distinct ---
+        # position-based: element is "in the window" from its CURRENT output row
+        # (ring elements: from the start) until its EXPIRED output row, which
+        # reproduces the reference's one-by-one add/remove ordering exactly.
+        inv = jnp.argsort(order)  # candidate index -> sorted output position
+        birth_pos = jnp.where(
+            own_row >= 0, inv[k + jnp.clip(own_row, 0, bsz - 1)], jnp.int32(-1)
+        )
+        death_pos = jnp.where(evict, inv[jnp.arange(k)], BIG)
+        alive_src = present
+        pos_row = jnp.arange(k + bsz)
+        member = (
+            alive_src[None, :]
+            & (birth_pos[None, :] <= pos_row[:, None])
+            & (pos_row[:, None] < death_pos[None, :])
+        )
+        member_cols = {
+            (self.ref, None, n): elem_cols[n] for n in elem_cols
+        }
+        member_cols[(self.ref, None, TS_ATTR)] = elem_ts
+        member_env = Env(member_cols, now=flow.now)
+
+        # --- new ring state ---
+        # rows already evicted within this batch (time-expired before the batch
+        # ended) must NOT be re-inserted, or they would expire a second time
+        ring_evicted = evict[:w]
+        batch_evicted = evict[w:]
+        insert = valid_cur & ~batch_evicted & (rank >= c - w)
+        slots = jnp.where(insert, (total + rank) % w, jnp.int64(w)).astype(jnp.int32)
+        new_seq = jnp.where(ring_evicted, jnp.int64(-1), state["seq"])
+        new_state = {
+            "cols": {
+                n: _place_ring(state["cols"][n], ring_evicted, slots, b.cols[n])
+                for n in b.cols
+            },
+            "ts": _place_ring(state["ts"], ring_evicted, slots, b.ts),
+            "wts": _place_ring(state["wts"], ring_evicted, slots, bwts),
+            "seq": new_seq.at[slots].set(seq_batch, mode="drop"),
+            "total": total + c,
+        }
+
+        aux = dict(flow.aux)
+        if self.needs_scheduler and self.t is not None:
+            surv_wts = jnp.where(new_state["seq"] >= 0, new_state["wts"], NO_TIMER - self.t)
+            aux["next_timer"] = surv_wts.min() + self.t
+
+        return new_state, Flow(
+            batch=out,
+            ref=flow.ref,
+            now=flow.now,
+            extra_cols={},
+            member=member,
+            member_env=member_env,
+            aux=aux,
+        )
+
+
+def _place_ring(old, evicted, slots, vals):
+    return jnp.where(evicted, 0, old).at[slots].set(vals, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# batch (tumbling) family: lengthBatch / timeBatch / externalTimeBatch
+# ---------------------------------------------------------------------------
+
+
+class BatchWindow(WindowStage):
+    """Tumbling buckets. Flush every `length` events (lengthBatch) or at each
+    `duration` boundary of the window-time (timeBatch / externalTimeBatch).
+    On flush the reference emits: prev-bucket EXPIREDs, RESET, then the closing
+    bucket's CURRENTs (LengthBatchWindowProcessor.java:108-160); sort keys
+    (trigger_row*4 + {0 expired, 1 reset, 2 current}) reproduce that order.
+
+    State invariant: the open bucket holds < flush size (cur_n < n for
+    lengthBatch); `prev` holds the last flushed bucket awaiting expiry.
+    """
+
+    def __init__(
+        self,
+        schema: StreamSchema,
+        ref: str,
+        capacity: int,
+        length: Optional[int] = None,
+        duration_ms: Optional[int] = None,
+        time_attr: Optional[str] = None,
+        use_scheduler: bool = False,
+        start_time: Optional[int] = None,
+    ):
+        if (length is None) == (duration_ms is None):
+            raise SiddhiAppCreationError("batch window needs length xor duration")
+        self.schema = schema
+        self.ref = ref
+        self.w = int(capacity)
+        self.n = length
+        self.t = duration_ms
+        self.time_attr = time_attr
+        self.needs_scheduler = use_scheduler
+        self.start_time = start_time
+
+    def init_state(self):
+        w = self.w
+        zero_cols = {
+            n: jnp.zeros((w,), a.dtype)
+            for n, a in self.schema.empty_batch(1).cols.items()
+        }
+        return {
+            "cur_cols": zero_cols,
+            "cur_ts": jnp.zeros((w,), jnp.int64),
+            "cur_n": jnp.zeros((), jnp.int32),
+            "prev_cols": {n: jnp.zeros_like(a) for n, a in zero_cols.items()},
+            "prev_ts": jnp.zeros((w,), jnp.int64),
+            "prev_n": jnp.zeros((), jnp.int32),
+            # open-bucket start time (timeBatch family); -1 = no bucket yet
+            "bucket_start": jnp.full((), -1, jnp.int64),
+        }
+
+    def apply(self, state, flow: Flow):
+        b = flow.batch
+        bsz = b.capacity
+        w = self.w
+        rows = jnp.arange(bsz, dtype=jnp.int32)
+        valid_cur = b.valid & (b.kind == KIND_CURRENT)
+        is_timer = b.valid & (b.kind == KIND_TIMER)
+        bwts = (
+            b.cols[self.time_attr].astype(jnp.int64)
+            if self.time_attr is not None
+            else b.ts
+        )
+        rank = jnp.cumsum(valid_cur) - valid_cur.astype(jnp.int32)
+        c = valid_cur.sum(dtype=jnp.int32)
+        perm = jnp.argsort(~valid_cur, stable=True).astype(jnp.int32)  # rank -> row
+        cur_n0 = state["cur_n"]
+
+        new_bucket_start = state["bucket_start"]
+        if self.n is not None:
+            # --- lengthBatch: flush f triggers at the row completing (f+1)*n ---
+            n = self.n
+            pos = cur_n0 + rank  # fill position of each current row
+            e_row = pos // n  # flush index at which the row's bucket closes
+            n_flush = (cur_n0 + c) // n
+            f_arr = rows
+            trig_rank_f = (f_arr + 1) * n - 1 - cur_n0
+            flush_exists = (trig_rank_f >= 0) & (trig_rank_f < c)
+            row_of_flush = jnp.where(
+                flush_exists, perm[jnp.clip(trig_rank_f, 0, bsz - 1)], bsz - 1
+            )
+        else:
+            # --- timeBatch: flush when a trigger row enters a later bucket ---
+            trigger_ok = valid_cur | is_timer
+            if self.start_time is not None:
+                start0 = jnp.int64(self.start_time)
+            else:
+                first_trig = jnp.argmax(trigger_ok)
+                start0 = jnp.where(
+                    state["bucket_start"] >= 0,
+                    state["bucket_start"],
+                    jnp.where(trigger_ok.any(), bwts[first_trig], jnp.int64(-1)),
+                )
+            rel = jnp.maximum(bwts - start0, 0)
+            g = jnp.where(trigger_ok & (start0 >= 0), rel // self.t, jnp.int64(0))
+            open_g = jax.lax.associative_scan(jnp.maximum, g)
+            prev_open = jnp.concatenate([jnp.zeros((1,), jnp.int64), open_g[:-1]])
+            had_bucket = (state["bucket_start"] >= 0) | (
+                jnp.cumsum(trigger_ok) - trigger_ok.astype(jnp.int32) > 0
+            )
+            flush_here = trigger_ok & (g > prev_open) & had_bucket
+            e_row = jnp.cumsum(flush_here)  # inclusive: flush at i precedes row i
+            n_flush = flush_here.sum(dtype=jnp.int32)
+            row_of_flush = jnp.where(
+                rows < n_flush,
+                jnp.argsort(jnp.where(flush_here, rows, BIG)).astype(jnp.int32),
+                bsz - 1,
+            )
+            flush_exists = rows < n_flush
+            new_bucket_start = jnp.where(
+                trigger_ok.any() & (start0 >= 0), start0 + open_g[-1] * self.t, start0
+            )
+            e_row = jnp.where(valid_cur, e_row, 0)
+
+        any_flush = n_flush > 0
+
+        def flush_key(f, kindbit):
+            return row_of_flush[jnp.clip(f, 0, bsz - 1)] * 4 + kindbit
+
+        # --- candidates ---
+        # carried open bucket: CURRENT at flush 0, EXPIRED at flush 1
+        cw = jnp.arange(w, dtype=jnp.int32)
+        carried_valid = cw < cur_n0
+        cc_cur_key = jnp.where(carried_valid & any_flush, flush_key(0, 2), BIG)
+        cc_exp_key = jnp.where(carried_valid & (n_flush > 1), flush_key(1, 0), BIG)
+        # prev bucket: EXPIRED at flush 0
+        prev_valid = cw < state["prev_n"]
+        pv_exp_key = jnp.where(prev_valid & any_flush, flush_key(0, 0), BIG)
+        # batch rows: CURRENT at their closing flush, EXPIRED one flush later
+        row_emit = valid_cur & (e_row < n_flush)
+        bt_cur_key = jnp.where(row_emit, flush_key(e_row.astype(jnp.int32), 2), BIG)
+        bt_exp_key = jnp.where(
+            row_emit & (e_row + 1 < n_flush), flush_key(e_row.astype(jnp.int32) + 1, 0), BIG
+        )
+        # resets: one per flush
+        rs_key = jnp.where(flush_exists, row_of_flush * 4 + 1, BIG)
+
+        # element table: [0,w) carried-cur, [w,2w) prev, [2w,2w+bsz) batch
+        elem_cols = {
+            nm: jnp.concatenate([state["cur_cols"][nm], state["prev_cols"][nm], b.cols[nm]])
+            for nm in b.cols
+        }
+        elem_ts = jnp.concatenate([state["cur_ts"], state["prev_ts"], b.ts])
+
+        cand_key = jnp.concatenate([cc_cur_key, cc_exp_key, pv_exp_key, bt_cur_key, bt_exp_key, rs_key])
+        cand_elem = jnp.concatenate([cw, cw, cw + w, rows + 2 * w, rows + 2 * w, jnp.zeros((bsz,), jnp.int32)])
+        cand_kind = jnp.concatenate(
+            [
+                jnp.full((w,), KIND_CURRENT, jnp.int8),
+                jnp.full((w,), KIND_EXPIRED, jnp.int8),
+                jnp.full((w,), KIND_EXPIRED, jnp.int8),
+                jnp.full((bsz,), KIND_CURRENT, jnp.int8),
+                jnp.full((bsz,), KIND_EXPIRED, jnp.int8),
+                jnp.full((bsz,), KIND_RESET, jnp.int8),
+            ]
+        )
+        cand_valid = cand_key < BIG
+        tie = jnp.concatenate([cw, cw, cw, rows + w, rows + w, rows])
+        order = jnp.lexsort((tie, jnp.where(cand_valid, cand_key, BIG)))
+
+        o_elem = cand_elem[order]
+        o_kind = cand_kind[order]
+        o_valid = cand_valid[order]
+        o_key = jnp.where(o_valid, cand_key[order], BIG)
+        trig_ts = b.ts[jnp.clip(o_key // 4, 0, bsz - 1)]
+        out = EventBatch(
+            ts=jnp.where(o_kind == KIND_EXPIRED, trig_ts, elem_ts[o_elem]),
+            kind=o_kind,
+            valid=o_valid,
+            cols={nm: elem_cols[nm][o_elem] for nm in elem_cols},
+        )
+
+        # --- membership (bucket contents; position-based, see SlidingWindow) ---
+        # An element is a member from its CURRENT output row until its bucket's
+        # RESET row. Prev-bucket elements are never members (the reference's
+        # aggregator deque was already cleared by that bucket's RESET; its
+        # later EXPIRED events remove from an empty deque — a no-op).
+        inv = jnp.argsort(order)  # candidate index -> sorted output position
+        ncand = cand_key.shape[0]
+        rs_base = 3 * w + 2 * bsz
+        birth_cc = jnp.where(carried_valid & any_flush, inv[cw], BIG)
+        death_cc = jnp.where(carried_valid & any_flush, inv[rs_base + 0], BIG)
+        birth_bt = jnp.where(row_emit, inv[3 * w + rows], BIG)
+        death_bt = jnp.where(
+            row_emit, inv[rs_base + jnp.clip(e_row.astype(jnp.int32), 0, bsz - 1)], BIG
+        )
+        e_birth = jnp.concatenate([birth_cc, jnp.full((w,), BIG, jnp.int32), birth_bt])
+        e_death = jnp.concatenate([death_cc, jnp.full((w,), -1, jnp.int32), death_bt])
+        e_alive = jnp.concatenate([carried_valid & any_flush, jnp.zeros((w,), bool), row_emit])
+        pos_row = jnp.arange(ncand)
+        member = (
+            e_alive[None, :]
+            & (e_birth[None, :] <= pos_row[:, None])
+            & (pos_row[:, None] < e_death[None, :])
+        )
+        member_cols = {(self.ref, None, nm): elem_cols[nm] for nm in elem_cols}
+        member_cols[(self.ref, None, TS_ATTR)] = elem_ts
+        member_env = Env(member_cols, now=flow.now)
+
+        # --- new buffers ---
+        # open bucket: elements whose bucket index == n_flush (not yet closed)
+        remaining = valid_cur & (e_row == n_flush)
+        keep_carried = ~any_flush  # carried stays only if nothing flushed
+        if self.n is not None:
+            rem_slot = jnp.where(remaining, pos - n_flush * self.n, w)
+        else:
+            rem_rank = jnp.cumsum(remaining) - remaining.astype(jnp.int32)
+            rem_slot = jnp.where(
+                remaining, rem_rank + jnp.where(keep_carried, cur_n0, 0), w
+            )
+        rem_slot = rem_slot.astype(jnp.int32)
+
+        def place_cur(old, vals):
+            kept = jnp.where(keep_carried, old, jnp.zeros_like(old))
+            return kept.at[rem_slot].set(vals, mode="drop")
+
+        new_cur_n = jnp.where(keep_carried, cur_n0, 0) + remaining.sum(dtype=jnp.int32)
+
+        # prev bucket: last flushed bucket (carried if it closed last, + rows)
+        in_last = row_emit & (e_row == n_flush - 1)
+        carried_in_last = carried_valid & (n_flush == 1)
+        n_carried_last = jnp.where(n_flush == 1, cur_n0, 0)
+        lb_rank = jnp.cumsum(in_last) - in_last.astype(jnp.int32)
+        lb_slot_c = jnp.where(carried_in_last, cw, w).astype(jnp.int32)
+        lb_slot_b = jnp.where(in_last, n_carried_last + lb_rank, w).astype(jnp.int32)
+
+        def place_prev(old_prev, carried_vals, batch_vals):
+            base = jnp.where(any_flush, jnp.zeros_like(old_prev), old_prev)
+            base = base.at[lb_slot_c].set(carried_vals, mode="drop")
+            return base.at[lb_slot_b].set(batch_vals, mode="drop")
+
+        new_prev_n = jnp.where(
+            any_flush, n_carried_last + in_last.sum(dtype=jnp.int32), state["prev_n"]
+        )
+
+        new_state = {
+            "cur_cols": {nm: place_cur(state["cur_cols"][nm], b.cols[nm]) for nm in b.cols},
+            "cur_ts": place_cur(state["cur_ts"], b.ts),
+            "cur_n": new_cur_n,
+            "prev_cols": {
+                nm: place_prev(state["prev_cols"][nm], state["cur_cols"][nm], b.cols[nm])
+                for nm in b.cols
+            },
+            "prev_ts": place_prev(state["prev_ts"], state["cur_ts"], b.ts),
+            "prev_n": new_prev_n,
+            "bucket_start": new_bucket_start,
+        }
+
+        aux = dict(flow.aux)
+        if self.needs_scheduler and self.t is not None:
+            aux["next_timer"] = jnp.where(
+                new_state["bucket_start"] >= 0,
+                new_state["bucket_start"] + self.t,
+                jnp.int64(NO_TIMER),
+            )
+
+        return new_state, Flow(
+            batch=out,
+            ref=flow.ref,
+            now=flow.now,
+            extra_cols={},
+            member=member,
+            member_env=member_env,
+            aux=aux,
+        )
+
+
+# ---------------------------------------------------------------------------
+# factory
+# ---------------------------------------------------------------------------
+
+
+def make_window(
+    spec: WindowSpec,
+    schema: StreamSchema,
+    ref: str,
+    scope: Scope,
+    time_capacity: int = DEFAULT_TIME_CAPACITY,
+) -> WindowStage:
+    """Reference: SingleInputStreamParser.generateProcessor window dispatch."""
+    name = spec.name.lower() if spec.namespace is None else f"{spec.namespace}:{spec.name}"
+    if name == "length":
+        n = _const_param(spec, 0, "length")
+        return SlidingWindow(schema, ref, capacity=n)
+    if name == "time":
+        t = _const_param(spec, 0, "duration")
+        return SlidingWindow(
+            schema, ref, capacity=time_capacity, duration_ms=t, use_scheduler=True
+        )
+    if name == "timelength":
+        t = _const_param(spec, 0, "duration")
+        n = _const_param(spec, 1, "length")
+        return SlidingWindow(
+            schema, ref, capacity=n, duration_ms=t, use_scheduler=True
+        )
+    if name == "externaltime":
+        attr = _time_attr(spec, 0, schema)
+        t = _const_param(spec, 1, "duration")
+        return SlidingWindow(
+            schema, ref, capacity=time_capacity, duration_ms=t, time_attr=attr
+        )
+    if name == "lengthbatch":
+        n = _const_param(spec, 0, "length")
+        return BatchWindow(schema, ref, capacity=n, length=n)
+    if name == "timebatch":
+        t = _const_param(spec, 0, "duration")
+        start = _const_param(spec, 1, "start time") if len(spec.parameters) > 1 else None
+        return BatchWindow(
+            schema, ref, capacity=time_capacity, duration_ms=t,
+            use_scheduler=True, start_time=start,
+        )
+    if name == "externaltimebatch":
+        attr = _time_attr(spec, 0, schema)
+        t = _const_param(spec, 1, "duration")
+        start = _const_param(spec, 2, "start time") if len(spec.parameters) > 2 else None
+        return BatchWindow(
+            schema, ref, capacity=time_capacity, duration_ms=t, time_attr=attr,
+            start_time=start,
+        )
+    raise SiddhiAppCreationError(f"unknown window type '{spec.name}'")
+
+
+def _time_attr(spec: WindowSpec, i: int, schema: StreamSchema) -> str:
+    from siddhi_tpu.query_api.expression import Variable
+
+    p = spec.parameters[i]
+    if not isinstance(p, Variable):
+        raise SiddhiAppCreationError(f"window {spec.name}: parameter {i} must be an attribute")
+    if schema.type_of(p.attribute) not in (AttrType.LONG, AttrType.INT):
+        raise SiddhiAppCreationError("external time attribute must be long")
+    return p.attribute

@@ -7,11 +7,19 @@ Multi-chip hardware is not available in CI; sharding tests run against a virtual
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't default: the environment pre-sets JAX_PLATFORMS=axon (the real
+# TPU tunnel); tests must run on the virtual 8-device CPU platform. The axon
+# site hook imports jax at interpreter startup, so the env var alone is read
+# too early — update the jax config explicitly as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
