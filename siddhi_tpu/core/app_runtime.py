@@ -130,8 +130,11 @@ class SiddhiAppRuntime:
         in_junction = self._junction(stream.stream_id)
 
         def receive(batch: EventBatch, now: int, _qr=qr) -> None:
-            out_batch, aux = _qr.receive(batch, now)
-            _qr.route_output(out_batch, now, decode)
+            # receive+route under one (reentrant) lock so concurrent timer and
+            # input threads deliver outputs in state-step order
+            with _qr._receive_lock:
+                out_batch, aux = _qr.receive(batch, now)
+                _qr.route_output(out_batch, now, decode)
             self._maybe_schedule(_qr, aux)
 
         in_junction.subscribe(receive)
@@ -145,8 +148,9 @@ class SiddhiAppRuntime:
                     [t_ms], [nulls], self.interner,
                     capacity=self.batch_size, kinds=[KIND_TIMER],
                 )
-                out_batch, aux = _qr.receive(batch, t_ms)
-                _qr.route_output(out_batch, t_ms, decode)
+                with _qr._receive_lock:
+                    out_batch, aux = _qr.receive(batch, t_ms)
+                    _qr.route_output(out_batch, t_ms, decode)
                 self._maybe_schedule(_qr, aux)
 
             qr.timer_target = fire

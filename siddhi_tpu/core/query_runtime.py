@@ -135,7 +135,12 @@ class QueryRuntime:
                 return make_window(spec, schema, ref, _scope)
 
         self.chain = CompiledSingleChain(stream, in_schema, scope, window_factory)
-        self.selector = CompiledSelector(query.selector, scope, in_schema.attrs)
+        self.selector = CompiledSelector(
+            query.selector,
+            scope,
+            in_schema.attrs,
+            batch_mode=self.chain.window is not None and self.chain.window.is_batch,
+        )
 
         out = query.output_stream
         if isinstance(out, InsertIntoStream):
@@ -155,6 +160,7 @@ class QueryRuntime:
         self._step = jax.jit(self._step_impl)
         self._receive_lock = threading.RLock()
         self.state = None
+        self._warned_overflow = False
 
     # ---- device program --------------------------------------------------
 
@@ -175,6 +181,21 @@ class QueryRuntime:
                 self.state = self.init_state()
             self.state, out, aux = self._step(
                 self.state, batch, jnp.asarray(now, dtype=jnp.int64)
+            )
+        if (
+            not self._warned_overflow
+            and "groupby_overflow" in aux
+            and bool(aux["groupby_overflow"])
+        ):
+            self._warned_overflow = True
+            import logging
+
+            logging.getLogger(__name__).error(
+                "query '%s': group-by slot table overflowed (capacity %d); "
+                "aggregates for colliding keys are unreliable — raise the "
+                "group capacity",
+                self.query_id,
+                self.selector.group.capacity if self.selector.group else -1,
             )
         return out, aux
 

@@ -25,9 +25,10 @@ class SystemTimeScheduler:
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
-        if self._thread is None:
-            self._thread = threading.Thread(target=self._run, daemon=True)
-            self._thread.start()
+        with self._cv:
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._run, daemon=True)
+                self._thread.start()
 
     def notify_at(self, t_ms: int, target: Callable[[int], None]) -> None:
         with self._cv:

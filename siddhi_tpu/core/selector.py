@@ -1,7 +1,9 @@
-"""Selector compilation: projection + aggregation + having (+ group-by in M5).
+"""Selector compilation: projection + aggregation + group-by + having +
+order-by/limit/offset.
 
 Reference: query/selector/QuerySelector.java:44-430 — attribute processors over
-each event, aggregator state mutation, having filter, then output. Here the
+each event, aggregator state mutation, group-by key via GroupByKeyGenerator,
+having filter, order-by/limit (OrderByEventComparator), then output. Here the
 whole selector is one vectorized transform over the Flow; aggregator calls inside
 selection expressions are lifted out, computed as running columns, and re-injected
 as synthetic attributes of a pseudo-stream "__agg__".
@@ -24,11 +26,13 @@ from siddhi_tpu.core.executor import (
     is_aggregator,
 )
 from siddhi_tpu.core.flow import Flow
+from siddhi_tpu.core.groupby import CompiledGroupBy
 from siddhi_tpu.core.types import AttrType
 from siddhi_tpu.query_api.execution import OutputAttribute, Selector
 from siddhi_tpu.query_api.expression import AttributeFunction, Expression, Variable
 
 _AGG_REF = "__agg__"
+_BIG = jnp.iinfo(jnp.int32).max
 
 
 def _lift_aggregators(expr: Expression, found: list[AttributeFunction]) -> Expression:
@@ -64,13 +68,20 @@ class CompiledSelector:
         selector: Selector,
         scope: Scope,
         input_attrs: list[tuple[str, AttrType]] | None = None,
+        batch_mode: bool = False,
     ):
         self.selector = selector
+        self.batch_mode = batch_mode
         sel_list = list(selector.selection_list)
         if selector.select_all:
             if input_attrs is None:
                 raise SiddhiAppCreationError("select * unsupported for this input")
             sel_list = [OutputAttribute(None, Variable(n)) for n, _ in input_attrs]
+
+        # group-by (reference: GroupByKeyGenerator over the input meta)
+        self.group: CompiledGroupBy | None = None
+        if selector.group_by:
+            self.group = CompiledGroupBy(selector.group_by, scope)
 
         # lift aggregator calls out of the selection expressions
         agg_calls: list[AttributeFunction] = []
@@ -79,7 +90,7 @@ class CompiledSelector:
         agg_types: dict[str, AttrType] = {}
         for i, call in enumerate(agg_calls):
             args = [compile_expression(p, scope) for p in call.parameters]
-            agg = build_aggregator(call.name, args)
+            agg = build_aggregator(call.name, args, group=self.group)
             self.aggregators.append(agg)
             agg_types[f"a{i}"] = agg.type
 
@@ -112,7 +123,7 @@ class CompiledSelector:
                 for i in range(len(self.aggregators), len(agg_calls)):
                     call = agg_calls[i]
                     args = [compile_expression(p, scope) for p in call.parameters]
-                    agg = build_aggregator(call.name, args)
+                    agg = build_aggregator(call.name, args, group=self.group)
                     self.aggregators.append(agg)
                     agg_types[f"a{i}"] = agg.type
                 inner.add_stream(_AGG_REF, agg_types)  # refresh
@@ -120,23 +131,55 @@ class CompiledSelector:
             if self.having.type is not AttrType.BOOL:
                 raise SiddhiAppCreationError("having must be a boolean expression")
 
+        # order-by: keys resolve against output attrs first, then input streams
+        # (reference: OrderByEventComparator over output stream attributes)
+        self.order_by: list[tuple[CompiledExpr, bool]] = []
+        for ob in selector.order_by:
+            var = ob.variable
+            out_names = dict(self.out_attrs)
+            if var.stream_id is None and var.attribute in out_names:
+                cexpr = compile_expression(
+                    Variable(var.attribute, stream_id="__out__"), _out_scope(inner, self.out_attrs)
+                )
+            else:
+                cexpr = compile_expression(var, scope)
+            if cexpr.type in (AttrType.STRING, AttrType.OBJECT):
+                raise SiddhiAppCreationError(
+                    "order by on STRING/OBJECT attributes is not supported yet "
+                    "(interned ids are not lexicographic)"
+                )
+            self.order_by.append((cexpr, ob.order.name == "DESC"))
+        self.limit = selector.limit
+        self.offset = selector.offset
+
     def init_state(self):
-        return [a.init() for a in self.aggregators]
+        st = {"aggs": [a.init() for a in self.aggregators]}
+        if self.group is not None:
+            st["group"] = self.group.init_state()
+        return st
 
     def apply(self, state, flow: Flow):
         env = flow.env()
+        keyed_rows = flow.sign != 0
+        group_state = state.get("group")
+        ctx = None
+        if self.group is not None:
+            group_state, ctx = self.group.assign(group_state, env, keyed_rows)
+            # surfaced to the host, which warns on slot-table exhaustion
+            flow.aux["groupby_overflow"] = ctx.overflow
         info = FlowInfo(
             sign=flow.sign,
             active=flow.current,
             reset=flow.reset,
             member=flow.member,
             member_env=flow.member_env,
+            group=ctx,
         )
-        new_state = []
+        new_aggs = []
         agg_cols: dict = {}
         for i, agg in enumerate(self.aggregators):
-            s, col = agg.apply(state[i], info, env)
-            new_state.append(s)
+            s, col = agg.apply(state["aggs"][i], info, env)
+            new_aggs.append(s)
             agg_cols[(_AGG_REF, None, f"a{i}")] = col
         env2 = Env({**env.columns, **agg_cols}, now=flow.now)
 
@@ -151,11 +194,76 @@ class CompiledSelector:
         valid = flow.batch.valid & (
             (flow.batch.kind == KIND_CURRENT) | (flow.batch.kind == KIND_EXPIRED)
         )
+        env3 = Env({**env2.columns, **out_col_keys}, now=flow.now)
         if self.having is not None:
-            env3 = Env({**env2.columns, **out_col_keys}, now=flow.now)
             valid = valid & self.having(env3)
+
+        # batch-mode group-by: one output per key per flush bucket — the last
+        # *having-passing* event of each (kind, bucket, key) survives
+        # (reference: QuerySelector.processInBatchGroupBy checks having BEFORE
+        # groupedEvents.put, so having order matches; the reference's map is
+        # kind-agnostic per chunk — we key by (kind, bucket), which only
+        # diverges for `output all events` where a bucket's CURRENT would
+        # shadow the previous bucket's EXPIRED of the same key)
+        if self.batch_mode and ctx is not None:
+            b = valid.shape[0]
+            idx = jnp.arange(b, dtype=jnp.int32)
+            seg = jnp.cumsum(flow.reset.astype(jnp.int32))
+            kind = flow.batch.kind
+            conflict = (
+                (idx[None, :] > idx[:, None])
+                & ctx.same
+                & (kind[None, :] == kind[:, None])
+                & (seg[None, :] == seg[:, None])
+                & valid[None, :]
+            )
+            valid = valid & ~conflict.any(axis=1)
 
         out = EventBatch(
             ts=flow.batch.ts, kind=flow.batch.kind, valid=valid, cols=out_cols
         )
+        out = self._order_limit(out, env3)
+        new_state = {"aggs": new_aggs}
+        if self.group is not None:
+            new_state["group"] = group_state
         return new_state, out
+
+    def _order_limit(self, out: EventBatch, env: Env) -> EventBatch:
+        """Per-chunk order-by + offset/limit (reference: QuerySelector
+        orderEventChunk/limitEventChunk)."""
+        if not self.order_by and self.limit is None and self.offset is None:
+            return out
+        if self.order_by:
+            keys = []
+            for cexpr, desc in self.order_by:
+                col = cexpr(env)
+                col = jnp.broadcast_to(col, out.valid.shape)
+                if desc:
+                    col = -col.astype(jnp.float32) if col.dtype == jnp.bool_ else -col
+                keys.append(col)
+            # primary = validity (valid rows first), then keys in order;
+            # jnp.lexsort treats the LAST key as primary
+            perm = jnp.lexsort(tuple(reversed(keys)) + (~out.valid,)).astype(jnp.int32)
+            out = EventBatch(
+                ts=out.ts[perm],
+                kind=out.kind[perm],
+                valid=out.valid[perm],
+                cols={n: c[perm] for n, c in out.cols.items()},
+            )
+        if self.limit is not None or self.offset is not None:
+            rank = jnp.cumsum(out.valid) - out.valid.astype(jnp.int32)
+            lo = 0 if self.offset is None else int(self.offset)
+            hi = _BIG if self.limit is None else lo + int(self.limit)
+            out = EventBatch(
+                ts=out.ts,
+                kind=out.kind,
+                valid=out.valid & (rank >= lo) & (rank < hi),
+                cols=out.cols,
+            )
+        return out
+
+
+def _out_scope(parent: Scope, out_attrs):
+    s = parent.child()
+    s.add_stream("__out__", dict(out_attrs))
+    return s

@@ -56,6 +56,9 @@ class WindowStage:
     """Base: (state, Flow) -> (state', Flow') with out-capacity growth."""
 
     needs_scheduler = False
+    # tumbling windows flip the selector into batch group-by output mode
+    # (reference: QueryParser batchProcessingAllowed -> QuerySelector)
+    is_batch = False
 
     def init_state(self):
         raise NotImplementedError
@@ -271,6 +274,8 @@ class BatchWindow(WindowStage):
     State invariant: the open bucket holds < flush size (cur_n < n for
     lengthBatch); `prev` holds the last flushed bucket awaiting expiry.
     """
+
+    is_batch = True
 
     def __init__(
         self,

@@ -1,0 +1,143 @@
+"""Keyed (group-by) batch reductions and the device group-slot assignment.
+
+The reference keeps one aggregator-state object per group key in a HashMap,
+looked up per event by a generated string key
+(reference: query/selector/GroupByKeyGenerator.java,
+query/selector/attribute/processor/executor/GroupByAggregationAttributeExecutor.java).
+TPU-shaped equivalent: group state is a fixed-capacity `[G]` array indexed by a
+slot; slot assignment is a vectorized probe of a persistent int64 key table —
+no scan, no host round-trip — and the per-event running values are masked
+O(B^2) segment reductions over the batch (one masked matmul / reduce).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from siddhi_tpu.ops.prefix import extreme_identity, last_reset_index
+
+# 64-bit mixing constants (splitmix64 finalizer) for combining composite keys.
+_MIX1 = jnp.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
+_MIX2 = jnp.int64(-4658895280553007687)  # 0xBF58476D1CE4E5B9 as signed
+
+
+def mix_keys(cols: list[jnp.ndarray]) -> jnp.ndarray:
+    """Combine one or more [B] integer-encoded key columns into one int64 key.
+
+    Single-column keys pass through exactly (collision-free); composite keys are
+    hash-mixed (the reference concatenates strings; a 64-bit mix keeps the
+    device representation fixed-width — collisions are ~2^-64 per pair).
+    """
+    if len(cols) == 1:
+        return cols[0].astype(jnp.int64)
+    h = jnp.zeros_like(cols[0], dtype=jnp.int64)
+    for c in cols:
+        h = (h ^ c.astype(jnp.int64)) * _MIX1
+        h = (h ^ (h >> 29)) * _MIX2
+    return h
+
+
+def assign_slots(
+    table_keys: jnp.ndarray,  # [G] int64
+    used: jnp.ndarray,        # [G] bool
+    n_used: jnp.ndarray,      # scalar int32
+    batch_keys: jnp.ndarray,  # [B] int64
+    active: jnp.ndarray,      # [B] bool — rows that carry a group key
+):
+    """Map each active row to a stable slot in [0, G); allocate new slots in
+    first-appearance order. Inactive rows get slot == G (scatter-drop lane).
+
+    Returns (new_table_keys, new_used, new_n_used, slot [B] int32,
+    same [B, B] bool key-equality mask, overflow scalar bool).
+    """
+    g = table_keys.shape[0]
+    b = batch_keys.shape[0]
+    idx = jnp.arange(b, dtype=jnp.int32)
+
+    eq_t = used[None, :] & (table_keys[None, :] == batch_keys[:, None])  # [B,G]
+    in_t = eq_t.any(axis=1) & active
+    t_slot = jnp.argmax(eq_t, axis=1).astype(jnp.int32)
+
+    same = (batch_keys[:, None] == batch_keys[None, :]) & active[:, None] & active[None, :]
+    first = jnp.argmax(same, axis=1).astype(jnp.int32)  # first row with my key
+
+    is_alloc = active & ~in_t & (first == idx)
+    alloc_rank = (jnp.cumsum(is_alloc) - is_alloc).astype(jnp.int32)
+    slot_new = n_used + alloc_rank  # valid where is_alloc
+    overflow = (jnp.where(is_alloc, slot_new, 0) >= g).any()
+    slot_new = jnp.minimum(slot_new, g - 1)
+
+    slot = jnp.where(in_t, t_slot, slot_new[first])
+    slot = jnp.where(active, slot, jnp.int32(g))
+
+    scatter = jnp.where(is_alloc, slot_new, jnp.int32(g))
+    new_keys = table_keys.at[scatter].set(batch_keys, mode="drop")
+    new_used = used.at[scatter].set(True, mode="drop")
+    new_n = jnp.minimum(n_used + is_alloc.sum(dtype=jnp.int32), g)
+    return new_keys, new_used, new_n, slot, same, overflow
+
+
+def _window_mask(same: jnp.ndarray, reset: jnp.ndarray) -> jnp.ndarray:
+    """[B,B]: j contributes to i's running value — same key, j <= i, j after
+    the last reset at or before i (RESET clears every group, matching the
+    reference's batch-window reset of all group states)."""
+    b = reset.shape[-1]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    lr = last_reset_index(reset)
+    return same & (idx[None, :] <= idx[:, None]) & (idx[None, :] > lr[:, None])
+
+
+def keyed_running_sum(
+    contrib: jnp.ndarray,  # [B], 0 on inactive rows
+    same: jnp.ndarray,     # [B,B]
+    reset: jnp.ndarray,    # [B]
+    carry: jnp.ndarray,    # [G]
+    slot: jnp.ndarray,     # [B] int32 (G = inactive)
+):
+    """Per-event running sum within each group; returns ([B] run, [G] carry')."""
+    g = carry.shape[0]
+    wm = _window_mask(same, reset)
+    run = jnp.where(wm, contrib[None, :], 0).sum(axis=-1)
+    lr = last_reset_index(reset)
+    gathered = jnp.where(slot < g, carry[jnp.clip(slot, 0, g - 1)], 0)
+    run = run + jnp.where(lr < 0, gathered, jnp.zeros_like(gathered))
+
+    glr = lr[-1]
+    post = jnp.arange(contrib.shape[0], dtype=jnp.int32) > glr
+    base = jnp.where(reset.any(), jnp.zeros_like(carry), carry)
+    new_carry = base.at[jnp.where(post, slot, g)].add(
+        jnp.where(post, contrib, 0), mode="drop"
+    )
+    return run, new_carry
+
+
+def keyed_running_extreme(
+    values: jnp.ndarray,
+    active: jnp.ndarray,
+    same: jnp.ndarray,
+    reset: jnp.ndarray,
+    carry: jnp.ndarray,  # [G]
+    slot: jnp.ndarray,
+    is_min: bool,
+):
+    """Per-event running min/max within each group (no removal)."""
+    g = carry.shape[0]
+    ident = extreme_identity(values.dtype, is_min)
+    wm = _window_mask(same, reset) & active[None, :]
+    masked = jnp.where(wm, values[None, :], ident)
+    red = masked.min(axis=-1) if is_min else masked.max(axis=-1)
+    lr = last_reset_index(reset)
+    gathered = jnp.where(
+        (slot < g) & (lr < 0), carry[jnp.clip(slot, 0, g - 1)], ident
+    )
+    run = jnp.minimum(red, gathered) if is_min else jnp.maximum(red, gathered)
+
+    post = jnp.arange(values.shape[0], dtype=jnp.int32) > lr[-1]
+    base = jnp.where(reset.any(), jnp.full_like(carry, ident), carry)
+    scatter = jnp.where(post & active, slot, g)
+    vals_post = jnp.where(post & active, values, ident)
+    if is_min:
+        new_carry = base.at[scatter].min(vals_post, mode="drop")
+    else:
+        new_carry = base.at[scatter].max(vals_post, mode="drop")
+    return run, new_carry
