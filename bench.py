@@ -1,0 +1,142 @@
+"""Benchmark driver: end-to-end engine throughput on the BASELINE.json configs.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The baseline denominator is the reference's published production throughput
+claim — 20B events/day ~= 300k events/s on a JVM cluster
+(reference: README.md:33-34; see BASELINE.md). Workloads follow
+BASELINE.json "configs"; configs not yet implemented are skipped and the
+headline value is the geometric mean of the implemented ones.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import time
+
+import numpy as np
+
+REFERENCE_EVENTS_PER_SEC = 300_000.0
+
+
+def _make_stock_data(n: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    symbols = np.array(["WSO2", "IBM", "GOOG", "MSFT", "ORCL", "AAPL", "AMZN", "NVDA"])
+    return {
+        "ts": np.arange(n, dtype=np.int64) + 1_700_000_000_000,
+        "symbol": rng.integers(1, 9, size=n).astype(np.int32),  # pre-interned ids
+        "price": rng.uniform(0.0, 100.0, size=n).astype(np.float32),
+        "volume": rng.integers(1, 1000, size=n).astype(np.int64),
+        "names": symbols,
+    }
+
+
+def _prime_interner(mgr, names):
+    for s in names:
+        mgr.interner.intern(str(s))
+
+
+def _run_workload(ql, query_stream, data, n_events, batch_size, warmup_batches=3):
+    """Throughput of one SiddhiQL app: events/sec through the full engine
+    (ingest pack -> device step chain -> downstream junction)."""
+    import jax
+
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    # interner ids 1..8 = the 8 symbols, matching the pre-interned columns
+    _prime_interner(mgr, data["names"])
+    rt.start()
+    h = rt.get_input_handler(query_stream)
+
+    cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
+    warm_n = batch_size * warmup_batches
+    h.send_columns(data["ts"][:warm_n], {k: v[:warm_n] for k, v in cols.items()})
+    _block_on_states(rt)
+
+    t0 = time.perf_counter()
+    sent = 0
+    while sent < n_events:
+        end = min(sent + batch_size * 64, n_events)
+        h.send_columns(
+            data["ts"][sent:end] if end <= len(data["ts"]) else data["ts"][: end - sent],
+            {k: v[sent:end] for k, v in cols.items()},
+        )
+        sent = end
+    _block_on_states(rt)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    mgr.shutdown()
+    return sent / dt
+
+
+def _block_on_states(rt):
+    import jax
+
+    for qr in rt.queries.values():
+        if qr.state is not None:
+            jax.block_until_ready(qr.state)
+
+
+WORKLOADS = {
+    # BASELINE.json config 1: SiddhiQL quickstart — filter + length-window avg
+    "filter_window_avg": (
+        """
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q')
+        from StockStream[price > 50]#window.length(50)
+        select symbol, avg(price) as ap
+        insert into Out;
+        """,
+        "StockStream",
+    ),
+    # BASELINE.json config 2: tumbling window group-by aggregation
+    "tumbling_groupby": (
+        """
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q')
+        from StockStream#window.lengthBatch(1024)
+        select symbol, sum(volume) as total, avg(price) as ap
+        group by symbol
+        insert into Out;
+        """,
+        "StockStream",
+    ),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=1_000_000)
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    n = args.events
+    data = _make_stock_data(max(n, args.batch * 8))
+    per = {}
+    for name, (ql, stream) in WORKLOADS.items():
+        ql = f"@app:batch(size='{args.batch}')\n" + ql
+        per[name] = _run_workload(ql, stream, data, n, args.batch)
+        if args.verbose:
+            print(f"# {name}: {per[name]:,.0f} events/s")
+
+    geomean = math.exp(sum(math.log(v) for v in per.values()) / len(per))
+    print(
+        json.dumps(
+            {
+                "metric": "engine_throughput_geomean",
+                "value": round(geomean, 1),
+                "unit": "events/s",
+                "vs_baseline": round(geomean / REFERENCE_EVENTS_PER_SEC, 3),
+                "detail": {k: round(v, 1) for k, v in per.items()},
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

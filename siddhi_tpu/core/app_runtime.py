@@ -61,6 +61,21 @@ class SiddhiAppRuntime:
 
         batch_ann = find_annotation(app.annotations, "app:batch")
         self.batch_size = int(batch_ann.element("size", str(DEFAULT_BATCH))) if batch_ann else DEFAULT_BATCH
+        gc_ann = find_annotation(app.annotations, "app:groupCapacity")
+        self.group_capacity = None
+        if gc_ann is not None:
+            v = gc_ann.element("size") or gc_ann.element(None)
+            if v is None:
+                raise SiddhiAppCreationError(
+                    "@app:groupCapacity needs a size, e.g. "
+                    "@app:groupCapacity(size='4096')"
+                )
+            self.group_capacity = int(v)
+        # one app-level processing lock: receive+route for every query runs
+        # under it, so cyclic stream topologies cannot lock-order deadlock and
+        # timer/input threads deliver outputs in state-step order (analog of
+        # the reference's synchronous junction dispatch + ThreadBarrier)
+        self._process_lock = threading.RLock()
 
         for sid, d in app.stream_definitions.items():
             self.stream_schemas[sid] = StreamSchema(
@@ -102,7 +117,10 @@ class SiddhiAppRuntime:
             raise DefinitionNotExistError(
                 f"stream '{stream.stream_id}' is not defined"
             )
-        qr = QueryRuntime(query, qid, in_schema, self.interner)
+        qr = QueryRuntime(
+            query, qid, in_schema, self.interner,
+            group_capacity=self.group_capacity,
+        )
         self.queries[qid] = qr
 
         out = query.output_stream
@@ -130,9 +148,7 @@ class SiddhiAppRuntime:
         in_junction = self._junction(stream.stream_id)
 
         def receive(batch: EventBatch, now: int, _qr=qr) -> None:
-            # receive+route under one (reentrant) lock so concurrent timer and
-            # input threads deliver outputs in state-step order
-            with _qr._receive_lock:
+            with self._process_lock:
                 out_batch, aux = _qr.receive(batch, now)
                 _qr.route_output(out_batch, now, decode)
             self._maybe_schedule(_qr, aux)
@@ -148,7 +164,7 @@ class SiddhiAppRuntime:
                     [t_ms], [nulls], self.interner,
                     capacity=self.batch_size, kinds=[KIND_TIMER],
                 )
-                with _qr._receive_lock:
+                with self._process_lock:
                     out_batch, aux = _qr.receive(batch, t_ms)
                     _qr.route_output(out_batch, t_ms, decode)
                 self._maybe_schedule(_qr, aux)

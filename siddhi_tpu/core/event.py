@@ -152,6 +152,55 @@ class StreamSchema:
             ts=jnp.asarray(ts), kind=jnp.asarray(kind), valid=jnp.asarray(valid), cols=cols
         )
 
+    def to_batch_cols(
+        self,
+        timestamps: np.ndarray,
+        cols: dict[str, np.ndarray],
+        interner: InternTable,
+        capacity: int | None = None,
+    ) -> EventBatch:
+        """Vectorized columnar packing: numpy arrays -> device batch.
+
+        String/object columns may be pre-interned int arrays or object arrays
+        (interned via np.unique — one table lookup per distinct value). This is
+        the high-throughput ingest path; `to_batch` is the per-row convenience.
+        """
+        ts = np.asarray(timestamps, dtype=np.int64)
+        n = ts.shape[0]
+        cap = capacity if capacity is not None else n
+        if n > cap:
+            raise ValueError(f"{n} events exceed batch capacity {cap}")
+        out_ts = np.zeros((cap,), dtype=np.int64)
+        out_ts[:n] = ts
+        valid = np.zeros((cap,), dtype=np.bool_)
+        valid[:n] = True
+        out_cols: dict[str, jax.Array] = {}
+        for name, t in self.attrs:
+            dt = np.dtype(PHYSICAL_DTYPE[t])
+            src = np.asarray(cols[name])
+            if t in (AttrType.STRING, AttrType.OBJECT) and src.dtype.kind in "OUS":
+                if t is AttrType.OBJECT or src.dtype.kind == "O":
+                    # objects may not be orderable (np.unique sorts) — intern
+                    # per item like the row path
+                    src = np.asarray(
+                        [interner.intern(v) for v in src.tolist()], dtype=dt
+                    )
+                else:
+                    uniq, inv = np.unique(src, return_inverse=True)
+                    ids = np.asarray(
+                        [interner.intern(v) for v in uniq.tolist()], dtype=dt
+                    )
+                    src = ids[inv]
+            arr = np.full((cap,), null_value(t), dtype=dt)
+            arr[:n] = src.astype(dt)
+            out_cols[name] = jnp.asarray(arr)
+        return EventBatch(
+            ts=jnp.asarray(out_ts),
+            kind=jnp.zeros((cap,), dtype=jnp.int8),
+            valid=jnp.asarray(valid),
+            cols=out_cols,
+        )
+
     def from_batch(
         self, batch: EventBatch, interner: InternTable
     ) -> list[tuple[int, int, tuple]]:

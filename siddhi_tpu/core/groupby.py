@@ -73,10 +73,10 @@ class CompiledGroupBy:
             "n": jnp.zeros((), jnp.int32),
         }
 
-    def assign(self, state, env: Env, active: jnp.ndarray):
+    def assign(self, state, env: Env, active: jnp.ndarray, reset: jnp.ndarray = None):
         bk = self.key_of(env)
         keys, used, n, slot, same, overflow = assign_slots(
-            state["keys"], state["used"], state["n"], bk, active
+            state["keys"], state["used"], state["n"], bk, active, reset=reset
         )
         ctx = GroupCtx(
             slot=slot, key=bk, same=same, capacity=self.capacity,

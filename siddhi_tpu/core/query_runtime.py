@@ -114,6 +114,7 @@ class QueryRuntime:
         in_schema: StreamSchema,
         interner: InternTable,
         window_factory: Optional[Callable] = None,
+        group_capacity: Optional[int] = None,
     ):
         self.query = query
         self.query_id = query_id
@@ -140,6 +141,7 @@ class QueryRuntime:
             scope,
             in_schema.attrs,
             batch_mode=self.chain.window is not None and self.chain.window.is_batch,
+            group_capacity=group_capacity,
         )
 
         out = query.output_stream
@@ -192,8 +194,8 @@ class QueryRuntime:
 
             logging.getLogger(__name__).error(
                 "query '%s': group-by slot table overflowed (capacity %d); "
-                "aggregates for colliding keys are unreliable — raise the "
-                "group capacity",
+                "overflowed keys lose their cross-batch carry — raise it "
+                "with @app:groupCapacity(size='N')",
                 self.query_id,
                 self.selector.group.capacity if self.selector.group else -1,
             )

@@ -69,6 +69,7 @@ class CompiledSelector:
         scope: Scope,
         input_attrs: list[tuple[str, AttrType]] | None = None,
         batch_mode: bool = False,
+        group_capacity: int | None = None,
     ):
         self.selector = selector
         self.batch_mode = batch_mode
@@ -81,7 +82,12 @@ class CompiledSelector:
         # group-by (reference: GroupByKeyGenerator over the input meta)
         self.group: CompiledGroupBy | None = None
         if selector.group_by:
-            self.group = CompiledGroupBy(selector.group_by, scope)
+            if group_capacity is not None:
+                self.group = CompiledGroupBy(
+                    selector.group_by, scope, capacity=group_capacity
+                )
+            else:
+                self.group = CompiledGroupBy(selector.group_by, scope)
 
         # lift aggregator calls out of the selection expressions
         agg_calls: list[AttributeFunction] = []
@@ -164,7 +170,9 @@ class CompiledSelector:
         group_state = state.get("group")
         ctx = None
         if self.group is not None:
-            group_state, ctx = self.group.assign(group_state, env, keyed_rows)
+            group_state, ctx = self.group.assign(
+                group_state, env, keyed_rows, reset=flow.reset
+            )
             # surfaced to the host, which warns on slot-table exhaustion
             flow.aux["groupby_overflow"] = ctx.overflow
         info = FlowInfo(

@@ -100,6 +100,27 @@ class InputHandler:
             timestamps = [t] * len(rows)
         self.junction.send_rows(list(timestamps), [tuple(r) for r in rows], now=self.clock())
 
+    def send_columns(
+        self,
+        timestamps: np.ndarray,
+        cols: dict[str, np.ndarray],
+        now: int | None = None,
+    ) -> None:
+        """High-throughput columnar ingest: one device batch per junction
+        batch-size chunk, no per-row Python work (the analog of the reference's
+        @async batched Disruptor path, StreamJunction.java:262-298)."""
+        j = self.junction
+        n = len(timestamps)
+        if now is None:
+            now = self.clock()  # same wall-clock default as send/send_many
+        for ofs in range(0, n, j.batch_size):
+            ts_chunk = timestamps[ofs : ofs + j.batch_size]
+            chunk = {k: v[ofs : ofs + j.batch_size] for k, v in cols.items()}
+            batch = j.schema.to_batch_cols(
+                ts_chunk, chunk, j.interner, capacity=j.batch_size
+            )
+            j.publish_batch(batch, now)
+
 
 def system_clock_ms() -> int:
     return int(time.time() * 1000)

@@ -43,9 +43,20 @@ def assign_slots(
     n_used: jnp.ndarray,      # scalar int32
     batch_keys: jnp.ndarray,  # [B] int64
     active: jnp.ndarray,      # [B] bool — rows that carry a group key
+    reset: jnp.ndarray | None = None,  # [B] bool — RESET rows clear the table
 ):
     """Map each active row to a stable slot in [0, G); allocate new slots in
     first-appearance order. Inactive rows get slot == G (scatter-drop lane).
+
+    RESET semantics: a reset kills every group's carried state, so rows after
+    the batch's last reset re-allocate into a FRESH table (bounding table
+    growth to per-bucket cardinality for batch windows — the reference's
+    per-chunk group map has the same lifetime). Rows before the reset resolve
+    against the old table, which only feeds the (pre-reset) carry gathers.
+
+    Overflow: keys beyond capacity go to the dead lane G — their within-batch
+    running values are still exact (computed from the `same` mask), but their
+    carry is lost across batches; existing groups are never corrupted.
 
     Returns (new_table_keys, new_used, new_n_used, slot [B] int32,
     same [B, B] bool key-equality mask, overflow scalar bool).
@@ -54,26 +65,59 @@ def assign_slots(
     b = batch_keys.shape[0]
     idx = jnp.arange(b, dtype=jnp.int32)
 
+    same = (batch_keys[:, None] == batch_keys[None, :]) & active[:, None] & active[None, :]
+
+    if reset is not None and reset.shape:
+        marked = jnp.where(reset, idx, jnp.int32(-1))
+        glr = jnp.max(marked)  # last reset row, -1 if none
+    else:
+        glr = jnp.int32(-1)
+    any_reset = glr >= 0
+    post = idx > glr  # rows whose carry lives in the (possibly fresh) new table
+
+    # --- resolution against the old table (pre-reset gathers + no-reset case)
     eq_t = used[None, :] & (table_keys[None, :] == batch_keys[:, None])  # [B,G]
     in_t = eq_t.any(axis=1) & active
     t_slot = jnp.argmax(eq_t, axis=1).astype(jnp.int32)
 
-    same = (batch_keys[:, None] == batch_keys[None, :]) & active[:, None] & active[None, :]
     first = jnp.argmax(same, axis=1).astype(jnp.int32)  # first row with my key
-
     is_alloc = active & ~in_t & (first == idx)
     alloc_rank = (jnp.cumsum(is_alloc) - is_alloc).astype(jnp.int32)
-    slot_new = n_used + alloc_rank  # valid where is_alloc
-    overflow = (jnp.where(is_alloc, slot_new, 0) >= g).any()
-    slot_new = jnp.minimum(slot_new, g - 1)
+    slot_new = n_used + alloc_rank
+    old_overflow = (jnp.where(is_alloc, slot_new, 0) >= g).any()
+    old_slot = jnp.where(in_t, t_slot, jnp.where(slot_new[first] < g, slot_new[first], g))
+    old_slot = jnp.where(active, old_slot, jnp.int32(g)).astype(jnp.int32)
 
-    slot = jnp.where(in_t, t_slot, slot_new[first])
+    # --- fresh-table resolution for post-reset rows
+    post_active = active & post
+    same_post = same & post[:, None] & post[None, :]
+    first_post = jnp.argmax(same_post, axis=1).astype(jnp.int32)
+    is_alloc_f = post_active & (first_post == idx)
+    rank_f = (jnp.cumsum(is_alloc_f) - is_alloc_f).astype(jnp.int32)
+    fresh_overflow = (jnp.where(is_alloc_f, rank_f, 0) >= g).any()
+    fresh_slot = jnp.where(
+        post_active & (rank_f[first_post] < g), rank_f[first_post], g
+    ).astype(jnp.int32)
+
+    slot = jnp.where(any_reset & post, fresh_slot, old_slot)
     slot = jnp.where(active, slot, jnp.int32(g))
+    overflow = jnp.where(any_reset, fresh_overflow, old_overflow)
 
-    scatter = jnp.where(is_alloc, slot_new, jnp.int32(g))
-    new_keys = table_keys.at[scatter].set(batch_keys, mode="drop")
-    new_used = used.at[scatter].set(True, mode="drop")
-    new_n = jnp.minimum(n_used + is_alloc.sum(dtype=jnp.int32), g)
+    # --- new table state
+    # no reset: old table + this batch's allocations
+    scatter_old = jnp.where(is_alloc & (slot_new < g) & ~any_reset, slot_new, g)
+    keys_old = table_keys.at[scatter_old].set(batch_keys, mode="drop")
+    used_old = used.at[scatter_old].set(True, mode="drop")
+    n_old = jnp.minimum(n_used + is_alloc.sum(dtype=jnp.int32), g)
+    # reset: fresh table from post-reset allocations only
+    scatter_f = jnp.where(is_alloc_f & (rank_f < g) & any_reset, rank_f, g)
+    keys_f = jnp.zeros_like(table_keys).at[scatter_f].set(batch_keys, mode="drop")
+    used_f = jnp.zeros_like(used).at[scatter_f].set(True, mode="drop")
+    n_f = jnp.minimum(is_alloc_f.sum(dtype=jnp.int32), g)
+
+    new_keys = jnp.where(any_reset, keys_f, keys_old)
+    new_used = jnp.where(any_reset, used_f, used_old)
+    new_n = jnp.where(any_reset, n_f, n_old)
     return new_keys, new_used, new_n, slot, same, overflow
 
 

@@ -32,16 +32,19 @@ def window_mask(reset: jnp.ndarray) -> jnp.ndarray:
 def running_sum(
     contrib: jnp.ndarray, reset: jnp.ndarray, base: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Running sum after each event with reset barriers.
+    """Running sum after each event with reset barriers — O(B) via cumsum:
+    run_i = csum_i - csum[last_reset_i] (+ carry before the first reset).
 
-    contrib: [B] signed contributions (0 for invalid/timer rows)
+    contrib: [B] signed contributions (0 for invalid/timer/reset rows)
     reset:   [B] bool reset-event marks
     base:    scalar carried sum from prior batches
     returns: ([B] running values, scalar new carry)
     """
-    m = window_mask(reset)
-    run = (jnp.where(m, contrib[None, :], 0)).sum(axis=-1)
-    no_reset_yet = last_reset_index(reset) < 0
+    csum = jnp.cumsum(contrib)
+    lr = last_reset_index(reset)
+    at_lr = jnp.where(lr >= 0, csum[jnp.clip(lr, 0)], jnp.zeros_like(csum[0]))
+    run = csum - at_lr
+    no_reset_yet = lr < 0
     run = run + jnp.where(no_reset_yet, base, jnp.zeros_like(base))
     return run, run[-1]
 
@@ -53,17 +56,26 @@ def running_extreme(
     base: jnp.ndarray,
     is_min: bool,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Running min/max (no removal — forever semantics / non-windowed).
+    """Running min/max (no removal — forever semantics / non-windowed), O(B)
+    via a segmented associative scan (reset starts a new segment).
 
     values: [B]; active: [B] bool (valid CURRENT rows); base: scalar carry
     (identity = +/-inf or int extreme when nothing seen yet).
     """
+    import jax.lax as lax
+
     ident = extreme_identity(values.dtype, is_min)
-    m = window_mask(reset)
-    masked = jnp.where(m & active[None, :], values[None, :], ident)
-    red = masked.min(axis=-1) if is_min else masked.max(axis=-1)
+    op = jnp.minimum if is_min else jnp.maximum
+    masked = jnp.where(active, values, ident)
+
+    def combine(a, b):
+        av, ar = a
+        bv, br = b
+        return jnp.where(br, bv, op(av, bv)), ar | br
+
+    red, _ = lax.associative_scan(combine, (masked, reset))
     base_eff = jnp.where(last_reset_index(reset) < 0, base, ident)
-    run = jnp.minimum(red, base_eff) if is_min else jnp.maximum(red, base_eff)
+    run = op(red, base_eff)
     return run, run[-1]
 
 

@@ -220,3 +220,54 @@ def test_limit_offset_arrival_order():
     rt.get_input_handler("S").send_many([(1,), (2,), (3,), (4,), (5,)])
     assert [e.data for e in got] == [(2,), (3,)]
     mgr.shutdown()
+
+
+def test_groupby_capacity_annotation_and_bucket_reset_reclaims_slots():
+    # tiny capacity 4; lengthBatch resets must clear the slot table so
+    # cumulative cardinality beyond capacity works across buckets
+    mgr, rt = make_runtime(
+        """
+        @app:groupCapacity(size='4')
+        define stream S (k int, v long);
+        @info(name='q1')
+        from S#window.lengthBatch(3) select k, sum(v) as s group by k
+        insert into Out;
+        """
+    )
+    got = []
+    rt.add_callback("q1", lambda ts, ins, removed: got.extend(ins or []))
+    h = rt.get_input_handler("S")
+    h.send_many([(1, 1), (2, 2), (1, 3)])      # bucket 1: keys {1,2}
+    h.send_many([(3, 5), (4, 6), (5, 7)])      # bucket 2: keys {3,4,5}
+    h.send_many([(6, 8), (7, 9), (6, 1)])      # bucket 3: keys {6,7}
+    assert sorted(e.data for e in got) == [
+        (1, 4), (2, 2), (3, 5), (4, 6), (5, 7), (6, 9), (7, 9),
+    ]
+    mgr.shutdown()
+
+
+def test_groupby_overflow_does_not_corrupt_existing_groups(caplog):
+    import logging
+
+    mgr, rt = make_runtime(
+        """
+        @app:groupCapacity(size='2')
+        define stream S (k int, v long);
+        @info(name='q1')
+        from S select k, sum(v) as s group by k insert into Out;
+        """
+    )
+    got = []
+    rt.add_callback("q1", lambda ts, ins, removed: got.extend(ins or []))
+    h = rt.get_input_handler("S")
+    with caplog.at_level(logging.ERROR):
+        h.send((1, 10))
+        h.send((2, 20))
+        h.send((3, 30))   # overflow: key 3 has no slot
+        h.send((1, 5))    # key 1's carry must be intact
+    assert got[0].data == (1, 10)
+    assert got[1].data == (2, 20)
+    assert got[2].data == (3, 30)   # within-batch value still exact
+    assert got[3].data == (1, 15)   # not corrupted by key 3
+    assert any("overflow" in r.message for r in caplog.records)
+    mgr.shutdown()
