@@ -59,12 +59,9 @@ def _run_workload(ql, query_stream, data, n_events, batch_size, warmup_batches=3
 
     t0 = time.perf_counter()
     sent = 0
-    while sent < n_events:
+    while sent < n_events:  # data arrays are sized >= n_events by main()
         end = min(sent + batch_size * 64, n_events)
-        h.send_columns(
-            data["ts"][sent:end] if end <= len(data["ts"]) else data["ts"][: end - sent],
-            {k: v[sent:end] for k, v in cols.items()},
-        )
+        h.send_columns(data["ts"][sent:end], {k: v[sent:end] for k, v in cols.items()})
         sent = end
     _block_on_states(rt)
     dt = time.perf_counter() - t0

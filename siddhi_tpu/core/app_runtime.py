@@ -32,6 +32,7 @@ from siddhi_tpu.core.stream_junction import (
 from siddhi_tpu.query_api.annotation import find_annotation
 from siddhi_tpu.query_api.execution import (
     InsertIntoStream,
+    JoinInputStream,
     OutputEventsFor,
     Partition,
     Query,
@@ -61,16 +62,7 @@ class SiddhiAppRuntime:
 
         batch_ann = find_annotation(app.annotations, "app:batch")
         self.batch_size = int(batch_ann.element("size", str(DEFAULT_BATCH))) if batch_ann else DEFAULT_BATCH
-        gc_ann = find_annotation(app.annotations, "app:groupCapacity")
-        self.group_capacity = None
-        if gc_ann is not None:
-            v = gc_ann.element("size") or gc_ann.element(None)
-            if v is None:
-                raise SiddhiAppCreationError(
-                    "@app:groupCapacity needs a size, e.g. "
-                    "@app:groupCapacity(size='4096')"
-                )
-            self.group_capacity = int(v)
+        self.group_capacity = self._capacity_annotation("app:groupCapacity", None)
         # one app-level processing lock: receive+route for every query runs
         # under it, so cyclic stream topologies cannot lock-order deadlock and
         # timer/input threads deliver outputs in state-step order (analog of
@@ -94,6 +86,17 @@ class SiddhiAppRuntime:
 
     # ---- assembly --------------------------------------------------------
 
+    def _capacity_annotation(self, name: str, default):
+        ann = find_annotation(self.app.annotations, name)
+        if ann is None:
+            return default
+        v = ann.element("size") or ann.element(None)
+        if v is None:
+            raise SiddhiAppCreationError(
+                f"@{name} needs a size, e.g. @{name}(size='4096')"
+            )
+        return int(v)
+
     def _junction(self, stream_id: str) -> StreamJunction:
         j = self.junctions.get(stream_id)
         if j is None:
@@ -104,10 +107,47 @@ class SiddhiAppRuntime:
             self.junctions[stream_id] = j
         return j
 
+    def _wire_insert(self, qr) -> None:
+        """Route a query's output batches into its insert-into junction
+        (reference: SiddhiAppRuntimeBuilder.addQuery:170-231 output wiring)."""
+        out = qr.query.output_stream
+        if not isinstance(out, InsertIntoStream):
+            return
+        target = out.target
+        existing = self.stream_schemas.get(target)
+        inferred = qr.out_schema
+        if existing is None:
+            self.stream_schemas[target] = inferred
+        elif [t for _, t in existing.attrs] != [t for _, t in inferred.attrs]:
+            raise SiddhiAppCreationError(
+                f"insert into '{target}': selector output {inferred.attrs} "
+                f"does not match defined stream {existing.attrs}"
+            )
+        target_junction = self._junction(target)
+        transform = _make_insert_transform(out.output_events)
+        rename = _make_rename(inferred, self.stream_schemas[target])
+
+        def publish(out_batch: EventBatch, now: int, _t=target_junction) -> None:
+            _t.publish_batch(rename(transform(out_batch)), now)
+
+        qr.publish_fn = publish
+
+    def _timer_batch(self, schema: StreamSchema, t_ms: int) -> EventBatch:
+        from siddhi_tpu.core.event import KIND_TIMER
+
+        nulls = tuple(None for _ in schema.attrs)
+        return schema.to_batch(
+            [t_ms], [nulls], self.interner,
+            capacity=self.batch_size, kinds=[KIND_TIMER],
+        )
+
     def _add_query(self, qid: str, query: Query) -> None:
         if qid in self.queries:
             raise SiddhiAppCreationError(f"duplicate query name '{qid}'")
         stream = query.input_stream
+        if isinstance(stream, JoinInputStream):
+            self._add_join_query(qid, query)
+            return
         if not isinstance(stream, SingleInputStream):
             raise SiddhiAppCreationError(
                 f"{type(stream).__name__} queries land in later milestones"
@@ -122,27 +162,7 @@ class SiddhiAppRuntime:
             group_capacity=self.group_capacity,
         )
         self.queries[qid] = qr
-
-        out = query.output_stream
-        if isinstance(out, InsertIntoStream):
-            target = out.target
-            existing = self.stream_schemas.get(target)
-            inferred = qr.out_schema
-            if existing is None:
-                self.stream_schemas[target] = inferred
-            elif [t for _, t in existing.attrs] != [t for _, t in inferred.attrs]:
-                raise SiddhiAppCreationError(
-                    f"insert into '{target}': selector output {inferred.attrs} "
-                    f"does not match defined stream {existing.attrs}"
-                )
-            target_junction = self._junction(target)
-            transform = _make_insert_transform(out.output_events)
-            rename = _make_rename(inferred, self.stream_schemas[target])
-
-            def publish(out_batch: EventBatch, now: int, _t=target_junction) -> None:
-                _t.publish_batch(rename(transform(out_batch)), now)
-
-            qr.publish_fn = publish
+        self._wire_insert(qr)
 
         decode = self._decode
         in_junction = self._junction(stream.stream_id)
@@ -157,13 +177,7 @@ class SiddhiAppRuntime:
 
         if qr.needs_scheduler:
             def fire(t_ms: int, _qr=qr, _schema=in_schema) -> None:
-                nulls = tuple(None for _ in _schema.attrs)
-                from siddhi_tpu.core.event import KIND_TIMER
-
-                batch = _schema.to_batch(
-                    [t_ms], [nulls], self.interner,
-                    capacity=self.batch_size, kinds=[KIND_TIMER],
-                )
+                batch = self._timer_batch(_schema, t_ms)
                 with self._process_lock:
                     out_batch, aux = _qr.receive(batch, t_ms)
                     _qr.route_output(out_batch, t_ms, decode)
@@ -171,18 +185,71 @@ class SiddhiAppRuntime:
 
             qr.timer_target = fire
 
+    def _add_join_query(self, qid: str, query: Query) -> None:
+        from siddhi_tpu.core.join import DEFAULT_JOIN_CAPACITY, JoinQueryRuntime
+
+        join = query.input_stream
+        schemas = []
+        for s in (join.left, join.right):
+            sch = self.stream_schemas.get(s.stream_id)
+            if sch is None:
+                raise DefinitionNotExistError(f"stream '{s.stream_id}' is not defined")
+            schemas.append(sch)
+        join_capacity = self._capacity_annotation(
+            "app:joinCapacity", DEFAULT_JOIN_CAPACITY
+        )
+        qr = JoinQueryRuntime(
+            query, qid, schemas[0], schemas[1], self.interner,
+            group_capacity=self.group_capacity, join_capacity=join_capacity,
+        )
+        self.queries[qid] = qr
+        self._wire_insert(qr)
+        decode = self._decode
+
+        def receive_side(batch: EventBatch, now: int, side: str, _qr=qr) -> None:
+            with self._process_lock:
+                out_batch, aux = _qr.receive(batch, now, side)
+                _qr.route_output(out_batch, now, decode)
+            if "next_timer" in aux:
+                self._schedule_at(aux, _qr.timer_targets.get(side))
+
+        # self-joins: one subscription drives left then right, in that order
+        # (reference: JoinInputStreamParser self-join double dispatch)
+        if join.left.stream_id == join.right.stream_id:
+            j = self._junction(join.left.stream_id)
+            j.subscribe(lambda b, now: (receive_side(b, now, "l"), receive_side(b, now, "r")))
+        else:
+            self._junction(join.left.stream_id).subscribe(
+                lambda b, now: receive_side(b, now, "l")
+            )
+            self._junction(join.right.stream_id).subscribe(
+                lambda b, now: receive_side(b, now, "r")
+            )
+
+        for side, schema in qr.side_schemas.items():
+            if qr.needs_scheduler[side]:
+                def fire(t_ms: int, _side=side, _schema=schema) -> None:
+                    receive_side(self._timer_batch(_schema, t_ms), t_ms, _side)
+
+                qr.timer_targets[side] = fire
+
     def _decode(self, schema: StreamSchema, batch: EventBatch):
         return schema.from_batch(batch, self.interner)
 
     def _maybe_schedule(self, qr: QueryRuntime, aux: dict) -> None:
         if not qr.needs_scheduler or "next_timer" not in aux:
             return
+        self._schedule_at(aux, qr.timer_target)
+
+    def _schedule_at(self, aux: dict, target) -> None:
+        if target is None or "next_timer" not in aux:
+            return
         from siddhi_tpu.core.windows import NO_TIMER
 
         t = int(aux["next_timer"])
         if t < int(NO_TIMER):
             self._scheduler.start()
-            self._scheduler.notify_at(t, qr.timer_target)
+            self._scheduler.notify_at(t, target)
 
     # ---- public API (reference: SiddhiAppRuntime callbacks/handlers) -----
 

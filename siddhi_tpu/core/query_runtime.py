@@ -104,7 +104,83 @@ class CompiledSingleChain:
         )
 
 
-class QueryRuntime:
+class BaseQueryRuntime:
+    """Shared host-side half of a compiled query: output schema inference,
+    callback/junction routing, state container (reference: QueryRuntime.java:45
+    + OutputParser callback construction)."""
+
+    def _setup_output(self, query: "Query", query_id: str) -> None:
+        out = query.output_stream
+        if isinstance(out, InsertIntoStream):
+            target = out.target
+        else:
+            target = f"__ret_{query_id}"
+        self.out_schema = StreamSchema(target, self.selector.out_attrs)
+        self.output_events = out.output_events
+        self.query_callbacks: list[Callable] = []
+        self.publish_fn: Optional[Callable] = None
+        self._receive_lock = threading.RLock()
+        self.state = None
+        self._warned_overflow = False
+        self._warned_join_overflow = False
+
+    def init_state(self):
+        raise NotImplementedError
+
+    def _warn_aux(self, aux: dict) -> None:
+        if (
+            not self._warned_overflow
+            and "groupby_overflow" in aux
+            and bool(aux["groupby_overflow"])
+        ):
+            self._warned_overflow = True
+            import logging
+
+            logging.getLogger(__name__).error(
+                "query '%s': group-by slot table overflowed (capacity %d); "
+                "overflowed keys lose their cross-batch carry — raise it "
+                "with @app:groupCapacity(size='N')",
+                self.query_id,
+                self.selector.group.capacity if self.selector.group else -1,
+            )
+        if (
+            not self._warned_join_overflow
+            and "join_overflow" in aux
+            and bool(aux["join_overflow"])
+        ):
+            self._warned_join_overflow = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "query '%s': join output overflowed its capacity; matches were "
+                "dropped — raise it with @app:joinCapacity(size='N')",
+                self.query_id,
+            )
+
+    def route_output(self, out: EventBatch, now: int, decode) -> None:
+        """Dispatch a step's output to query callbacks / downstream junction.
+
+        `decode` = app-runtime host decoder (batch -> event triples).
+        """
+        if self.query_callbacks:
+            events = decode(self.out_schema, out)
+            if events:
+                ins = [e for e in events if e[1] == KIND_CURRENT]
+                removed = [e for e in events if e[1] == KIND_EXPIRED]
+                want = self.output_events
+                if want is OutputEventsFor.CURRENT:
+                    removed = []
+                elif want is OutputEventsFor.EXPIRED:
+                    ins = []
+                if ins or removed:
+                    ts = events[-1][0]
+                    for cb in self.query_callbacks:
+                        cb(ts, ins or None, removed or None)
+        if self.publish_fn is not None:
+            self.publish_fn(out, now)
+
+
+class QueryRuntime(BaseQueryRuntime):
     """Compiled query + device state + host output routing."""
 
     def __init__(
@@ -144,25 +220,11 @@ class QueryRuntime:
             group_capacity=group_capacity,
         )
 
-        out = query.output_stream
-        if isinstance(out, InsertIntoStream):
-            target = out.target
-        else:
-            target = f"__ret_{query_id}"
-        self.out_schema = StreamSchema(target, self.selector.out_attrs)
-        self.output_events = out.output_events
-
-        # host-side sinks wired by the app runtime
-        self.query_callbacks: list[Callable] = []
-        self.publish_fn: Optional[Callable] = None
+        self._setup_output(query, query_id)
         self.needs_scheduler = (
             self.chain.window is not None and self.chain.window.needs_scheduler
         )
-
         self._step = jax.jit(self._step_impl)
-        self._receive_lock = threading.RLock()
-        self.state = None
-        self._warned_overflow = False
 
     # ---- device program --------------------------------------------------
 
@@ -184,41 +246,5 @@ class QueryRuntime:
             self.state, out, aux = self._step(
                 self.state, batch, jnp.asarray(now, dtype=jnp.int64)
             )
-        if (
-            not self._warned_overflow
-            and "groupby_overflow" in aux
-            and bool(aux["groupby_overflow"])
-        ):
-            self._warned_overflow = True
-            import logging
-
-            logging.getLogger(__name__).error(
-                "query '%s': group-by slot table overflowed (capacity %d); "
-                "overflowed keys lose their cross-batch carry — raise it "
-                "with @app:groupCapacity(size='N')",
-                self.query_id,
-                self.selector.group.capacity if self.selector.group else -1,
-            )
+        self._warn_aux(aux)
         return out, aux
-
-    def route_output(self, out: EventBatch, now: int, decode) -> None:
-        """Dispatch a step's output to query callbacks / downstream junction.
-
-        `decode` = app-runtime host decoder (batch -> event triples).
-        """
-        if self.query_callbacks:
-            events = decode(self.out_schema, out)
-            if events:
-                ins = [e for e in events if e[1] == KIND_CURRENT]
-                removed = [e for e in events if e[1] == KIND_EXPIRED]
-                want = self.output_events
-                if want is OutputEventsFor.CURRENT:
-                    removed = []
-                elif want is OutputEventsFor.EXPIRED:
-                    ins = []
-                if ins or removed:
-                    ts = events[-1][0]
-                    for cb in self.query_callbacks:
-                        cb(ts, ins or None, removed or None)
-        if self.publish_fn is not None:
-            self.publish_fn(out, now)

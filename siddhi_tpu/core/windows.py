@@ -66,6 +66,12 @@ class WindowStage:
     def apply(self, state, flow: Flow):
         raise NotImplementedError
 
+    def view(self, state):
+        """Stored window contents for probing: `(cols, ts, mask)` with rows in
+        insertion order (reference: FindableProcessor.find iterating the window
+        buffer, query/processor/stream/window/LengthWindowProcessor.java:144)."""
+        raise NotImplementedError(f"{type(self).__name__} is not findable")
+
 
 # ---------------------------------------------------------------------------
 # sliding family: length / time / timeLength / externalTime / delay
@@ -253,6 +259,16 @@ class SlidingWindow(WindowStage):
             member_env=member_env,
             aux=aux,
         )
+
+
+    def view(self, state):
+        mask = state["seq"] >= 0
+        # ring slots -> logical insertion order via the monotone seq lane
+        perm = jnp.argsort(jnp.where(mask, state["seq"], jnp.iinfo(jnp.int64).max)).astype(
+            jnp.int32
+        )
+        cols = {n: c[perm] for n, c in state["cols"].items()}
+        return cols, state["ts"][perm], mask[perm]
 
 
 def _place_ring(old, evicted, slots, vals):
@@ -530,6 +546,13 @@ class BatchWindow(WindowStage):
             member_env=member_env,
             aux=aux,
         )
+
+
+    def view(self, state):
+        # the open (current) bucket is the probe-able window content
+        # (reference: LengthBatchWindowProcessor.find over currentEventQueue)
+        mask = jnp.arange(self.w, dtype=jnp.int32) < state["cur_n"]
+        return state["cur_cols"], state["cur_ts"], mask
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,230 @@
+"""Join query end-to-end tests.
+
+Mirrors the reference's JoinTestCase / OuterJoinTestCase semantics
+(reference: modules/siddhi-core/src/test/java/org/wso2/siddhi/core/query/join/).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def run_app(ql, sends, callback_name="q"):
+    """sends: list of (stream_id, row, ts). Returns (in_events, removed_events)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    ins, removed = [], []
+
+    def cb(ts, in_events, removed_events):
+        if in_events:
+            ins.extend(e.data for e in in_events)
+        if removed_events:
+            removed.extend(e.data for e in removed_events)
+
+    rt.add_callback(callback_name, cb)
+    rt.start()
+    handlers = {}
+    for stream_id, row, ts in sends:
+        h = handlers.setdefault(stream_id, rt.get_input_handler(stream_id))
+        h.send(row, timestamp=ts)
+    rt.shutdown()
+    mgr.shutdown()
+    return ins, removed
+
+
+BASE = """
+define stream StockStream (sym string, price float);
+define stream TwitterStream (user string, company string);
+"""
+
+
+class TestInnerJoin:
+    def test_window_probe(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select StockStream.sym as sym, TwitterStream.user as user, StockStream.price as price
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("StockStream", ("WSO2", 55.5), 100),
+            ("TwitterStream", ("u1", "WSO2"), 200),
+            ("StockStream", ("IBM", 75.5), 300),
+            ("StockStream", ("WSO2", 57.0), 400),
+        ])
+        assert ins == [("WSO2", "u1", 55.5), ("WSO2", "u1", 57.0)]
+
+    def test_multi_match_one_arrival(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select TwitterStream.user as user, StockStream.price as price
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("TwitterStream", ("u1", "WSO2"), 100),
+            ("TwitterStream", ("u2", "WSO2"), 200),
+            ("StockStream", ("WSO2", 10.0), 300),
+        ])
+        # one stock arrival matches both tweets, window (insertion) order
+        assert ins == [("u1", 10.0), ("u2", 10.0)]
+
+    def test_join_condition_non_equi(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) as a join StockStream#window.length(10) as b
+        on a.price < b.price
+        select a.price as lo, b.price as hi
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("StockStream", ("WSO2", 10.0), 100),
+            ("StockStream", ("WSO2", 20.0), 200),
+        ])
+        # arrival 20.0: left-side probe right window {10} -> no (20<10 false);
+        # right-side probe left window {10,20} -> (10,20)
+        assert ins == [(10.0, 20.0)]
+
+    def test_filter_before_window(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream[price > 50]#window.length(10) join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select StockStream.price as price, TwitterStream.user as user
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("StockStream", ("WSO2", 10.0), 100),   # filtered out
+            ("StockStream", ("WSO2", 60.0), 200),
+            ("TwitterStream", ("u1", "WSO2"), 300),
+        ])
+        assert ins == [(60.0, "u1")]
+
+    def test_unidirectional(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) unidirectional join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select StockStream.sym as sym, TwitterStream.user as user
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("StockStream", ("WSO2", 55.5), 100),
+            ("TwitterStream", ("u1", "WSO2"), 200),   # right arrival: no output
+            ("StockStream", ("WSO2", 57.0), 300),     # left arrival: match
+        ])
+        assert ins == [("WSO2", "u1")]
+
+
+class TestOuterJoin:
+    def test_left_outer(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) left outer join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select StockStream.sym as sym, TwitterStream.user as user
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("StockStream", ("WSO2", 55.5), 100),     # no match -> (WSO2, null)
+            ("TwitterStream", ("u1", "WSO2"), 200),   # match -> (WSO2, u1)
+            ("TwitterStream", ("u2", "IBM"), 300),    # right miss on left outer -> none
+        ])
+        assert ins == [("WSO2", None), ("WSO2", "u1")]
+
+    def test_right_outer(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) right outer join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select StockStream.sym as sym, TwitterStream.user as user
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("TwitterStream", ("u1", "WSO2"), 100),   # no match -> (null, u1)
+            ("StockStream", ("WSO2", 55.5), 200),     # match -> (WSO2, u1)
+            ("StockStream", ("IBM", 75.5), 300),      # left miss on right outer -> none
+        ])
+        assert ins == [(None, "u1"), ("WSO2", "u1")]
+
+    def test_full_outer(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) full outer join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select StockStream.sym as sym, TwitterStream.user as user
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("StockStream", ("WSO2", 55.5), 100),     # (WSO2, null)
+            ("TwitterStream", ("u2", "IBM"), 200),    # (null, u2)
+            ("TwitterStream", ("u1", "WSO2"), 300),   # (WSO2, u1)
+        ])
+        assert ins == [("WSO2", None), (None, "u2"), ("WSO2", "u1")]
+
+    def test_null_numeric_fill(self):
+        ql = BASE + """
+        @info(name='q')
+        from TwitterStream#window.length(10) left outer join StockStream#window.length(10)
+        on TwitterStream.company == StockStream.sym
+        select TwitterStream.user as user, StockStream.price as price
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("TwitterStream", ("u1", "WSO2"), 100),
+        ])
+        assert ins == [("u1", None)]
+
+
+class TestJoinAggregation:
+    def test_count_over_join(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select StockStream.sym as sym, count() as c
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("TwitterStream", ("u1", "WSO2"), 100),
+            ("StockStream", ("WSO2", 10.0), 200),
+            ("StockStream", ("WSO2", 11.0), 300),
+        ])
+        assert ins == [("WSO2", 1), ("WSO2", 2)]
+
+    def test_group_by_over_join(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(10) join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select TwitterStream.user as user, sum(StockStream.price) as total
+        group by TwitterStream.user
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("TwitterStream", ("u1", "WSO2"), 100),
+            ("TwitterStream", ("u2", "WSO2"), 150),
+            ("StockStream", ("WSO2", 10.0), 200),
+            ("StockStream", ("WSO2", 5.0), 300),
+        ])
+        assert ins == [("u1", 10.0), ("u2", 10.0), ("u1", 15.0), ("u2", 15.0)]
+
+
+class TestJoinExpired:
+    def test_all_events_expired_probe(self):
+        ql = BASE + """
+        @info(name='q')
+        from StockStream#window.length(1) join TwitterStream#window.length(10)
+        on StockStream.sym == TwitterStream.company
+        select StockStream.price as price, TwitterStream.user as user
+        insert all events into Out;
+        """
+        ins, removed = run_app(ql, [
+            ("TwitterStream", ("u1", "WSO2"), 100),
+            ("StockStream", ("WSO2", 10.0), 200),
+            ("StockStream", ("WSO2", 11.0), 300),  # evicts 10.0 -> expired join
+        ])
+        assert ins == [(10.0, "u1"), (11.0, "u1")]
+        assert removed == [(10.0, "u1")]
