@@ -92,6 +92,10 @@ class Scope:
     def __init__(self, interner: InternTable, default_ref: str | None = None):
         self.interner = interner
         self.default_ref = default_ref
+        # pattern-node filters resolve unqualified attrs to the CURRENT event's
+        # stream even when earlier state refs carry the same attribute
+        # (reference: MatchingMetaInfoHolder default stream-event index)
+        self.prefer_default = False
         self._streams: dict[str, dict[str, AttrType]] = {}
         self._parent: Scope | None = None
 
@@ -127,6 +131,16 @@ class Scope:
             raise KeyError(f"unknown stream reference '{var.stream_id}'")
         # unqualified: unique attribute across in-scope streams (reference
         # resolves unprefixed attrs the same way)
+        if self.prefer_default and self.default_ref is not None:
+            scope = self
+            while scope is not None:
+                attrs = scope._streams.get(self.default_ref)
+                if attrs is not None and var.attribute in attrs:
+                    return (
+                        (self.default_ref, var.stream_index, var.attribute),
+                        attrs[var.attribute],
+                    )
+                scope = scope._parent
         scope = self
         while scope is not None:
             hits = [
