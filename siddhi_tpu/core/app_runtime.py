@@ -37,6 +37,7 @@ from siddhi_tpu.query_api.execution import (
     Partition,
     Query,
     SingleInputStream,
+    StateInputStream,
 )
 from siddhi_tpu.query_api.siddhi_app import SiddhiApp
 
@@ -148,6 +149,9 @@ class SiddhiAppRuntime:
         if isinstance(stream, JoinInputStream):
             self._add_join_query(qid, query)
             return
+        if isinstance(stream, StateInputStream):
+            self._add_pattern_query(qid, query)
+            return
         if not isinstance(stream, SingleInputStream):
             raise SiddhiAppCreationError(
                 f"{type(stream).__name__} queries land in later milestones"
@@ -180,6 +184,46 @@ class SiddhiAppRuntime:
                 batch = self._timer_batch(_schema, t_ms)
                 with self._process_lock:
                     out_batch, aux = _qr.receive(batch, t_ms)
+                    _qr.route_output(out_batch, t_ms, decode)
+                self._maybe_schedule(_qr, aux)
+
+            qr.timer_target = fire
+
+    def _add_pattern_query(self, qid: str, query: Query) -> None:
+        from siddhi_tpu.core.pattern_runtime import PatternQueryRuntime
+
+        token_capacity = self._capacity_annotation("app:patternCapacity", 128)
+        count_capacity = self._capacity_annotation("app:countCapacity", 8)
+        qr = PatternQueryRuntime(
+            query,
+            qid,
+            self.stream_schemas,
+            self.interner,
+            group_capacity=self.group_capacity,
+            token_capacity=token_capacity,
+            count_capacity=count_capacity,
+            batch_size=self.batch_size,
+        )
+        self.queries[qid] = qr
+        self._wire_insert(qr)
+        decode = self._decode
+
+        def receive(batch: EventBatch, now: int, sid: str, _qr=qr) -> None:
+            with self._process_lock:
+                out_batch, aux = _qr.receive(batch, now, sid)
+                _qr.route_output(out_batch, now, decode)
+            self._maybe_schedule(_qr, aux)
+
+        for sid in qr.prog.stream_ids:
+            self._junction(sid).subscribe(
+                lambda b, now, _sid=sid: receive(b, now, _sid)
+            )
+
+        if qr.needs_scheduler:
+            def fire(t_ms: int, _qr=qr) -> None:
+                batch = _pattern_timer_batch(t_ms)
+                with self._process_lock:
+                    out_batch, aux = _qr.receive_timer(batch, t_ms)
                     _qr.route_output(out_batch, t_ms, decode)
                 self._maybe_schedule(_qr, aux)
 
@@ -285,6 +329,14 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._running = True
+        # absent-at-start patterns must arm their timers before any event
+        # (reference: SiddhiAppRuntime.start -> eternalReferencedHolders.start)
+        from siddhi_tpu.core.pattern_runtime import PatternQueryRuntime
+
+        for qr in self.queries.values():
+            if isinstance(qr, PatternQueryRuntime) and qr.needs_scheduler:
+                aux = qr.prime(self.clock())
+                self._maybe_schedule(qr, aux)
 
     def shutdown(self) -> None:
         self._running = False
@@ -295,6 +347,18 @@ class SiddhiAppRuntime:
 
     def restore_last_revision(self):  # M11
         raise NotImplementedError("persistence lands in M11")
+
+
+def _pattern_timer_batch(t_ms: int) -> EventBatch:
+    from siddhi_tpu.core.event import KIND_TIMER
+    import jax.numpy as _jnp
+
+    return EventBatch(
+        ts=_jnp.asarray([t_ms], dtype=_jnp.int64),
+        kind=_jnp.asarray([KIND_TIMER], dtype=_jnp.int8),
+        valid=_jnp.asarray([True]),
+        cols={},
+    )
 
 
 def _make_insert_transform(output_events: OutputEventsFor):

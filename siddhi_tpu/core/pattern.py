@@ -1,0 +1,655 @@
+"""Pattern / sequence NFA engine over token matrices.
+
+The reference implements temporal patterns as a per-event interpreter over linked
+Pre/Post state-processor chains, each holding a `pendingStateEventList` of partial
+matches (reference: query/input/stream/state/StreamPreStateProcessor.java:43-359,
+StreamPostStateProcessor.java:29-140, CountPreStateProcessor.java:34-150,
+LogicalPreStateProcessor.java:35, AbsentStreamPreStateProcessor.java:37-140).
+
+Here the whole NFA lives in one fixed-capacity **token table** on device: every
+partial match is a row holding (current slot, capture columns for every state
+ref, occurrence counts, timestamps). Processing a micro-batch is a `lax.scan`
+over event rows; each scan step runs a static, vectorized pass per NFA slot —
+eligibility mask -> compiled condition over the token table -> capture/advance
+scatter. `every` is modelled as *persistent* slots whose tokens fork into free
+rows instead of being consumed (reference semantics: `every` re-arms via
+nextEveryStatePreProcessor, StreamPostStateProcessor.java:100-120).
+
+Deliberate deviations from the reference interpreter (documented, test-covered):
+- token/capture capacity is static (`@app:patternCapacity`, `@app:countCapacity`)
+  with overflow surfaced via aux flags, where the reference grows lists unboundedly;
+- count states `<m:n>` are greedy without forking: a token that has absorbed >= min
+  occurrences is eligible for the next slot while still absorbing, but one event
+  commits to exactly one alternative (later slot preferred), where the reference
+  explores both;
+- absent states with a waiting time are supported standalone (`A -> not B for 5
+  sec`); inside logical elements only the kill/`and`-completion semantics are
+  implemented.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import (
+    EventBatch,
+    KIND_CURRENT,
+    KIND_TIMER,
+    StreamSchema,
+)
+from siddhi_tpu.core.executor import (
+    TS_ATTR,
+    Env,
+    Scope,
+    compile_expression,
+)
+from siddhi_tpu.core.types import AttrType, InternTable, PHYSICAL_DTYPE, null_value
+from siddhi_tpu.query_api.execution import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    EveryStateElement,
+    Filter,
+    LogicalStateElement,
+    LogicalType,
+    NextStateElement,
+    StateElement,
+    StateInputStream,
+    StateStreamType,
+    StreamStateElement,
+)
+from siddhi_tpu.query_api.expression import Expression
+
+NO_TIMER = jnp.asarray(np.iinfo(np.int64).max, dtype=jnp.int64)
+
+DEFAULT_TOKEN_CAPACITY = 128
+DEFAULT_COUNT_CAPACITY = 8
+
+
+@dataclasses.dataclass
+class Atom:
+    """One stream obligation inside a slot (reference: a single
+    Stream/AbsentStream state element)."""
+
+    ref: str
+    ref_idx: int
+    stream_id: str
+    filters: list  # raw Expression list, compiled in PatternProgram
+    absent: bool = False
+    waiting_ms: Optional[int] = None
+    cap: int = 1  # occurrence capture capacity K
+
+
+@dataclasses.dataclass
+class Slot:
+    """One linearized NFA state (reference: one Pre/Post state-processor pair)."""
+
+    index: int
+    atoms: list  # [Atom] — two entries for logical elements
+    logical: Optional[LogicalType] = None
+    min_count: int = 1
+    max_count: int = 1  # -1 == unbounded
+    persistent: bool = False  # `every` entry: matches fork, token stays
+    within_ms: Optional[int] = None
+
+    @property
+    def is_count(self) -> bool:
+        return not (self.min_count == 1 and self.max_count == 1)
+
+    @property
+    def is_absent(self) -> bool:
+        return len(self.atoms) == 1 and self.atoms[0].absent
+
+
+def _flatten_state(
+    elem: StateElement,
+    slots: list,
+    refs: list,
+    schemas: dict,
+    count_cap: int,
+) -> None:
+    """Linearize the state-element tree into the slot chain (reference:
+    StateInputStreamParser.parseInputStream recursive walk,
+    util/parser/StateInputStreamParser.java:134-430)."""
+
+    def new_atom(stream, absent=False, waiting=None, cap=1) -> Atom:
+        sid = stream.stream_id
+        if sid not in schemas:
+            raise SiddhiAppCreationError(f"stream '{sid}' is not defined")
+        ref = stream.alias
+        if ref is None:
+            # unaliased: referenceable by stream name when that stream appears
+            # exactly once in the pattern; otherwise synthetic
+            uses = sum(1 for r in refs if r.stream_id == sid)
+            ref = sid if uses == 0 else f"__p{len(refs)}"
+        if any(r.ref == ref for r in refs):
+            raise SiddhiAppCreationError(f"duplicate pattern event reference '{ref}'")
+        filters = [
+            h.expression for h in stream.handlers if isinstance(h, Filter)
+        ]
+        if len(filters) != len(stream.handlers):
+            raise SiddhiAppCreationError(
+                "pattern sources support only filters (no windows/stream functions)"
+            )
+        a = Atom(ref, len(refs), sid, filters, absent=absent, waiting_ms=waiting, cap=cap)
+        refs.append(a)
+        return a
+
+    if isinstance(elem, NextStateElement):
+        first = len(slots)
+        _flatten_state(elem.state, slots, refs, schemas, count_cap)
+        _flatten_state(elem.next, slots, refs, schemas, count_cap)
+        if elem.within_ms is not None:
+            for s in slots[first:]:
+                s.within_ms = s.within_ms or elem.within_ms
+    elif isinstance(elem, EveryStateElement):
+        first = len(slots)
+        _flatten_state(elem.state, slots, refs, schemas, count_cap)
+        if len(slots) > first:
+            slots[first].persistent = True
+        if elem.within_ms is not None:
+            for s in slots[first:]:
+                s.within_ms = s.within_ms or elem.within_ms
+    elif isinstance(elem, CountStateElement):
+        mx = elem.max_count
+        cap = mx if 0 < mx <= count_cap else count_cap
+        atom = new_atom(elem.stream.stream, cap=cap)
+        slots.append(
+            Slot(
+                len(slots),
+                [atom],
+                min_count=elem.min_count,
+                max_count=mx,
+                within_ms=elem.within_ms,
+            )
+        )
+    elif isinstance(elem, LogicalStateElement):
+        atoms = []
+        for side in (elem.left, elem.right):
+            if isinstance(side, AbsentStreamStateElement):
+                if side.waiting_time_ms is not None:
+                    raise SiddhiAppCreationError(
+                        "absent-with-waiting inside 'and'/'or' is not supported yet"
+                    )
+                atoms.append(new_atom(side.stream, absent=True))
+            elif isinstance(side, StreamStateElement):
+                atoms.append(new_atom(side.stream))
+            else:
+                raise SiddhiAppCreationError(
+                    "'and'/'or' sides must be plain or absent streams"
+                )
+        if all(a.absent for a in atoms):
+            raise SiddhiAppCreationError("both sides of a logical element are absent")
+        slots.append(
+            Slot(len(slots), atoms, logical=elem.type, within_ms=elem.within_ms)
+        )
+    elif isinstance(elem, AbsentStreamStateElement):
+        if elem.waiting_time_ms is None:
+            raise SiddhiAppCreationError(
+                "a standalone absent stream needs 'for <time>' "
+                "(reference: AbsentStreamPreStateProcessor waiting time)"
+            )
+        atom = new_atom(elem.stream, absent=True, waiting=elem.waiting_time_ms)
+        slots.append(Slot(len(slots), [atom], within_ms=elem.within_ms))
+    elif isinstance(elem, StreamStateElement):
+        atom = new_atom(elem.stream)
+        slots.append(Slot(len(slots), [atom], within_ms=elem.within_ms))
+    else:
+        raise SiddhiAppCreationError(f"unsupported state element {type(elem).__name__}")
+
+
+class PatternProgram:
+    """Compiled NFA: slot chain + per-atom conditions + token-table layout."""
+
+    def __init__(
+        self,
+        state_stream: StateInputStream,
+        schemas: dict[str, StreamSchema],
+        interner: InternTable,
+        token_capacity: int = DEFAULT_TOKEN_CAPACITY,
+        count_capacity: int = DEFAULT_COUNT_CAPACITY,
+    ):
+        self.sequence = state_stream.type is StateStreamType.SEQUENCE
+        self.within_ms = state_stream.within_ms
+        self.T = token_capacity
+        self.schemas = schemas
+        self.interner = interner
+
+        self.slots: list[Slot] = []
+        self.refs: list[Atom] = []
+        _flatten_state(
+            state_stream.state, self.slots, self.refs, schemas, count_capacity
+        )
+        if not self.slots:
+            raise SiddhiAppCreationError("empty pattern")
+
+        # name-resolution scope over every ref (reference: each state's
+        # MatchingMetaInfoHolder exposes all earlier stream events)
+        self.scope = Scope(interner)
+        for a in self.refs:
+            self.scope.add_stream(a.ref, schemas[a.stream_id].attr_types)
+        self.scope.default_ref = self.refs[0].ref
+
+        # compiled per-atom condition: AND of the atom's filters, evaluated over
+        # the token table with the current event broadcast as the atom's own ref
+        self._conds = {}
+        for slot in self.slots:
+            for atom in slot.atoms:
+                conds = []
+                for f in atom.filters:
+                    s = self.scope.child()
+                    s.default_ref = atom.ref
+                    s.prefer_default = True
+                    c = compile_expression(f, s)
+                    if c.type is not AttrType.BOOL:
+                        raise SiddhiAppCreationError("pattern filter must be boolean")
+                    conds.append(c)
+                self._conds[(slot.index, atom.ref_idx)] = conds
+
+        self.stream_ids = sorted({a.stream_id for a in self.refs})
+        self.needs_scheduler = any(
+            a.waiting_ms is not None for a in self.refs
+        )
+
+    # ---- token table ----------------------------------------------------
+
+    def init_state(self, now: int = 0):
+        T = self.T
+        caps = []
+        for a in self.refs:
+            schema = self.schemas[a.stream_id]
+            cols = {
+                name: jnp.full(
+                    (T, a.cap), null_value(t), dtype=PHYSICAL_DTYPE[t]
+                )
+                for name, t in schema.attrs
+            }
+            caps.append(
+                {
+                    "n": jnp.zeros((T,), dtype=jnp.int32),
+                    "ts": jnp.zeros((T, a.cap), dtype=jnp.int64),
+                    "cols": cols,
+                }
+            )
+        tok = {
+            "active": jnp.zeros((T,), dtype=jnp.bool_).at[0].set(True),
+            "slot": jnp.zeros((T,), dtype=jnp.int32),
+            # -1 == virgin (no event captured yet); 0 is a legitimate epoch ts
+            "start_ts": jnp.full((T,), -1, dtype=jnp.int64),
+            "entry_ts": jnp.full((T,), now, dtype=jnp.int64).at[1:].set(0),
+            "caps": caps,
+        }
+        return tok
+
+    # ---- environments ----------------------------------------------------
+
+    def _token_env(self, tok, now, override_ref: Optional[int] = None,
+                   event_cols: Optional[dict] = None, event_ts=None) -> Env:
+        """Column view of the token table; `override_ref` substitutes the
+        current event (broadcast scalars) for that ref's columns."""
+        T = self.T
+        cols = {}
+        for a in self.refs:
+            c = tok["caps"][a.ref_idx]
+            for name in c["cols"]:
+                cols[(a.ref, None, name)] = c["cols"][name][:, 0]
+                for k in range(a.cap):
+                    cols[(a.ref, k, name)] = c["cols"][name][:, k]
+            cols[(a.ref, None, TS_ATTR)] = c["ts"][:, 0]
+            for k in range(a.cap):
+                cols[(a.ref, k, TS_ATTR)] = c["ts"][:, k]
+            cols[(a.ref, None, "__arrived__")] = c["n"] > 0
+        if override_ref is not None:
+            a = self.refs[override_ref]
+            for name, v in event_cols.items():
+                cols[(a.ref, None, name)] = jnp.broadcast_to(v, (T,))
+            cols[(a.ref, None, TS_ATTR)] = jnp.broadcast_to(event_ts, (T,))
+            cols[(a.ref, None, "__arrived__")] = jnp.ones((T,), dtype=jnp.bool_)
+        return Env(cols, now=now)
+
+    # ---- per-event application -------------------------------------------
+
+    def _eligible(self, tok, p: int) -> jnp.ndarray:
+        """Tokens that may match slot p: at p, or parked at preceding count
+        slots whose min is satisfied (count-skip, reference:
+        CountPreStateProcessor min-count forwarding)."""
+        active, slot = tok["active"], tok["slot"]
+        elig = active & (slot == p)
+        q = p - 1
+        while q >= 0 and self.slots[q].is_count:
+            sat = tok["caps"][self.slots[q].atoms[0].ref_idx]["n"] >= max(
+                self.slots[q].min_count, 0
+            )
+            elig = elig | (active & (slot == q) & sat)
+            if self.slots[q].min_count > 0:
+                break
+            q -= 1
+        return elig
+
+    def _capture(self, caps_r, atom: Atom, match, ts, event_cols):
+        """Write the current event into ref r's next occurrence slot."""
+        T = self.T
+        n = caps_r["n"]
+        pos = jnp.clip(n, 0, atom.cap - 1)
+        write = match & (n < atom.cap)
+        rowi = jnp.arange(T)
+        new_cols = {}
+        for name, arr in caps_r["cols"].items():
+            upd = arr.at[rowi, pos].set(
+                jnp.broadcast_to(event_cols[name], (T,)).astype(arr.dtype)
+            )
+            new_cols[name] = jnp.where(write[:, None], upd, arr)
+        upd_ts = caps_r["ts"].at[rowi, pos].set(jnp.broadcast_to(ts, (T,)))
+        return {
+            "n": jnp.where(match, n + 1, n),
+            "ts": jnp.where(write[:, None], upd_ts, caps_r["ts"]),
+            "cols": new_cols,
+        }
+
+    def apply_event(self, tok, ts, kind, valid, stream_cols: dict[str, dict], out, out_n, overflow):
+        """One scan step: apply a single event row to the token table.
+
+        stream_cols: {stream_id: {attr: scalar}} — the row's columns, keyed by
+        the stream this step function serves (one entry).
+        """
+        is_cur = valid & (kind == KIND_CURRENT)
+        is_timer = valid & (kind == KIND_TIMER)
+
+        # within expiry (reference: StreamPreStateProcessor.isExpired :102-121)
+        active = tok["active"]
+        kills = []
+        started = tok["start_ts"] >= 0
+        if self.within_ms is not None:
+            kills.append(started & (ts - tok["start_ts"] > self.within_ms))
+        for slot in self.slots:
+            if slot.within_ms is not None:
+                kills.append(
+                    (tok["slot"] == slot.index)
+                    & started
+                    & (ts - tok["start_ts"] > slot.within_ms)
+                )
+        if kills:
+            dead = kills[0]
+            for k in kills[1:]:
+                dead = dead | k
+            active = tok["active"] & ~(dead & valid)
+        tok = {**tok, "active": active}
+
+        touched = jnp.zeros((self.T,), dtype=jnp.bool_)
+        last = len(self.slots) - 1
+
+        # ---- timer handling: absent slots whose deadline passed emit/advance
+        for slot in self.slots:
+            atom = slot.atoms[0]
+            if not (slot.is_absent and atom.waiting_ms is not None):
+                continue
+            p = slot.index
+            at_p = tok["active"] & (tok["slot"] == p)
+            fire = at_p & is_timer & (ts >= tok["entry_ts"] + atom.waiting_ms)
+            if p == last:
+                # emit with this ref not arrived; output ts = deadline
+                out, out_n, overflow = self._write_emits(
+                    out, out_n, overflow, fire, tok,
+                    tok["entry_ts"] + atom.waiting_ms,
+                )
+                tok = self._consume(tok, fire, slot)
+            else:
+                tok = self._advance_rows(tok, fire, slot, tok["entry_ts"] + atom.waiting_ms)
+            touched = touched | fire
+
+        # ---- event matching, descending slot order so one event moves a
+        # token at most one hop (reference: next-event semantics)
+        for slot in reversed(self.slots):
+            p = slot.index
+            for atom in slot.atoms:
+                if atom.stream_id not in stream_cols:
+                    continue
+                ev = stream_cols[atom.stream_id]
+                elig = self._eligible(tok, p) & ~touched & is_cur
+                if slot.is_count and atom.cap:
+                    mx = slot.max_count
+                    if mx > 0:
+                        # cannot absorb beyond max (only tokens AT p absorb)
+                        n_here = tok["caps"][atom.ref_idx]["n"]
+                        elig = elig & ~((tok["slot"] == p) & (n_here >= mx))
+                env = self._token_env(
+                    tok, None, override_ref=atom.ref_idx,
+                    event_cols=ev, event_ts=ts,
+                )
+                match = elig
+                for c in self._conds[(p, atom.ref_idx)]:
+                    match = match & c(env)
+                if atom.absent:
+                    # arrival on an absent stream kills the token
+                    # (reference: AbsentStreamPreStateProcessor.process kill)
+                    tok = {**tok, "active": tok["active"] & ~match}
+                    touched = touched | match
+                    continue
+
+                # capture the event into the atom's ref
+                new_caps = list(tok["caps"])
+                new_caps[atom.ref_idx] = self._capture(
+                    tok["caps"][atom.ref_idx], atom, match, ts, ev
+                )
+                adv_tok = {
+                    **tok,
+                    "caps": new_caps,
+                    "slot": jnp.where(match, p, tok["slot"]),
+                    "start_ts": jnp.where(
+                        match & (tok["start_ts"] < 0), ts, tok["start_ts"]
+                    ),
+                }
+
+                if slot.logical is not None:
+                    arrived = [
+                        new_caps[a2.ref_idx]["n"] > 0
+                        for a2 in slot.atoms
+                        if not a2.absent
+                    ]
+                    if slot.logical is LogicalType.OR:
+                        complete = match
+                    else:
+                        allv = arrived[0]
+                        for v in arrived[1:]:
+                            allv = allv & v
+                        complete = match & allv
+                    advance = complete
+                elif slot.is_count:
+                    advance = jnp.zeros_like(match)  # absorb in place
+                else:
+                    advance = match
+
+                stay = match & ~advance
+                if p == last:
+                    out, out_n, overflow = self._write_emits(
+                        out, out_n, overflow, advance, adv_tok, ts
+                    )
+                    new_tok = self._merge(tok, adv_tok, stay)
+                    new_tok = self._consume(new_tok, advance, slot)
+                    tok = new_tok
+                elif slot.persistent:
+                    # fork: advanced copy goes to a free row; the source
+                    # (virgin/generator) stays armed
+                    tok, overflow, dest_mask = self._fork(
+                        tok, adv_tok, advance, p + 1, ts, overflow
+                    )
+                    tok = self._merge(tok, adv_tok, stay)
+                    touched = touched | dest_mask
+                else:
+                    moved = self._merge(tok, adv_tok, match)
+                    moved = {
+                        **moved,
+                        "slot": jnp.where(advance, p + 1, moved["slot"]),
+                        "entry_ts": jnp.where(advance, ts, moved["entry_ts"]),
+                    }
+                    tok = moved
+                touched = touched | match
+
+        # ---- sequence strictness: any unconsumed CURRENT event kills
+        # non-virgin, non-generator tokens (reference: sequence
+        # StreamPreStateProcessor resetState on mismatch)
+        if self.sequence:
+            virgin = tok["start_ts"] < 0
+            pers = jnp.zeros((self.T,), dtype=jnp.bool_)
+            for slot in self.slots:
+                if slot.persistent:
+                    pers = pers | (tok["slot"] == slot.index)
+            kill = is_cur & tok["active"] & ~touched & ~virgin & ~pers
+            tok = {**tok, "active": tok["active"] & ~kill}
+
+        return tok, out, out_n, overflow
+
+    # ---- token-table update helpers --------------------------------------
+
+    @staticmethod
+    def _merge(old, new, mask):
+        """Per-row select between two token tables."""
+
+        def sel(a, b):
+            if a.ndim == 1:
+                return jnp.where(mask, b, a)
+            return jnp.where(mask[:, None], b, a)
+
+        caps = [
+            {
+                "n": sel(o["n"], n_["n"]),
+                "ts": sel(o["ts"], n_["ts"]),
+                "cols": {k: sel(o["cols"][k], n_["cols"][k]) for k in o["cols"]},
+            }
+            for o, n_ in zip(old["caps"], new["caps"])
+        ]
+        return {
+            "active": sel(old["active"], new["active"]),
+            "slot": sel(old["slot"], new["slot"]),
+            "start_ts": sel(old["start_ts"], new["start_ts"]),
+            "entry_ts": sel(old["entry_ts"], new["entry_ts"]),
+            "caps": caps,
+        }
+
+    def _consume(self, tok, mask, slot: Slot):
+        """Tokens that emitted: die, unless at a persistent slot (the `every`
+        generator stays armed)."""
+        if slot.persistent:
+            return tok
+        return {**tok, "active": tok["active"] & ~mask}
+
+    def _advance_rows(self, tok, mask, slot: Slot, ts):
+        p = slot.index
+        return {
+            **tok,
+            "slot": jnp.where(mask, p + 1, tok["slot"]),
+            "entry_ts": jnp.where(mask, ts, tok["entry_ts"]),
+        }
+
+    def _fork(self, tok, adv_tok, mask, next_slot: int, ts, overflow):
+        """Scatter advanced copies of `mask` rows into free rows
+        (reference: every re-arm keeps the pre-state armed while the matched
+        StateEvent moves on)."""
+        T = self.T
+        free = ~tok["active"]
+        order = jnp.argsort(~free)  # free row indices first (stable)
+        nfree = jnp.sum(free)
+        rank = jnp.cumsum(mask) - 1
+        ok = mask & (rank < nfree)
+        dest = jnp.where(ok, order[jnp.clip(rank, 0, T - 1)], T)
+        overflow = overflow | jnp.any(mask & ~ok)
+
+        def scat(lane, adv_lane, fill=None):
+            return lane.at[dest].set(adv_lane, mode="drop")
+
+        caps = [
+            {
+                "n": scat(o["n"], a["n"]),
+                "ts": scat(o["ts"], a["ts"]),
+                "cols": {k: scat(o["cols"][k], a["cols"][k]) for k in o["cols"]},
+            }
+            for o, a in zip(tok["caps"], adv_tok["caps"])
+        ]
+        dest_mask = jnp.zeros((T,), dtype=jnp.bool_).at[dest].set(True, mode="drop")
+        return {
+            "active": tok["active"].at[dest].set(True, mode="drop"),
+            "slot": tok["slot"].at[dest].set(
+                jnp.full((T,), next_slot, dtype=jnp.int32), mode="drop"
+            ),
+            "start_ts": scat(tok["start_ts"], adv_tok["start_ts"]),
+            "entry_ts": tok["entry_ts"].at[dest].set(
+                jnp.broadcast_to(ts, (T,)), mode="drop"
+            ),
+            "caps": caps,
+        }, overflow, dest_mask
+
+    # ---- emission --------------------------------------------------------
+
+    def out_capacity(self, batch_capacity: int) -> int:
+        return max(batch_capacity, 64)
+
+    def init_out(self, cap: int):
+        out = {
+            "ts": jnp.zeros((cap,), dtype=jnp.int64),
+            "valid": jnp.zeros((cap,), dtype=jnp.bool_),
+        }
+        for a in self.refs:
+            schema = self.schemas[a.stream_id]
+            out[f"n{a.ref_idx}"] = jnp.zeros((cap,), dtype=jnp.int32)
+            out[f"ts{a.ref_idx}"] = jnp.zeros((cap, a.cap), dtype=jnp.int64)
+            for name, t in schema.attrs:
+                out[f"c{a.ref_idx}.{name}"] = jnp.full(
+                    (cap, a.cap), null_value(t), dtype=PHYSICAL_DTYPE[t]
+                )
+        return out
+
+    def _write_emits(self, out, out_n, overflow, emit, tok, ts):
+        cap = out["valid"].shape[0]
+        rank = jnp.cumsum(emit) - 1
+        dest_raw = out_n + rank
+        ok = emit & (dest_raw < cap)
+        dest = jnp.where(ok, dest_raw, cap)
+        overflow = overflow | jnp.any(emit & ~ok)
+        out = dict(out)
+        out["ts"] = out["ts"].at[dest].set(jnp.broadcast_to(ts, (self.T,)), mode="drop")
+        out["valid"] = out["valid"].at[dest].set(True, mode="drop")
+        for a in self.refs:
+            c = tok["caps"][a.ref_idx]
+            out[f"n{a.ref_idx}"] = out[f"n{a.ref_idx}"].at[dest].set(c["n"], mode="drop")
+            out[f"ts{a.ref_idx}"] = out[f"ts{a.ref_idx}"].at[dest].set(c["ts"], mode="drop")
+            for name in c["cols"]:
+                key = f"c{a.ref_idx}.{name}"
+                out[key] = out[key].at[dest].set(c["cols"][name], mode="drop")
+        return (
+            out,
+            jnp.minimum(out_n + jnp.sum(emit).astype(jnp.int32), cap).astype(jnp.int32),
+            overflow,
+        )
+
+    def out_env_cols(self, out) -> dict:
+        """VarKeys for the selector over the emission buffer."""
+        cols = {}
+        for a in self.refs:
+            for name in self.schemas[a.stream_id].attr_names:
+                arr = out[f"c{a.ref_idx}.{name}"]
+                cols[(a.ref, None, name)] = arr[:, 0]
+                for k in range(a.cap):
+                    cols[(a.ref, k, name)] = arr[:, k]
+            tsr = out[f"ts{a.ref_idx}"]
+            cols[(a.ref, None, TS_ATTR)] = tsr[:, 0]
+            for k in range(a.cap):
+                cols[(a.ref, k, TS_ATTR)] = tsr[:, k]
+            cols[(a.ref, None, "__arrived__")] = out[f"n{a.ref_idx}"] > 0
+        return cols
+
+    def next_timer(self, tok) -> jnp.ndarray:
+        """Earliest absent-slot deadline over active tokens, NO_TIMER if none."""
+        t = NO_TIMER
+        for slot in self.slots:
+            atom = slot.atoms[0]
+            if not (slot.is_absent and atom.waiting_ms is not None):
+                continue
+            at_p = tok["active"] & (tok["slot"] == slot.index)
+            dl = jnp.where(at_p, tok["entry_ts"] + atom.waiting_ms, NO_TIMER)
+            t = jnp.minimum(t, jnp.min(dl))
+        return t

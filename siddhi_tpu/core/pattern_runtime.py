@@ -1,0 +1,190 @@
+"""Pattern/sequence query runtime: NFA token table + selector as one jitted step.
+
+Reference analog: the per-query object graph built by
+util/parser/StateInputStreamParser.java + QueryParser.java for state streams,
+with Pattern*ProcessStreamReceiver per input stream. Here each input stream gets
+its own jitted step `(state, batch, now) -> (state', out, aux)` sharing the same
+token-table state; TIMER delivery for absent states is a third step variant.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import EventBatch, KIND_TIMER, StreamSchema
+from siddhi_tpu.core.flow import Flow
+from siddhi_tpu.core.pattern import NO_TIMER, PatternProgram
+from siddhi_tpu.core.query_runtime import BaseQueryRuntime
+from siddhi_tpu.core.selector import CompiledSelector
+from siddhi_tpu.core.types import InternTable
+from siddhi_tpu.query_api.execution import Query, StateInputStream
+
+
+class PatternQueryRuntime(BaseQueryRuntime):
+    def __init__(
+        self,
+        query: Query,
+        query_id: str,
+        schemas: dict[str, StreamSchema],
+        interner: InternTable,
+        group_capacity: Optional[int] = None,
+        token_capacity: int = 128,
+        count_capacity: int = 8,
+        batch_size: int = 64,
+    ):
+        self.query = query
+        self.query_id = query_id
+        state_stream = query.input_stream
+        assert isinstance(state_stream, StateInputStream)
+        self.prog = PatternProgram(
+            state_stream,
+            schemas,
+            interner,
+            token_capacity=token_capacity,
+            count_capacity=count_capacity,
+        )
+        # emission buffer scales with the token table: every pending token can
+        # emit on one event, so raising @app:patternCapacity raises this too
+        self.out_cap = max(batch_size, 64, token_capacity)
+
+        # select * over a pattern exposes every ref's attributes in order
+        # (duplicate names require explicit projection)
+        flat_attrs = []
+        seen = set()
+        dup = set()
+        for a in self.prog.refs:
+            for name, t in schemas[a.stream_id].attrs:
+                if name in seen:
+                    dup.add(name)
+                else:
+                    seen.add(name)
+                    flat_attrs.append((name, t))
+        if query.selector.select_all and dup:
+            raise SiddhiAppCreationError(
+                f"select * over this pattern is ambiguous for {sorted(dup)}; "
+                "project explicitly"
+            )
+        self.selector = CompiledSelector(
+            query.selector,
+            self.prog.scope,
+            flat_attrs,
+            batch_mode=False,
+            group_capacity=group_capacity,
+        )
+        self._setup_output(query, query_id)
+        self.needs_scheduler = self.prog.needs_scheduler
+        self.timer_target = None
+        self._steps = {
+            sid: jax.jit(self._make_step(sid)) for sid in self.prog.stream_ids
+        }
+        self._timer_step = jax.jit(self._make_step(None))
+
+    # ---- device program --------------------------------------------------
+
+    def init_state(self, now: int = 0):
+        return {
+            "tok": self.prog.init_state(now),
+            "sel": self.selector.init_state(),
+        }
+
+    def _make_step(self, stream_id: Optional[str]):
+        prog = self.prog
+
+        def step(state, batch: EventBatch, now):
+            out0 = prog.init_out(self.out_cap)
+            carry0 = (
+                state["tok"],
+                out0,
+                jnp.asarray(0, dtype=jnp.int32),
+                jnp.asarray(False),
+            )
+            xs = {
+                "ts": batch.ts,
+                "kind": batch.kind,
+                "valid": batch.valid,
+                **{f"c.{n}": c for n, c in batch.cols.items()},
+            }
+
+            def body(carry, row):
+                tok, out, out_n, ovf = carry
+                stream_cols = (
+                    {
+                        stream_id: {
+                            n: row[f"c.{n}"] for n in batch.cols
+                        }
+                    }
+                    if stream_id is not None
+                    else {}
+                )
+                tok, out, out_n, ovf = prog.apply_event(
+                    tok,
+                    row["ts"],
+                    row["kind"],
+                    row["valid"],
+                    stream_cols,
+                    out,
+                    out_n,
+                    ovf,
+                )
+                return (tok, out, out_n, ovf), None
+
+            (tok, out, _, ovf), _ = lax.scan(body, carry0, xs)
+
+            emit_batch = EventBatch(
+                ts=out["ts"],
+                kind=jnp.zeros_like(out["ts"], dtype=jnp.int8),
+                valid=out["valid"],
+                cols={},
+            )
+            flow = Flow(
+                batch=emit_batch,
+                ref=prog.refs[0].ref,
+                now=now,
+                extra_cols=prog.out_env_cols(out),
+            )
+            sel_state, out_batch = self.selector.apply(state["sel"], flow)
+            aux = dict(flow.aux)
+            aux["pattern_overflow"] = ovf
+            aux["next_timer"] = prog.next_timer(tok)
+            return {"tok": tok, "sel": sel_state}, out_batch, aux
+
+        return step
+
+    # ---- host side -------------------------------------------------------
+
+    def receive(self, batch: EventBatch, now: int, stream_id: str):
+        with self._receive_lock:
+            if self.state is None:
+                self.state = self.init_state(now)
+            step = self._steps[stream_id]
+            self.state, out, aux = step(
+                self.state, batch, jnp.asarray(now, dtype=jnp.int64)
+            )
+        self._warn_aux(aux)
+        return out, aux
+
+    def receive_timer(self, schema_batch: EventBatch, t_ms: int):
+        with self._receive_lock:
+            if self.state is None:
+                self.state = self.init_state(t_ms)
+            self.state, out, aux = self._timer_step(
+                self.state, schema_batch, jnp.asarray(t_ms, dtype=jnp.int64)
+            )
+        self._warn_aux(aux)
+        return out, aux
+
+    def prime(self, now: int) -> dict:
+        """Arm the initial token's clock (absent-at-start patterns need a timer
+        before any event arrives — reference:
+        AbsentStreamPreStateProcessor.start scheduling)."""
+        with self._receive_lock:
+            if self.state is None:
+                self.state = self.init_state(now)
+            t = self.prog.next_timer(self.state["tok"])
+        return {"next_timer": t}

@@ -144,6 +144,20 @@ class BaseQueryRuntime:
                 self.selector.group.capacity if self.selector.group else -1,
             )
         if (
+            not getattr(self, "_warned_pattern_overflow", False)
+            and "pattern_overflow" in aux
+            and bool(aux["pattern_overflow"])
+        ):
+            self._warned_pattern_overflow = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "query '%s': pattern token table or emission buffer "
+                "overflowed; partial matches or emissions were dropped — "
+                "raise @app:patternCapacity(size='N') (sizes both)",
+                self.query_id,
+            )
+        if (
             not self._warned_join_overflow
             and "join_overflow" in aux
             and bool(aux["join_overflow"])

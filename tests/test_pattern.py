@@ -1,0 +1,292 @@
+"""Pattern/sequence NFA tests, mirroring the reference corpus semantics
+(reference: siddhi-core/src/test/java/org/wso2/siddhi/core/query/pattern/
+{EveryPatternTestCase,CountPatternTestCase,LogicalPatternTestCase,
+WithinPatternTestCase,absent/*}.java and query/sequence/*.java)."""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def run_app(ql, sends, query_name="query1", wait_timers=0.0):
+    """sends: list of (stream, [(data...), ...]) pushed in order."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+
+    def cb(ts, ins, removed):
+        for e in ins or []:
+            got.append(tuple(e.data))
+
+    rt.add_callback(query_name, cb)
+    rt.start()
+    handlers = {}
+    for stream, rows in sends:
+        h = handlers.setdefault(stream, rt.get_input_handler(stream))
+        for row in rows:
+            h.send(row)
+    if wait_timers:
+        time.sleep(wait_timers)
+    rt.shutdown()
+    return got
+
+
+S2 = """
+define stream StreamA (symbol string, price float, volume int);
+define stream StreamB (symbol string, price float, volume int);
+"""
+
+
+class TestPattern:
+    def test_simple_pattern(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from e1=StreamA[price > 20] -> e2=StreamB[price > e1.price]
+        select e1.symbol as sym1, e2.symbol as sym2, e2.price as price2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("IBM", 25.0, 100)]),
+            ("StreamB", [("WSO2", 20.0, 100)]),   # not > 25 — no match
+            ("StreamB", [("GOOG", 30.0, 100)]),
+        ])
+        assert got == [("IBM", "GOOG", 30.0)]
+
+    def test_pattern_without_every_matches_once(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from e1=StreamA -> e2=StreamB
+        select e1.volume as v1, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 1.0, 1)]),
+            ("StreamB", [("B", 1.0, 2)]),
+            ("StreamA", [("A", 1.0, 3)]),
+            ("StreamB", [("B", 1.0, 4)]),
+        ])
+        assert got == [(1, 2)]
+
+    def test_every_rearms(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from every e1=StreamA -> e2=StreamB
+        select e1.volume as v1, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 1.0, 1)]),
+            ("StreamB", [("B", 1.0, 2)]),
+            ("StreamA", [("A", 1.0, 3)]),
+            ("StreamB", [("B", 1.0, 4)]),
+        ])
+        assert got == [(1, 2), (3, 4)]
+
+    def test_every_two_pending(self):
+        # two A's before a B: both tokens match the B
+        ql = S2 + """
+        @info(name = 'query1')
+        from every e1=StreamA -> e2=StreamB
+        select e1.volume as v1, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 1.0, 1), ("A", 1.0, 2)]),
+            ("StreamB", [("B", 1.0, 9)]),
+        ])
+        assert sorted(got) == [(1, 9), (2, 9)]
+
+    def test_same_stream_chain(self):
+        # A -> A on the same stream: in-batch sequencing via scan
+        ql = S2 + """
+        @info(name = 'query1')
+        from every e1=StreamA[price > 20] -> e2=StreamA[price > e1.price]
+        select e1.price as p1, e2.price as p2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 25.0, 1), ("A", 30.0, 2), ("A", 10.0, 3)]),
+        ])
+        assert (25.0, 30.0) in got
+
+    def test_logical_and(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from e1=StreamA and e2=StreamB
+        select e1.volume as v1, e2.volume as v2
+        insert into OutStream;
+        """
+        # arrives in either order
+        got = run_app(ql, [
+            ("StreamB", [("B", 1.0, 7)]),
+            ("StreamA", [("A", 1.0, 5)]),
+        ])
+        assert got == [(5, 7)]
+
+    def test_logical_or_null_side(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from e1=StreamA or e2=StreamB
+        select e1.volume as v1, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [("StreamB", [("B", 1.0, 7)])])
+        assert got == [(None, 7)]
+
+    def test_count_pattern(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from e1=StreamA<2:4> -> e2=StreamB
+        select e1[0].volume as c0, e1[1].volume as c1, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 1.0, 1)]),
+            ("StreamB", [("B", 1.0, 9)]),   # only 1 A so far — no match
+            ("StreamA", [("A", 1.0, 2)]),
+            ("StreamB", [("B", 1.0, 10)]),
+        ])
+        assert got == [(1, 2, 10)]
+
+    def test_count_absorbs_up_to_max_then_waits(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from e1=StreamA<1:2> -> e2=StreamB
+        select e1[0].volume as c0, e1[1].volume as c1, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 1.0, 1), ("A", 1.0, 2), ("A", 1.0, 3)]),
+            ("StreamB", [("B", 1.0, 9)]),
+        ])
+        # max 2: third A is not absorbed
+        assert got == [(1, 2, 9)]
+
+    def test_within_expires(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from every e1=StreamA -> e2=StreamB within 1 sec
+        select e1.volume as v1, e2.volume as v2
+        insert into OutStream;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("query1", lambda ts, ins, rm: got.extend(
+            tuple(e.data) for e in ins or []))
+        rt.start()
+        ha = rt.get_input_handler("StreamA")
+        hb = rt.get_input_handler("StreamB")
+        t0 = 1_700_000_000_000
+        ha.send(("A", 1.0, 1), timestamp=t0)
+        hb.send(("B", 1.0, 2), timestamp=t0 + 2000)  # too late
+        ha.send(("A", 1.0, 3), timestamp=t0 + 3000)
+        hb.send(("B", 1.0, 4), timestamp=t0 + 3500)  # in time
+        rt.shutdown()
+        assert got == [(3, 4)]
+
+    def _absent_app(self):
+        """Build the absent-pattern app with all steps pre-compiled, so
+        real-time deadlines are not raced by jit compile latency."""
+        ql = S2 + """
+        @info(name = 'query1')
+        from e1=StreamA[volume == 5] -> not StreamB for 300 milliseconds
+        select e1.volume as v1
+        insert into OutStream;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("query1", lambda ts, ins, rm: got.extend(
+            tuple(e.data) for e in ins or []))
+        rt.start()
+        ha = rt.get_input_handler("StreamA")
+        hb = rt.get_input_handler("StreamB")
+        ha.send(("warm", 1.0, 0))   # filtered out — compiles the A step
+        hb.send(("warm", 1.0, 0))   # no armed token — compiles the B step
+        qr = rt.queries["query1"]
+        qr._timer_step(qr.state, __import__("siddhi_tpu.core.app_runtime",
+                       fromlist=["_pattern_timer_batch"])._pattern_timer_batch(0),
+                       0)  # compile the timer step (t=0: fires nothing)
+        return rt, ha, hb, got
+
+    @staticmethod
+    def _poll(got, n, timeout=5.0):
+        t0 = time.time()
+        while len(got) < n and time.time() - t0 < timeout:
+            time.sleep(0.05)
+
+    def test_absent_emits_on_timeout(self):
+        rt, ha, hb, got = self._absent_app()
+        ha.send(("A", 1.0, 5))
+        self._poll(got, 1)
+        rt.shutdown()
+        assert got == [(5,)]
+
+    def test_absent_killed_by_arrival(self):
+        rt, ha, hb, got = self._absent_app()
+        ha.send(("A", 1.0, 5))
+        hb.send(("B", 1.0, 1))
+        time.sleep(0.8)
+        rt.shutdown()
+        assert got == []
+
+
+class TestSequence:
+    def test_strict_sequence(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from every e1=StreamA, e2=StreamA
+        select e1.volume as v1, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 1.0, 1), ("A", 1.0, 2), ("A", 1.0, 3)]),
+        ])
+        # consecutive pairs; e2 of one match can be e1 of the next (every)
+        assert (1, 2) in got and (2, 3) in got
+
+    def test_sequence_broken_by_intermediate(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from e1=StreamA[volume == 1], e2=StreamA[volume == 3]
+        select e1.volume as v1, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 1.0, 1), ("A", 1.0, 2), ("A", 1.0, 3)]),
+        ])
+        assert got == []  # volume 2 breaks consecutiveness
+
+    def test_kleene_plus(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from every e1=StreamA[price > 20]+, e2=StreamB
+        select e1[0].price as p0, e2.volume as v2
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("A", 25.0, 1), ("A", 30.0, 2)]),
+            ("StreamB", [("B", 1.0, 9)]),
+        ])
+        assert got == [(25.0, 9)]
+
+
+class TestPatternAggregation:
+    def test_pattern_with_group_by(self):
+        ql = S2 + """
+        @info(name = 'query1')
+        from every e1=StreamA -> e2=StreamB
+        select e1.symbol as symbol, sum(e2.volume) as total
+        group by e1.symbol
+        insert into OutStream;
+        """
+        got = run_app(ql, [
+            ("StreamA", [("IBM", 1.0, 1)]),
+            ("StreamB", [("X", 1.0, 10)]),
+            ("StreamA", [("IBM", 1.0, 2)]),
+            ("StreamB", [("X", 1.0, 5)]),
+        ])
+        assert got[-1] == ("IBM", 15)
