@@ -75,6 +75,17 @@ class SiddhiAppRuntime:
                 sid, [(a.name, a.type) for a in d.attributes]
             )
 
+        from siddhi_tpu.core.table import DEFAULT_TABLE_CAPACITY, InMemoryTable
+
+        table_capacity = self._capacity_annotation(
+            "app:tableCapacity", DEFAULT_TABLE_CAPACITY
+        )
+        self.tables: dict[str, InMemoryTable] = {
+            tid: InMemoryTable(d, self.interner, capacity=table_capacity)
+            for tid, d in app.table_definitions.items()
+        }
+        self._store_query_cache: dict[str, object] = {}
+
         unnamed = 0
         for elem in app.execution_elements:
             if isinstance(elem, Query):
@@ -115,6 +126,8 @@ class SiddhiAppRuntime:
         if not isinstance(out, InsertIntoStream):
             return
         target = out.target
+        if target in self.tables:
+            return  # table writes are compiled into the query step
         existing = self.stream_schemas.get(target)
         inferred = qr.out_schema
         if existing is None:
@@ -164,6 +177,7 @@ class SiddhiAppRuntime:
         qr = QueryRuntime(
             query, qid, in_schema, self.interner,
             group_capacity=self.group_capacity,
+            tables=self.tables,
         )
         self.queries[qid] = qr
         self._wire_insert(qr)
@@ -203,6 +217,7 @@ class SiddhiAppRuntime:
             token_capacity=token_capacity,
             count_capacity=count_capacity,
             batch_size=self.batch_size,
+            tables=self.tables,
         )
         self.queries[qid] = qr
         self._wire_insert(qr)
@@ -236,6 +251,8 @@ class SiddhiAppRuntime:
         schemas = []
         for s in (join.left, join.right):
             sch = self.stream_schemas.get(s.stream_id)
+            if sch is None and s.stream_id in self.tables:
+                sch = self.tables[s.stream_id].schema
             if sch is None:
                 raise DefinitionNotExistError(f"stream '{s.stream_id}' is not defined")
             schemas.append(sch)
@@ -245,6 +262,7 @@ class SiddhiAppRuntime:
         qr = JoinQueryRuntime(
             query, qid, schemas[0], schemas[1], self.interner,
             group_capacity=self.group_capacity, join_capacity=join_capacity,
+            tables=self.tables,
         )
         self.queries[qid] = qr
         self._wire_insert(qr)
@@ -263,12 +281,14 @@ class SiddhiAppRuntime:
             j = self._junction(join.left.stream_id)
             j.subscribe(lambda b, now: (receive_side(b, now, "l"), receive_side(b, now, "r")))
         else:
-            self._junction(join.left.stream_id).subscribe(
-                lambda b, now: receive_side(b, now, "l")
-            )
-            self._junction(join.right.stream_id).subscribe(
-                lambda b, now: receive_side(b, now, "r")
-            )
+            if not qr.table_sides["l"]:
+                self._junction(join.left.stream_id).subscribe(
+                    lambda b, now: receive_side(b, now, "l")
+                )
+            if not qr.table_sides["r"]:
+                self._junction(join.right.stream_id).subscribe(
+                    lambda b, now: receive_side(b, now, "r")
+                )
 
         for side, schema in qr.side_schemas.items():
             if qr.needs_scheduler[side]:
@@ -326,6 +346,32 @@ class SiddhiAppRuntime:
             )
             return
         raise DefinitionNotExistError(f"no stream or query named '{name}'")
+
+    def query(self, store_query) -> list:
+        """One-shot pull query over tables (reference:
+        SiddhiAppRuntime.query:264-299, cached per query string)."""
+        from siddhi_tpu.core.store_query import StoreQueryRuntime
+        from siddhi_tpu.query_api.execution import StoreQuery
+
+        if isinstance(store_query, str):
+            sqr = self._store_query_cache.get(store_query)
+            if sqr is None:
+                from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+                sq = SiddhiCompiler.parse_store_query(store_query)
+                sqr = StoreQueryRuntime(
+                    sq, self.tables, self.interner,
+                    group_capacity=self.group_capacity,
+                )
+                self._store_query_cache[store_query] = sqr
+        else:
+            assert isinstance(store_query, StoreQuery)
+            sqr = StoreQueryRuntime(
+                store_query, self.tables, self.interner,
+                group_capacity=self.group_capacity,
+            )
+        with self._process_lock:
+            return sqr.execute(self.clock())
 
     def start(self) -> None:
         self._running = True

@@ -53,9 +53,15 @@ TS_ATTR = "__ts__"
 class Env:
     """Runtime (trace-time) column provider for a compiled expression."""
 
-    def __init__(self, columns: dict[VarKey, jnp.ndarray], now: jnp.ndarray | None = None):
+    def __init__(
+        self,
+        columns: dict[VarKey, jnp.ndarray],
+        now: jnp.ndarray | None = None,
+        tables: dict[str, dict] | None = None,
+    ):
         self.columns = columns
         self._now = now
+        self.tables = tables or {}
 
     def read(self, key: VarKey) -> jnp.ndarray:
         try:
@@ -96,8 +102,26 @@ class Scope:
         # stream even when earlier state refs carry the same attribute
         # (reference: MatchingMetaInfoHolder default stream-event index)
         self.prefer_default = False
+        # in-table conditions resolve unqualified attrs against the OUTER
+        # (stream) scope before the table's own columns (reference:
+        # CollectionExpressionParser matching-side resolution)
+        self.prefer_parent = False
         self._streams: dict[str, dict[str, AttrType]] = {}
+        self._tables: dict[str, object] = {}
         self._parent: Scope | None = None
+
+    def add_table(self, table) -> "Scope":
+        """Register an InMemoryTable handle for `in <table>` conditions."""
+        self._tables[table.table_id] = table
+        return self
+
+    def resolve_table(self, name: str):
+        scope: Scope | None = self
+        while scope is not None:
+            if name in scope._tables:
+                return scope._tables[name]
+            scope = scope._parent
+        return None
 
     def add_stream(self, ref: str, attrs: dict[str, AttrType]) -> "Scope":
         self._streams[ref] = dict(attrs)
@@ -131,6 +155,11 @@ class Scope:
             raise KeyError(f"unknown stream reference '{var.stream_id}'")
         # unqualified: unique attribute across in-scope streams (reference
         # resolves unprefixed attrs the same way)
+        if self.prefer_parent and self._parent is not None:
+            try:
+                return self._parent.resolve(var)
+            except KeyError:
+                pass
         if self.prefer_default and self.default_ref is not None:
             scope = self
             while scope is not None:
@@ -288,9 +317,35 @@ def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
         return CompiledExpr(AttrType.BOOL, lambda env, k=key: ~env.read(k))
 
     if isinstance(expr, In):
-        raise NotImplementedError(
-            "'in <table>' conditions are compiled by the table layer"
-        )
+        table = scope.resolve_table(expr.source_id)
+        if table is None:
+            raise KeyError(
+                f"'in {expr.source_id}': no such table in scope"
+            )
+        inner_scope = scope.child()
+        inner_scope.add_stream(expr.source_id, table.schema.attr_types)
+        inner_scope.prefer_parent = True
+        cond = compile_expression(expr.expression, inner_scope)
+        _require_bool(cond, "in-table condition")
+        tid = table.table_id
+
+        def fn(env: Env) -> jnp.ndarray:
+            state = env.tables.get(tid)
+            if state is None:
+                raise KeyError(
+                    f"table '{tid}' state not provided at this site"
+                )
+            # probe rows [B] -> [B,1]; table rows -> [1,C]; any-match over C
+            cols2 = {k: v[:, None] for k, v in env.columns.items()}
+            cols2.update(
+                {(tid, None, n): v[None, :] for n, v in state["cols"].items()}
+            )
+            cols2[(tid, None, TS_ATTR)] = state["ts"][None, :]
+            env2 = Env(cols2, now=env._now, tables=env.tables)
+            pair = cond(env2) & state["valid"][None, :]
+            return pair.any(axis=1)
+
+        return CompiledExpr(AttrType.BOOL, fn)
 
     if isinstance(expr, AttributeFunction):
         return _compile_function(expr, scope)

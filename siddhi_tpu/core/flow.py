@@ -33,6 +33,8 @@ class Flow:
     member_env: Optional[Env] = None
     # device scalars surfaced to the host after the step (e.g. next_timer)
     aux: dict = dataclasses.field(default_factory=dict)
+    # live table states keyed by table id (for `in <table>` conditions)
+    tables: dict = dataclasses.field(default_factory=dict)
 
     def env(self) -> Env:
         cols: dict[VarKey, jnp.ndarray] = {
@@ -40,7 +42,7 @@ class Flow:
         }
         cols[(self.ref, None, TS_ATTR)] = self.batch.ts
         cols.update(self.extra_cols)
-        return Env(cols, now=self.now)
+        return Env(cols, now=self.now, tables=self.tables)
 
     # ---- kind masks ----
     @property

@@ -73,8 +73,36 @@ class NoWindow(WindowStage):
         return cols, jnp.zeros((1,), jnp.int64), jnp.zeros((1,), jnp.bool_)
 
 
+class TableSide:
+    """A join side backed by a table (reference: TableWindowProcessor — the
+    join probes the table via find; the table side never triggers output)."""
+
+    is_table = True
+
+    def __init__(self, stream: SingleInputStream, table):
+        if stream.handlers:
+            raise SiddhiAppCreationError(
+                f"table '{stream.stream_id}' cannot carry filters/windows "
+                "on a join side"
+            )
+        self.stream_id = stream.stream_id
+        self.ref = stream.ref
+        self.schema = table.schema
+        self.table = table
+        self.window = None
+
+    def init_state(self):
+        return {}
+
+    def probe_view(self, state_slice, tstates):
+        st = tstates[self.table.table_id]
+        return st["cols"], st["ts"], st["valid"]
+
+
 class JoinSide:
     """One side of the join: pre-window filters + window stage."""
+
+    is_table = False
 
     def __init__(
         self,
@@ -110,6 +138,12 @@ class JoinSide:
         if self.window is None:
             self.window = NoWindow(schema, self.ref)
 
+    def init_state(self):
+        return self.window.init_state()
+
+    def probe_view(self, state_slice, tstates):
+        return self.window.view(state_slice)
+
     def filter_batch(self, batch: EventBatch, now) -> EventBatch:
         if not self.pre_filters:
             return batch
@@ -138,9 +172,20 @@ class CompiledJoin:
         scope: Scope,
         out_capacity: int = DEFAULT_JOIN_CAPACITY,
         output_expired: bool = False,
+        tables: Optional[dict] = None,
     ):
-        self.left = JoinSide(join.left, left_schema, scope)
-        self.right = JoinSide(join.right, right_schema, scope)
+        tables = tables or {}
+
+        def make_side(stream, schema):
+            t = tables.get(stream.stream_id)
+            if t is not None:
+                return TableSide(stream, t)
+            return JoinSide(stream, schema, scope)
+
+        self.left = make_side(join.left, left_schema)
+        self.right = make_side(join.right, right_schema)
+        if self.left.is_table and self.right.is_table:
+            raise SiddhiAppCreationError("cannot join two tables; use a store query")
         if self.left.ref == self.right.ref:
             raise SiddhiAppCreationError(
                 f"join sides must have distinct references; alias one: "
@@ -153,11 +198,25 @@ class CompiledJoin:
         # (reference: JoinInputStreamParser.java:214-231)
         trigger = join.trigger
         if join.unidirectional == "left":
+            if self.left.is_table:
+                raise SiddhiAppCreationError(
+                    "unidirectional cannot be set on the table side of a join"
+                )
             trigger = JoinEventTrigger.LEFT
         elif join.unidirectional == "right":
+            if self.right.is_table:
+                raise SiddhiAppCreationError(
+                    "unidirectional cannot be set on the table side of a join"
+                )
             trigger = JoinEventTrigger.RIGHT
-        self.emit_left = trigger in (JoinEventTrigger.ALL, JoinEventTrigger.LEFT)
-        self.emit_right = trigger in (JoinEventTrigger.ALL, JoinEventTrigger.RIGHT)
+        self.emit_left = (
+            trigger in (JoinEventTrigger.ALL, JoinEventTrigger.LEFT)
+            and not self.left.is_table
+        )
+        self.emit_right = (
+            trigger in (JoinEventTrigger.ALL, JoinEventTrigger.RIGHT)
+            and not self.right.is_table
+        )
         self.on = None
         if join.on is not None:
             cond = compile_expression(join.on, scope)
@@ -166,11 +225,11 @@ class CompiledJoin:
             self.on = cond
 
     def init_state(self):
-        return {"l": self.left.window.init_state(), "r": self.right.window.init_state()}
+        return {"l": self.left.init_state(), "r": self.right.init_state()}
 
     # ---- device step for one arriving side -------------------------------
 
-    def step(self, state, batch: EventBatch, now, side: str):
+    def step(self, state, batch: EventBatch, now, side: str, tstates=None):
         """side: 'l' | 'r'. Returns (state', joined Flow, aux)."""
         arr = self.left if side == "l" else self.right
         other = self.right if side == "l" else self.left
@@ -179,7 +238,7 @@ class CompiledJoin:
         batch = arr.filter_batch(batch, now)
         aux: dict = {}
 
-        vcols, vts, vmask = other.window.view(state[other_key])
+        vcols, vts, vmask = other.probe_view(state[other_key], tstates or {})
 
         # probe 1: arriving CURRENT rows against the other window
         # (reference: preJoinProcessor — probe happens BEFORE own-window insert)
@@ -198,13 +257,17 @@ class CompiledJoin:
         if not emits:
             probes = []
 
-        joined = self._assemble(probes, arr, other, vcols, vts, vmask, now, side, aux)
+        joined = self._assemble(
+            probes, arr, other, vcols, vts, vmask, now, side, aux, tstates
+        )
 
         new_state = dict(state)
         new_state[side] = wstate
         return new_state, joined, aux
 
-    def _assemble(self, probes, arr, other, vcols, vts, vmask, now, side, aux):
+    def _assemble(
+        self, probes, arr, other, vcols, vts, vmask, now, side, aux, tstates=None
+    ):
         """Evaluate the on-condition for each probe set, compact matched pairs
         (plus outer misses) into one fixed-capacity joined Flow."""
         cap = self.out_capacity
@@ -284,7 +347,10 @@ class CompiledJoin:
         extra = {(self.right.ref, None, n): c for n, c in right_cols.items()}
         extra[(self.right.ref, None, TS_ATTR)] = right_ts
         extra[(self.left.ref, None, TS_ATTR)] = left_ts
-        return Flow(batch=batch, ref=self.left.ref, now=now, extra_cols=extra, aux=aux)
+        return Flow(
+            batch=batch, ref=self.left.ref, now=now, extra_cols=extra, aux=aux,
+            tables=tstates or {},
+        )
 
 
 from siddhi_tpu.core.query_runtime import BaseQueryRuntime
@@ -303,6 +369,7 @@ class JoinQueryRuntime(BaseQueryRuntime):
         interner,
         group_capacity: Optional[int] = None,
         join_capacity: int = DEFAULT_JOIN_CAPACITY,
+        tables: Optional[dict] = None,
     ):
         join = query.input_stream
         assert isinstance(join, JoinInputStream)
@@ -314,6 +381,8 @@ class JoinQueryRuntime(BaseQueryRuntime):
         scope.add_stream(lref, left_schema.attr_types)
         scope.add_stream(rref, right_schema.attr_types)
         scope.default_ref = lref
+        for t in (tables or {}).values():
+            scope.add_table(t)
 
         output_expired = query.output_stream.output_events is not OutputEventsFor.CURRENT
         self.join = CompiledJoin(
@@ -323,6 +392,7 @@ class JoinQueryRuntime(BaseQueryRuntime):
             scope,
             out_capacity=join_capacity,
             output_expired=output_expired,
+            tables=tables,
         )
         combined_attrs = [
             (n, t) for n, t in left_schema.attrs
@@ -335,33 +405,42 @@ class JoinQueryRuntime(BaseQueryRuntime):
             group_capacity=group_capacity,
         )
         self._setup_output(query, query_id)
+        self._attach_tables(tables, interner)
 
         self.needs_scheduler = {
-            "l": self.join.left.window.needs_scheduler,
-            "r": self.join.right.window.needs_scheduler,
+            "l": not self.join.left.is_table and self.join.left.window.needs_scheduler,
+            "r": not self.join.right.is_table and self.join.right.window.needs_scheduler,
+        }
+        self.table_sides = {
+            "l": self.join.left.is_table,
+            "r": self.join.right.is_table,
         }
         self.side_schemas = {"l": left_schema, "r": right_schema}
         self.timer_targets: dict[str, object] = {}
         self._steps = {
-            "l": jax.jit(lambda st, b, now: self._step_impl(st, b, now, "l")),
-            "r": jax.jit(lambda st, b, now: self._step_impl(st, b, now, "r")),
+            "l": jax.jit(lambda st, ts, b, now: self._step_impl(st, ts, b, now, "l")),
+            "r": jax.jit(lambda st, ts, b, now: self._step_impl(st, ts, b, now, "r")),
         }
 
     def init_state(self):
         return {"join": self.join.init_state(), "sel": self.selector.init_state()}
 
-    def _step_impl(self, state, batch: EventBatch, now, side: str):
-        jstate, flow, aux = self.join.step(state["join"], batch, now, side)
+    def _step_impl(self, state, tstates, batch: EventBatch, now, side: str):
+        jstate, flow, aux = self.join.step(state["join"], batch, now, side, tstates)
         sel_state, out = self.selector.apply(state["sel"], flow)
+        if self.table_op is not None:
+            tstates = self.table_op(tstates, out, now, flow.aux)
         aux.update(flow.aux)
-        return {"join": jstate, "sel": sel_state}, out, aux
+        return {"join": jstate, "sel": sel_state}, tstates, out, aux
 
     def receive(self, batch: EventBatch, now: int, side: str):
         with self._receive_lock:
             if self.state is None:
                 self.state = self.init_state()
-            self.state, out, aux = self._steps[side](
-                self.state, batch, jnp.asarray(now, dtype=jnp.int64)
+            tstates = self._collect_table_states()
+            self.state, tstates, out, aux = self._steps[side](
+                self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)
             )
+            self._writeback_table_states(tstates)
         self._warn_aux(aux)
         return out, aux

@@ -37,6 +37,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
         token_capacity: int = 128,
         count_capacity: int = 8,
         batch_size: int = 64,
+        tables: Optional[dict] = None,
     ):
         self.query = query
         self.query_id = query_id
@@ -49,6 +50,11 @@ class PatternQueryRuntime(BaseQueryRuntime):
             token_capacity=token_capacity,
             count_capacity=count_capacity,
         )
+        # selector/having `in <table>` conditions resolve against these
+        # (pattern node filters are compiled before tables attach — the
+        # reference allows them there too; that lands with the NFA env rework)
+        for t in (tables or {}).values():
+            self.prog.scope.add_table(t)
         # emission buffer scales with the token table: every pending token can
         # emit on one event, so raising @app:patternCapacity raises this too
         self.out_cap = max(batch_size, 64, token_capacity)
@@ -78,6 +84,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
             group_capacity=group_capacity,
         )
         self._setup_output(query, query_id)
+        self._attach_tables(tables, interner)
         self.needs_scheduler = self.prog.needs_scheduler
         self.timer_target = None
         self._steps = {
@@ -96,7 +103,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
     def _make_step(self, stream_id: Optional[str]):
         prog = self.prog
 
-        def step(state, batch: EventBatch, now):
+        def step(state, tstates, batch: EventBatch, now):
             out0 = prog.init_out(self.out_cap)
             carry0 = (
                 state["tok"],
@@ -147,12 +154,15 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 ref=prog.refs[0].ref,
                 now=now,
                 extra_cols=prog.out_env_cols(out),
+                tables=tstates,
             )
             sel_state, out_batch = self.selector.apply(state["sel"], flow)
+            if self.table_op is not None:
+                tstates = self.table_op(tstates, out_batch, now, flow.aux)
             aux = dict(flow.aux)
             aux["pattern_overflow"] = ovf
             aux["next_timer"] = prog.next_timer(tok)
-            return {"tok": tok, "sel": sel_state}, out_batch, aux
+            return {"tok": tok, "sel": sel_state}, tstates, out_batch, aux
 
         return step
 
@@ -163,9 +173,11 @@ class PatternQueryRuntime(BaseQueryRuntime):
             if self.state is None:
                 self.state = self.init_state(now)
             step = self._steps[stream_id]
-            self.state, out, aux = step(
-                self.state, batch, jnp.asarray(now, dtype=jnp.int64)
+            tstates = self._collect_table_states()
+            self.state, tstates, out, aux = step(
+                self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)
             )
+            self._writeback_table_states(tstates)
         self._warn_aux(aux)
         return out, aux
 
@@ -173,9 +185,11 @@ class PatternQueryRuntime(BaseQueryRuntime):
         with self._receive_lock:
             if self.state is None:
                 self.state = self.init_state(t_ms)
-            self.state, out, aux = self._timer_step(
-                self.state, schema_batch, jnp.asarray(t_ms, dtype=jnp.int64)
+            tstates = self._collect_table_states()
+            self.state, tstates, out, aux = self._timer_step(
+                self.state, tstates, schema_batch, jnp.asarray(t_ms, dtype=jnp.int64)
             )
+            self._writeback_table_states(tstates)
         self._warn_aux(aux)
         return out, aux
 

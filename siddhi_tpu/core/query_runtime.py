@@ -98,10 +98,9 @@ class CompiledSingleChain:
         is_timer = flow.batch.kind == KIND_TIMER  # timers bypass filters
         valid = flow.batch.valid & (is_timer | mask)
         batch = EventBatch(flow.batch.ts, flow.batch.kind, valid, flow.batch.cols)
-        return Flow(
-            batch, flow.ref, flow.now, flow.extra_cols, flow.member,
-            flow.member_env, flow.aux,
-        )
+        import dataclasses
+
+        return dataclasses.replace(flow, batch=batch)
 
 
 class BaseQueryRuntime:
@@ -121,8 +120,33 @@ class BaseQueryRuntime:
         self.publish_fn: Optional[Callable] = None
         self._receive_lock = threading.RLock()
         self.state = None
+        self.tables = {}
+        self.table_op = None
         self._warned_overflow = False
         self._warned_join_overflow = False
+        self._warned_table_overflow = False
+
+    def _attach_tables(self, tables: dict, interner) -> None:
+        """Compile this query's table-output op and attach ONLY the tables the
+        query actually reads (in-conditions, join sides) or writes (output
+        target) — table-free queries skip table-state plumbing entirely
+        (reference: OutputParser constructing Insert/Update/Delete/
+        UpdateOrInsertIntoTableCallback, query/output/callback/*)."""
+        from siddhi_tpu.core.table import collect_used_tables, compile_table_output
+
+        tables = dict(tables or {})
+        self.table_op = compile_table_output(
+            self.query.output_stream, self.out_schema, tables, interner
+        )
+        used = collect_used_tables(self.query, tables)
+        self.tables = {tid: tables[tid] for tid in sorted(used)}
+
+    def _collect_table_states(self) -> dict:
+        return {tid: t.state for tid, t in self.tables.items()}
+
+    def _writeback_table_states(self, tstates: dict) -> None:
+        for tid, t in self.tables.items():
+            t.state = tstates[tid]
 
     def init_state(self):
         raise NotImplementedError
@@ -155,6 +179,19 @@ class BaseQueryRuntime:
                 "query '%s': pattern token table or emission buffer "
                 "overflowed; partial matches or emissions were dropped — "
                 "raise @app:patternCapacity(size='N') (sizes both)",
+                self.query_id,
+            )
+        if (
+            not self._warned_table_overflow
+            and "table_overflow" in aux
+            and bool(aux["table_overflow"])
+        ):
+            self._warned_table_overflow = True
+            import logging
+
+            logging.getLogger(__name__).error(
+                "query '%s': table ran out of capacity; inserts were dropped — "
+                "raise it with @capacity(size='N') on the table definition",
                 self.query_id,
             )
         if (
@@ -205,6 +242,7 @@ class QueryRuntime(BaseQueryRuntime):
         interner: InternTable,
         window_factory: Optional[Callable] = None,
         group_capacity: Optional[int] = None,
+        tables: Optional[dict] = None,
     ):
         self.query = query
         self.query_id = query_id
@@ -218,6 +256,8 @@ class QueryRuntime(BaseQueryRuntime):
         if self.ref != in_schema.stream_id:
             scope.add_stream(in_schema.stream_id, in_schema.attr_types)
         scope.default_ref = self.ref
+        for t in (tables or {}).values():
+            scope.add_table(t)
 
         if window_factory is None:
             from siddhi_tpu.core.windows import make_window
@@ -235,6 +275,7 @@ class QueryRuntime(BaseQueryRuntime):
         )
 
         self._setup_output(query, query_id)
+        self._attach_tables(tables, interner)
         self.needs_scheduler = (
             self.chain.window is not None and self.chain.window.needs_scheduler
         )
@@ -245,11 +286,13 @@ class QueryRuntime(BaseQueryRuntime):
     def init_state(self):
         return {"chain": self.chain.init_state(), "sel": self.selector.init_state()}
 
-    def _step_impl(self, state, batch: EventBatch, now):
-        flow = Flow(batch=batch, ref=self.ref, now=now)
+    def _step_impl(self, state, tstates, batch: EventBatch, now):
+        flow = Flow(batch=batch, ref=self.ref, now=now, tables=tstates)
         chain_state, flow = self.chain.apply(state["chain"], flow)
         sel_state, out = self.selector.apply(state["sel"], flow)
-        return {"chain": chain_state, "sel": sel_state}, out, flow.aux
+        if self.table_op is not None:
+            tstates = self.table_op(tstates, out, now, flow.aux)
+        return {"chain": chain_state, "sel": sel_state}, tstates, out, flow.aux
 
     # ---- host side -------------------------------------------------------
 
@@ -257,8 +300,10 @@ class QueryRuntime(BaseQueryRuntime):
         with self._receive_lock:
             if self.state is None:
                 self.state = self.init_state()
-            self.state, out, aux = self._step(
-                self.state, batch, jnp.asarray(now, dtype=jnp.int64)
+            tstates = self._collect_table_states()
+            self.state, tstates, out, aux = self._step(
+                self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)
             )
+            self._writeback_table_states(tstates)
         self._warn_aux(aux)
         return out, aux

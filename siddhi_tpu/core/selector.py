@@ -189,7 +189,7 @@ class CompiledSelector:
             s, col = agg.apply(state["aggs"][i], info, env)
             new_aggs.append(s)
             agg_cols[(_AGG_REF, None, f"a{i}")] = col
-        env2 = Env({**env.columns, **agg_cols}, now=flow.now)
+        env2 = Env({**env.columns, **agg_cols}, now=flow.now, tables=env.tables)
 
         out_cols = {}
         out_col_keys = {}
@@ -202,7 +202,7 @@ class CompiledSelector:
         valid = flow.batch.valid & (
             (flow.batch.kind == KIND_CURRENT) | (flow.batch.kind == KIND_EXPIRED)
         )
-        env3 = Env({**env2.columns, **out_col_keys}, now=flow.now)
+        env3 = Env({**env2.columns, **out_col_keys}, now=flow.now, tables=env.tables)
         if self.having is not None:
             valid = valid & self.having(env3)
 

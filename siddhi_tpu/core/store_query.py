@@ -1,0 +1,130 @@
+"""Store queries: one-shot pull queries over tables (and, later, named windows
+and aggregations).
+
+Reference: util/parser/StoreQueryParser.java:79-491 compiling Find/Select/
+Update/Delete store-query runtimes, cached per query string by
+SiddhiAppRuntime.java:272-299. Here the whole pull — order table rows, apply
+the on-condition, run the selector, apply any table write-back — is one jitted
+device program over the live table state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.errors import (
+    DefinitionNotExistError,
+    SiddhiAppCreationError,
+)
+from siddhi_tpu.core.event import Event, EventBatch, StreamSchema
+from siddhi_tpu.core.executor import Scope, compile_expression
+from siddhi_tpu.core.flow import Flow
+from siddhi_tpu.core.selector import CompiledSelector
+from siddhi_tpu.core.table import compile_table_output
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.query_api.execution import StoreQuery
+
+_MAX64 = jnp.iinfo(jnp.int64).max
+
+
+class StoreQueryRuntime:
+    """Compiled pull query over one table source."""
+
+    def __init__(
+        self,
+        sq: StoreQuery,
+        tables: dict,
+        interner,
+        group_capacity=None,
+    ):
+        store = sq.input_store
+        if store is None:
+            raise SiddhiAppCreationError(
+                "store queries without a 'from <store>' clause are not supported"
+            )
+        table = tables.get(store.store_id)
+        if table is None:
+            raise DefinitionNotExistError(
+                f"'{store.store_id}' is not a defined table"
+            )
+        if store.within is not None or store.per is not None:
+            raise SiddhiAppCreationError(
+                "'within'/'per' apply to aggregation store queries"
+            )
+        self.table = table
+        self.tables = dict(tables)
+        self.ref = store.alias or store.store_id
+
+        scope = Scope(interner)
+        scope.add_stream(self.ref, table.schema.attr_types)
+        scope.default_ref = self.ref
+        for t in self.tables.values():
+            scope.add_table(t)
+
+        self.on = None
+        if store.on is not None:
+            self.on = compile_expression(store.on, scope)
+            if self.on.type is not AttrType.BOOL:
+                raise SiddhiAppCreationError("'on' must be a boolean expression")
+
+        self.selector = CompiledSelector(
+            sq.selector,
+            scope,
+            input_attrs=table.schema.attrs,
+            batch_mode=True,  # one row per group key (store queries pull once)
+            group_capacity=group_capacity,
+        )
+        # plain aggregation (no group by) collapses to the final running row
+        # (reference: SelectStoreQueryRuntime with aggregating selector)
+        self.agg_single = bool(self.selector.aggregators) and self.selector.group is None
+        self.out_schema = StreamSchema(f"__sq_{self.ref}", self.selector.out_attrs)
+        self.interner = interner
+
+        self.table_op = (
+            compile_table_output(sq.output_stream, self.out_schema, self.tables, interner)
+            if sq.output_stream is not None
+            else None
+        )
+        self._step = jax.jit(self._step_impl)
+
+    # ---- device program --------------------------------------------------
+
+    def _step_impl(self, tstates, now):
+        st = tstates[self.table.table_id]
+        # iterate in insertion order (reference: holder iteration order)
+        order = jnp.argsort(jnp.where(st["valid"], st["seq"], _MAX64))
+        batch = EventBatch(
+            ts=st["ts"][order],
+            kind=jnp.zeros_like(st["ts"], dtype=jnp.int8),
+            valid=st["valid"][order],
+            cols={n: c[order] for n, c in st["cols"].items()},
+        )
+        flow = Flow(batch=batch, ref=self.ref, now=now, tables=tstates)
+        if self.on is not None:
+            mask = self.on(flow.env())
+            batch = EventBatch(batch.ts, batch.kind, batch.valid & mask, batch.cols)
+            flow = dataclasses.replace(flow, batch=batch)
+        out_state, out = self.selector.apply(self.selector.init_state(), flow)
+        if self.agg_single:
+            idx = jnp.arange(out.valid.shape[0])
+            last = jnp.max(jnp.where(out.valid, idx, -1))
+            out = EventBatch(
+                out.ts, out.kind, out.valid & (idx == last), out.cols
+            )
+        aux = dict(flow.aux)
+        if self.table_op is not None:
+            tstates = self.table_op(tstates, out, now, aux)
+        return tstates, out
+
+    # ---- host side -------------------------------------------------------
+
+    def execute(self, now: int) -> list[Event]:
+        tstates = {tid: t.state for tid, t in self.tables.items()}
+        tstates, out = self._step(tstates, jnp.asarray(now, dtype=jnp.int64))
+        for tid, t in self.tables.items():
+            t.state = tstates[tid]
+        rows = self.out_schema.from_batch(out, self.interner)
+        return [Event(ts, data) for ts, _kind, data in rows]

@@ -1,0 +1,539 @@
+"""Device-resident columnar tables.
+
+Reference: core/table/InMemoryTable.java:55-220 + table/holder/IndexEventHolder.java
+— list/indexed/primary-key event holders with CRUD under compiled conditions, and
+util/collection/ (CollectionExecutors/Operators) — the lookup planner.
+
+TPU-native design: a table is a fixed-capacity columnar arena on device
+(`cols/ts/valid/seq` lanes). Lookups are dense masked [B, C] condition
+evaluations (one fused XLA kernel — the MXU-friendly analog of the reference's
+per-event holder scans); the primary-key "index" is the same dense compare used
+for overwrite-on-conflict semantics rather than a host hash map, so every CRUD
+op stays inside the jitted query step. Sequential update semantics (later
+events in a chunk see earlier events' writes, as in the reference's per-event
+loop) are kept via a `lax.scan` over the probe batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import EventBatch, KIND_CURRENT, StreamSchema
+from siddhi_tpu.core.executor import (
+    CompiledExpr,
+    Env,
+    Scope,
+    TS_ATTR,
+    compile_expression,
+)
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.query_api.annotation import find_annotation
+from siddhi_tpu.query_api.definition import TableDefinition
+from siddhi_tpu.query_api.execution import UpdateSetAttribute
+
+DEFAULT_TABLE_CAPACITY = 4096
+
+
+class InMemoryTable:
+    """Host handle for one table: schema + device state + compiled-op builders.
+
+    State pytree:
+      cols:  {attr: [C] array}
+      ts:    [C] int64   insertion timestamps
+      valid: [C] bool    row occupancy
+      seq:   [C] int64   insertion order (stable find/iteration order)
+      next:  scalar int64 next sequence number
+    """
+
+    def __init__(
+        self,
+        definition: TableDefinition,
+        interner,
+        capacity: int = DEFAULT_TABLE_CAPACITY,
+    ):
+        self.definition = definition
+        self.table_id = definition.id
+        self.schema = StreamSchema(
+            definition.id, [(a.name, a.type) for a in definition.attributes]
+        )
+        self.interner = interner
+        cap_ann = find_annotation(definition.annotations, "capacity")
+        self.capacity = (
+            int(cap_ann.element("size") or cap_ann.element(None))
+            if cap_ann
+            else int(capacity)
+        )
+        pk = find_annotation(definition.annotations, "PrimaryKey") or find_annotation(
+            definition.annotations, "primaryKey"
+        )
+        self.primary_keys: list[str] = [v for _, v in pk.elements] if pk else []
+        for k in self.primary_keys:
+            if k not in self.schema.attr_names:
+                raise SiddhiAppCreationError(
+                    f"table '{self.table_id}': @PrimaryKey attribute '{k}' undefined"
+                )
+        idx = find_annotation(definition.annotations, "Index") or find_annotation(
+            definition.annotations, "IndexBy"
+        )
+        self.indexes: list[str] = [v for _, v in idx.elements] if idx else []
+
+        self.lock = threading.RLock()
+        self.state = self.init_state()
+        self._change_listeners: list[Callable] = []
+
+    # ---- state ------------------------------------------------------------
+
+    def init_state(self):
+        c = self.capacity
+        return {
+            "cols": {
+                n: jnp.zeros((c,), a.dtype)
+                for n, a in self.schema.empty_batch(1).cols.items()
+            },
+            "ts": jnp.zeros((c,), jnp.int64),
+            "valid": jnp.zeros((c,), jnp.bool_),
+            "seq": jnp.full((c,), jnp.iinfo(jnp.int64).max, jnp.int64),
+            "next": jnp.zeros((), jnp.int64),
+        }
+
+    def view(self, state):
+        """(cols, ts, mask) — probe view, same contract as WindowStage.view."""
+        return state["cols"], state["ts"], state["valid"]
+
+    # ---- device ops (traced inside query steps) ---------------------------
+
+    def insert(self, state, batch: EventBatch, aux: dict):
+        """Insert valid CURRENT rows. Primary-key conflicts overwrite the
+        existing row (reference: IndexEventHolder primary-key put)."""
+        rows = batch.valid & (batch.kind == KIND_CURRENT)
+        b = rows.shape[0]
+        c = self.capacity
+
+        if self.primary_keys:
+            # [B, C] key equality against stored rows
+            pk_match = jnp.ones((b, c), jnp.bool_)
+            for k in self.primary_keys:
+                pk_match = pk_match & (batch.cols[k][:, None] == state["cols"][k][None, :])
+            pk_match = pk_match & rows[:, None] & state["valid"][None, :]
+            # also dedupe within the arriving batch: a later row with the same
+            # key overwrites the earlier one's slot — keep only the LAST row
+            # per key as the writer of a fresh slot
+            same_key = jnp.ones((b, b), jnp.bool_)
+            for k in self.primary_keys:
+                same_key = same_key & (batch.cols[k][:, None] == batch.cols[k][None, :])
+            later_dup = same_key & rows[None, :] & (
+                jnp.arange(b)[None, :] > jnp.arange(b)[:, None]
+            )
+            is_last = rows & ~later_dup.any(axis=1)
+            overwrites = pk_match.any(axis=1) & is_last  # rows that overwrite
+            fresh = is_last & ~overwrites                # rows taking free slots
+            # overwrite writes: for each table slot, the last arriving row that
+            # pk-matches it
+            writer = jnp.where(
+                pk_match & is_last[:, None], jnp.arange(b)[:, None], -1
+            ).max(axis=0)  # [C] index of writer row or -1
+            has_writer = writer >= 0
+            wi = jnp.clip(writer, 0, b - 1)
+            new_cols = {
+                n: jnp.where(has_writer, col_b[wi], state["cols"][n])
+                for n, col_b in batch.cols.items()
+            }
+            new_ts = jnp.where(has_writer, batch.ts[wi], state["ts"])
+            mid = {
+                "cols": new_cols,
+                "ts": new_ts,
+                "valid": state["valid"],
+                "seq": state["seq"],
+                "next": state["next"],
+            }
+            return self._append(mid, batch, fresh, aux)
+        return self._append(state, batch, rows, aux)
+
+    def _append(self, state, batch: EventBatch, rows, aux: dict):
+        b = rows.shape[0]
+        c = self.capacity
+        # free slots in order; rows ranked by position
+        free = ~state["valid"]
+        n_free = free.sum()
+        n_rows = rows.sum()
+        aux["table_overflow"] = aux.get(
+            "table_overflow", jnp.zeros((), jnp.bool_)
+        ) | (n_rows > n_free)
+        free_idx = jnp.nonzero(free, size=b, fill_value=-1)[0]  # first B free slots
+        rank = jnp.cumsum(rows) - 1  # rank of each inserting row
+        slot = jnp.where(rows, free_idx[jnp.clip(rank, 0, b - 1)], -1)
+        ok = rows & (slot >= 0)
+        # non-inserting rows scatter out of bounds and are dropped
+        slot_c = jnp.where(ok, slot, c)
+
+        def scatter(dst, src):
+            return dst.at[slot_c].set(src.astype(dst.dtype), mode="drop")
+
+        new_seq = state["next"] + rank
+        return {
+            "cols": {n: scatter(state["cols"][n], batch.cols[n]) for n in state["cols"]},
+            "ts": scatter(state["ts"], batch.ts),
+            "valid": scatter(state["valid"], jnp.ones((b,), jnp.bool_)),
+            "seq": scatter(state["seq"], new_seq),
+            "next": state["next"] + n_rows.astype(jnp.int64),
+        }
+
+    def match(
+        self,
+        state,
+        probe_cols: dict[str, jnp.ndarray],
+        probe_ts,
+        probe_ref: str,
+        on: Optional[CompiledExpr],
+        now,
+        extra_probe_cols: Optional[dict] = None,
+    ) -> jnp.ndarray:
+        """[B, C] condition mask of probe rows against table rows."""
+        b = probe_ts.shape[0]
+        c = self.capacity
+        if on is None:
+            return jnp.broadcast_to(state["valid"][None, :], (b, c))
+        env_cols = {(probe_ref, None, n): v[:, None] for n, v in probe_cols.items()}
+        env_cols[(probe_ref, None, TS_ATTR)] = probe_ts[:, None]
+        if extra_probe_cols:
+            env_cols.update(
+                {k: v[:, None] for k, v in extra_probe_cols.items()}
+            )
+        env_cols.update(
+            {(self.table_id, None, n): v[None, :] for n, v in state["cols"].items()}
+        )
+        env_cols[(self.table_id, None, TS_ATTR)] = state["ts"][None, :]
+        env = Env(env_cols, now=now)
+        return jnp.broadcast_to(on(env), (b, c)) & state["valid"][None, :]
+
+    def delete(self, state, batch: EventBatch, on, probe_ref, now, aux: dict):
+        rows = batch.valid & (batch.kind == KIND_CURRENT)
+        pair = self.match(state, batch.cols, batch.ts, probe_ref, on, now)
+        doomed = (pair & rows[:, None]).any(axis=0)
+        return {**state, "valid": state["valid"] & ~doomed}
+
+    def update(
+        self,
+        state,
+        batch: EventBatch,
+        on,
+        set_fns: list[tuple[str, Callable]],
+        probe_ref,
+        now,
+        aux: dict,
+    ):
+        """Sequential per-probe-row update (reference: InMemoryTable.update
+        iterates the updating chunk event by event)."""
+        rows = batch.valid & (batch.kind == KIND_CURRENT)
+
+        def body(carry, xs):
+            cols = carry
+            row_cols, row_ts, row_on = xs
+            env_cols = {(probe_ref, None, n): v[None] for n, v in row_cols.items()}
+            env_cols[(probe_ref, None, TS_ATTR)] = row_ts[None]
+            env_cols.update(
+                {(self.table_id, None, n): v for n, v in cols.items()}
+            )
+            env_cols[(self.table_id, None, TS_ATTR)] = state["ts"]
+            env = Env(env_cols, now=now)
+            m = state["valid"] if on is None else (
+                jnp.broadcast_to(on(env), (self.capacity,)) & state["valid"]
+            )
+            m = m & row_on
+            new_cols = dict(cols)
+            for name, fn in set_fns:
+                new_cols[name] = jnp.where(m, fn(env).astype(cols[name].dtype), cols[name])
+            return new_cols, None
+
+        xs = (batch.cols, batch.ts, rows)
+        new_cols, _ = lax.scan(body, state["cols"], xs)
+        return {**state, "cols": new_cols}
+
+    def update_or_insert(
+        self,
+        state,
+        batch: EventBatch,
+        on,
+        set_fns: list[tuple[str, Callable]],
+        probe_ref,
+        now,
+        aux: dict,
+        insert_names: Optional[list[str]] = None,
+    ):
+        """Per-probe-row: update matches, else insert the row
+        (reference: InMemoryTable.updateOrAdd). `insert_names` maps probe
+        columns to table columns positionally (selector output order)."""
+        rows = batch.valid & (batch.kind == KIND_CURRENT)
+        c = self.capacity
+        # probe column feeding each table column, by position
+        src_of = dict(
+            zip(self.schema.attr_names, insert_names or self.schema.attr_names)
+        )
+        overflow0 = aux.get("table_overflow", jnp.zeros((), jnp.bool_))
+
+        def body(carry, xs):
+            cols, ts, valid, seq, nxt, ovf = carry
+            row_cols, row_ts, row_on = xs
+            env_cols = {(probe_ref, None, n): v[None] for n, v in row_cols.items()}
+            env_cols[(probe_ref, None, TS_ATTR)] = row_ts[None]
+            env_cols.update({(self.table_id, None, n): v for n, v in cols.items()})
+            env_cols[(self.table_id, None, TS_ATTR)] = ts
+            env = Env(env_cols, now=now)
+            m = valid if on is None else (jnp.broadcast_to(on(env), (c,)) & valid)
+            m = m & row_on
+            hit = m.any()
+            # update path
+            upd_cols = dict(cols)
+            for name, fn in set_fns:
+                upd_cols[name] = jnp.where(m, fn(env).astype(cols[name].dtype), cols[name])
+            # insert path: first free slot
+            free = ~valid
+            has_free = free.any()
+            slot = jnp.argmax(free)
+            do_insert = row_on & ~hit & has_free
+            ovf = ovf | (row_on & ~hit & ~has_free)
+            ins_cols = {
+                n: jnp.where(
+                    do_insert,
+                    cols[n].at[slot].set(row_cols[src_of[n]].astype(cols[n].dtype)),
+                    upd_cols[n],
+                )
+                for n in cols
+            }
+            new_ts = jnp.where(do_insert, ts.at[slot].set(row_ts), ts)
+            new_valid = jnp.where(do_insert, valid.at[slot].set(True), valid)
+            new_seq = jnp.where(do_insert, seq.at[slot].set(nxt), seq)
+            new_next = nxt + do_insert.astype(jnp.int64)
+            return (ins_cols, new_ts, new_valid, new_seq, new_next, ovf), None
+
+        carry = (
+            state["cols"], state["ts"], state["valid"], state["seq"],
+            state["next"], overflow0,
+        )
+        xs = (batch.cols, batch.ts, rows)
+        (cols, ts, valid, seq, nxt, ovf), _ = lax.scan(body, carry, xs)
+        aux["table_overflow"] = ovf
+        return {"cols": cols, "ts": ts, "valid": valid, "seq": seq, "next": nxt}
+
+    # ---- host-side convenience (tests / record-table parity) --------------
+
+    def rows(self) -> list[tuple]:
+        """Decode current contents in insertion order (host)."""
+        import numpy as np
+
+        with self.lock:
+            st = self.state
+        valid = np.asarray(st["valid"])
+        seq = np.asarray(st["seq"])
+        cols = {n: np.asarray(c) for n, c in st["cols"].items()}
+        order = np.argsort(np.where(valid, seq, np.iinfo(np.int64).max), kind="stable")
+        from siddhi_tpu.core.event import decode_value
+
+        out = []
+        for i in order:
+            if not valid[i]:
+                continue
+            out.append(
+                tuple(
+                    decode_value(cols[n][i], t, self.interner)
+                    for n, t in self.schema.attrs
+                )
+            )
+        return out
+
+
+def compile_table_output(
+    output_stream,
+    out_schema: StreamSchema,
+    tables: dict[str, InMemoryTable],
+    interner,
+) -> Optional[Callable]:
+    """Compile a query/store-query output stream into a table op
+    `(tstates, out_batch, now, aux) -> tstates'`, or None when the output
+    does not target a table (reference: OutputParser constructing
+    Insert/Update/Delete/UpdateOrInsertIntoTableCallback)."""
+    from siddhi_tpu.core.errors import DefinitionNotExistError
+    from siddhi_tpu.query_api.execution import (
+        DeleteStream,
+        InsertIntoStream,
+        UpdateOrInsertStream,
+        UpdateStream,
+    )
+
+    target = getattr(output_stream, "target", None)
+
+    if isinstance(output_stream, InsertIntoStream):
+        if target not in tables:
+            return None
+        table = tables[target]
+        _check_positional_schema(out_schema, table, "insert into")
+        names = table.schema.attr_names
+        dtypes = {n: a.dtype for n, a in table.schema.empty_batch(1).cols.items()}
+        from siddhi_tpu.query_api.execution import OutputEventsFor
+
+        want = output_stream.output_events
+
+        def op(tstates, out_batch, now, aux, _t=table, _tid=target):
+            # honor `insert [current|expired|all] events into T`
+            # (reference: InsertIntoTableCallback event-type filtering)
+            if want is OutputEventsFor.CURRENT:
+                keep = out_batch.kind == KIND_CURRENT
+            elif want is OutputEventsFor.EXPIRED:
+                keep = out_batch.kind == jnp.int8(1)  # KIND_EXPIRED
+            else:
+                keep = jnp.ones_like(out_batch.valid)
+            cols = {
+                n: c.astype(dtypes[n])
+                for n, c in zip(names, out_batch.cols.values())
+            }
+            renamed = EventBatch(
+                out_batch.ts,
+                jnp.zeros_like(out_batch.kind),  # inserted rows become CURRENT
+                out_batch.valid & keep,
+                cols,
+            )
+            tstates = dict(tstates)
+            tstates[_tid] = _t.insert(tstates[_tid], renamed, aux)
+            return tstates
+
+        return op
+
+    if isinstance(output_stream, (UpdateStream, DeleteStream, UpdateOrInsertStream)):
+        table = tables.get(target)
+        if table is None:
+            raise DefinitionNotExistError(f"'{target}' is not a defined table")
+        if isinstance(output_stream, UpdateOrInsertStream):
+            _check_positional_schema(out_schema, table, "update or insert into")
+        scope = Scope(interner)
+        scope.add_stream("__out__", dict(out_schema.attrs))
+        scope.add_stream(table.table_id, table.schema.attr_types)
+        scope.default_ref = "__out__"
+        scope.prefer_default = True
+        on = (
+            compile_expression(output_stream.on, scope)
+            if output_stream.on is not None
+            else None
+        )
+        if on is not None and on.type is not AttrType.BOOL:
+            raise SiddhiAppCreationError("'on' must be a boolean expression")
+        if isinstance(output_stream, DeleteStream):
+            def op(tstates, out_batch, now, aux, _t=table, _tid=target):
+                tstates = dict(tstates)
+                tstates[_tid] = _t.delete(
+                    tstates[_tid], out_batch, on, "__out__", now, aux
+                )
+                return tstates
+        else:
+            set_fns = compile_set_attributes(
+                table, output_stream.set_attributes, scope
+            )
+            if isinstance(output_stream, UpdateOrInsertStream):
+                ins_names = list(out_schema.attr_names)
+
+                def op(tstates, out_batch, now, aux, _t=table, _tid=target):
+                    tstates = dict(tstates)
+                    tstates[_tid] = _t.update_or_insert(
+                        tstates[_tid], out_batch, on, set_fns, "__out__", now,
+                        aux, insert_names=ins_names,
+                    )
+                    return tstates
+            else:
+                def op(tstates, out_batch, now, aux, _t=table, _tid=target):
+                    tstates = dict(tstates)
+                    tstates[_tid] = _t.update(
+                        tstates[_tid], out_batch, on, set_fns, "__out__", now, aux
+                    )
+                    return tstates
+
+        return op
+
+    return None
+
+
+def collect_used_tables(query, tables: dict[str, InMemoryTable]) -> set[str]:
+    """Table ids a query touches: `in <table>` conditions anywhere in its AST,
+    table-backed join sides, and the table-output target."""
+    import dataclasses as _dc
+
+    from siddhi_tpu.query_api.execution import JoinInputStream
+    from siddhi_tpu.query_api.expression import In
+
+    used: set[str] = set()
+
+    def walk(obj):
+        if isinstance(obj, In):
+            if obj.source_id in tables:
+                used.add(obj.source_id)
+            walk(obj.expression)
+        elif _dc.is_dataclass(obj) and not isinstance(obj, type):
+            for f in _dc.fields(obj):
+                walk(getattr(obj, f.name))
+        elif isinstance(obj, (list, tuple)):
+            for x in obj:
+                walk(x)
+        elif isinstance(obj, dict):
+            for x in obj.values():
+                walk(x)
+
+    walk(query)
+    target = getattr(query.output_stream, "target", None)
+    if target in tables:
+        used.add(target)
+    ins = query.input_stream
+    if isinstance(ins, JoinInputStream):
+        for s in (ins.left, ins.right):
+            if s.stream_id in tables:
+                used.add(s.stream_id)
+    return used
+
+
+def _check_positional_schema(
+    out_schema: StreamSchema, table: InMemoryTable, what: str
+) -> None:
+    """Positional attribute mapping requires matching arity and types
+    (reference: DefinitionParserHelper validateOutputStream)."""
+    if len(out_schema.attrs) != len(table.schema.attrs):
+        raise SiddhiAppCreationError(
+            f"{what} table '{table.table_id}': selector emits "
+            f"{len(out_schema.attrs)} attributes, table has "
+            f"{len(table.schema.attrs)}"
+        )
+    for (on_, ot), (tn, tt) in zip(out_schema.attrs, table.schema.attrs):
+        if ot is not tt:
+            raise SiddhiAppCreationError(
+                f"{what} table '{table.table_id}': output attribute "
+                f"'{on_}' is {ot.name} but table column '{tn}' is {tt.name}"
+            )
+
+
+def compile_set_attributes(
+    table: InMemoryTable,
+    set_attributes: Optional[list[UpdateSetAttribute]],
+    scope: Scope,
+) -> list[tuple[str, CompiledExpr]]:
+    """`set T.a = expr, ...`; absent => overwrite every table column with the
+    same-named output attribute (reference: InMemoryTable default update)."""
+    out: list[tuple[str, CompiledExpr]] = []
+    if set_attributes:
+        for sa in set_attributes:
+            name = sa.table_variable.attribute
+            if name not in table.schema.attr_names:
+                raise SiddhiAppCreationError(
+                    f"set target '{name}' is not a column of '{table.table_id}'"
+                )
+            out.append((name, compile_expression(sa.expression, scope)))
+    else:
+        from siddhi_tpu.query_api.expression import Variable
+
+        for name, _t in table.schema.attrs:
+            try:
+                out.append((name, compile_expression(Variable(name), scope)))
+            except KeyError:
+                continue  # no same-named output attribute: column untouched
+    return out

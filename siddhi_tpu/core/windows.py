@@ -258,6 +258,7 @@ class SlidingWindow(WindowStage):
             member=member,
             member_env=member_env,
             aux=aux,
+            tables=flow.tables,
         )
 
 
@@ -545,6 +546,7 @@ class BatchWindow(WindowStage):
             member=member,
             member_env=member_env,
             aux=aux,
+            tables=flow.tables,
         )
 
 

@@ -207,7 +207,8 @@ class TestPattern:
         ha.send(("warm", 1.0, 0))   # filtered out — compiles the A step
         hb.send(("warm", 1.0, 0))   # no armed token — compiles the B step
         qr = rt.queries["query1"]
-        qr._timer_step(qr.state, __import__("siddhi_tpu.core.app_runtime",
+        qr._timer_step(qr.state, qr._collect_table_states(),
+                       __import__("siddhi_tpu.core.app_runtime",
                        fromlist=["_pattern_timer_batch"])._pattern_timer_batch(0),
                        0)  # compile the timer step (t=0: fires nothing)
         return rt, ha, hb, got

@@ -1,0 +1,276 @@
+"""Table end-to-end tests.
+
+Mirrors the reference's table test semantics
+(reference: modules/siddhi-core/src/test/java/org/wso2/siddhi/core/query/table/
+InsertIntoTableTestCase, UpdateFromTableTestCase, DeleteFromTableTestCase,
+UpdateOrInsertTableTestCase, InTableTestCase, JoinTableTestCase,
+PrimaryKeyTableTestCase; store/StoreQueryTableTestCase).
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def build(ql):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    rt.start()
+    return mgr, rt
+
+
+BASE = """
+define stream StockStream (symbol string, price float, volume long);
+define table StockTable (symbol string, price float, volume long);
+"""
+
+
+class TestInsertIntoTable:
+    def test_insert_and_store_query(self):
+        mgr, rt = build(BASE + """
+        from StockStream insert into StockTable;
+        """)
+        h = rt.get_input_handler("StockStream")
+        h.send(("WSO2", 55.5, 100), timestamp=1)
+        h.send(("IBM", 75.5, 10), timestamp=2)
+        rows = rt.query("from StockTable select *")
+        assert [e.data for e in rows] == [("WSO2", 55.5, 100), ("IBM", 75.5, 10)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_insert_with_filter_and_on_condition(self):
+        mgr, rt = build(BASE + """
+        from StockStream[volume > 50] insert into StockTable;
+        """)
+        h = rt.get_input_handler("StockStream")
+        h.send(("WSO2", 55.5, 100), timestamp=1)
+        h.send(("IBM", 75.5, 10), timestamp=2)
+        h.send(("GOOG", 50.0, 200), timestamp=3)
+        rows = rt.query("from StockTable on volume > 150 select symbol, volume")
+        assert [e.data for e in rows] == [("GOOG", 200)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_store_query_aggregation(self):
+        mgr, rt = build(BASE + """
+        from StockStream insert into StockTable;
+        """)
+        h = rt.get_input_handler("StockStream")
+        for i, (s, p, v) in enumerate(
+            [("WSO2", 50.0, 10), ("WSO2", 60.0, 20), ("IBM", 70.0, 5)]
+        ):
+            h.send((s, p, v), timestamp=i + 1)
+        total = rt.query("from StockTable select sum(volume) as t")
+        assert [e.data for e in total] == [(35,)]
+        by_sym = rt.query(
+            "from StockTable select symbol, sum(volume) as t group by symbol"
+        )
+        assert sorted(e.data for e in by_sym) == [("IBM", 5), ("WSO2", 30)]
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestTableCrud:
+    def test_delete_on_condition(self):
+        mgr, rt = build(BASE + """
+        define stream DeleteStream (symbol string);
+        from StockStream insert into StockTable;
+        from DeleteStream delete StockTable on StockTable.symbol == symbol;
+        """)
+        rt.get_input_handler("StockStream").send(("WSO2", 55.5, 100), timestamp=1)
+        rt.get_input_handler("StockStream").send(("IBM", 75.5, 10), timestamp=2)
+        rt.get_input_handler("DeleteStream").send(("WSO2",), timestamp=3)
+        rows = rt.query("from StockTable select symbol")
+        assert [e.data for e in rows] == [("IBM",)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_update_set(self):
+        mgr, rt = build(BASE + """
+        define stream UpdateStream (symbol string, newPrice float);
+        from StockStream insert into StockTable;
+        from UpdateStream
+        update StockTable
+        set StockTable.price = newPrice
+        on StockTable.symbol == symbol;
+        """)
+        rt.get_input_handler("StockStream").send(("WSO2", 55.5, 100), timestamp=1)
+        rt.get_input_handler("StockStream").send(("IBM", 75.5, 10), timestamp=2)
+        rt.get_input_handler("UpdateStream").send(("WSO2", 99.0), timestamp=3)
+        rows = rt.query("from StockTable select symbol, price")
+        assert sorted(e.data for e in rows) == [("IBM", 75.5), ("WSO2", 99.0)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_update_default_overwrite(self):
+        # no `set` clause: same-named output attrs overwrite table columns
+        mgr, rt = build(BASE + """
+        define stream UpdateStream (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        from UpdateStream
+        select symbol, price, volume
+        update StockTable
+        on StockTable.symbol == symbol;
+        """)
+        rt.get_input_handler("StockStream").send(("WSO2", 55.5, 100), timestamp=1)
+        rt.get_input_handler("UpdateStream").send(("WSO2", 77.0, 200), timestamp=2)
+        rows = rt.query("from StockTable select *")
+        assert [e.data for e in rows] == [("WSO2", 77.0, 200)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_update_sequential_within_batch(self):
+        # two updating events in one batch apply sequentially
+        mgr, rt = build("""
+        @app:batch(size='8')
+        define stream S (symbol string, add long);
+        define table T (symbol string, total long);
+        define stream Init (symbol string, total long);
+        from Init insert into T;
+        from S
+        select symbol, add
+        update T
+        set T.total = T.total + add
+        on T.symbol == symbol;
+        """)
+        rt.get_input_handler("Init").send(("WSO2", 0), timestamp=1)
+        h = rt.get_input_handler("S")
+        h.send_many([("WSO2", 5), ("WSO2", 7)], timestamps=[2, 2])
+        rows = rt.query("from T select total")
+        assert [e.data for e in rows] == [(12,)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_update_or_insert(self):
+        mgr, rt = build(BASE + """
+        define stream UpsertStream (symbol string, price float, volume long);
+        from UpsertStream
+        select symbol, price, volume
+        update or insert into StockTable
+        on StockTable.symbol == symbol;
+        """)
+        h = rt.get_input_handler("UpsertStream")
+        h.send(("WSO2", 55.5, 100), timestamp=1)
+        h.send(("IBM", 75.5, 10), timestamp=2)
+        h.send(("WSO2", 57.5, 150), timestamp=3)
+        rows = rt.query("from StockTable select *")
+        assert sorted(e.data for e in rows) == [
+            ("IBM", 75.5, 10), ("WSO2", 57.5, 150)
+        ]
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestInTable:
+    def test_filter_in_table(self):
+        mgr, rt = build(BASE + """
+        define stream CheckStream (symbol string, price float);
+        @info(name='q')
+        from CheckStream[(StockTable.symbol == symbol) in StockTable]
+        select symbol, price
+        insert into Out;
+        from StockStream insert into StockTable;
+        """)
+        got = []
+        rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in ins or []))
+        rt.get_input_handler("StockStream").send(("WSO2", 55.5, 100), timestamp=1)
+        rt.get_input_handler("CheckStream").send(("WSO2", 1.0), timestamp=2)
+        rt.get_input_handler("CheckStream").send(("IBM", 2.0), timestamp=3)
+        assert got == [("WSO2", 1.0)]
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestJoinTable:
+    def test_stream_join_table(self):
+        mgr, rt = build(BASE + """
+        define stream CheckStream (company string);
+        @info(name='q')
+        from CheckStream join StockTable
+        on CheckStream.company == StockTable.symbol
+        select company, StockTable.price as price, StockTable.volume as volume
+        insert into Out;
+        from StockStream insert into StockTable;
+        """)
+        got = []
+        rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in ins or []))
+        rt.get_input_handler("StockStream").send(("WSO2", 55.5, 100), timestamp=1)
+        rt.get_input_handler("StockStream").send(("IBM", 75.5, 10), timestamp=2)
+        rt.get_input_handler("CheckStream").send(("WSO2",), timestamp=3)
+        assert got == [("WSO2", 55.5, 100)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_table_join_left_outer(self):
+        mgr, rt = build(BASE + """
+        define stream CheckStream (company string);
+        @info(name='q')
+        from CheckStream left outer join StockTable
+        on CheckStream.company == StockTable.symbol
+        select company, StockTable.volume as volume
+        insert into Out;
+        from StockStream insert into StockTable;
+        """)
+        got = []
+        rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in ins or []))
+        rt.get_input_handler("StockStream").send(("WSO2", 55.5, 100), timestamp=1)
+        rt.get_input_handler("CheckStream").send(("AMZN",), timestamp=2)
+        rt.get_input_handler("CheckStream").send(("WSO2",), timestamp=3)
+        assert got == [("AMZN", None), ("WSO2", 100)]
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestPrimaryKey:
+    def test_primary_key_overwrites(self):
+        mgr, rt = build("""
+        define stream StockStream (symbol string, price float, volume long);
+        @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        """)
+        h = rt.get_input_handler("StockStream")
+        h.send(("WSO2", 55.5, 100), timestamp=1)
+        h.send(("IBM", 75.5, 10), timestamp=2)
+        h.send(("WSO2", 57.5, 200), timestamp=3)
+        rows = rt.query("from StockTable select *")
+        assert sorted(e.data for e in rows) == [
+            ("IBM", 75.5, 10), ("WSO2", 57.5, 200)
+        ]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_primary_key_same_batch_dedupe(self):
+        mgr, rt = build("""
+        @app:batch(size='8')
+        define stream StockStream (symbol string, price float, volume long);
+        @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        """)
+        h = rt.get_input_handler("StockStream")
+        h.send_many(
+            [("WSO2", 55.5, 100), ("WSO2", 57.5, 200), ("IBM", 75.5, 10)],
+            timestamps=[1, 1, 1],
+        )
+        rows = rt.query("from StockTable select *")
+        assert sorted(e.data for e in rows) == [
+            ("IBM", 75.5, 10), ("WSO2", 57.5, 200)
+        ]
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestStoreQueryCrud:
+    def test_store_delete(self):
+        mgr, rt = build(BASE + """
+        from StockStream insert into StockTable;
+        """)
+        h = rt.get_input_handler("StockStream")
+        h.send(("WSO2", 55.5, 100), timestamp=1)
+        h.send(("IBM", 75.5, 10), timestamp=2)
+        rt.query("from StockTable select symbol delete StockTable on StockTable.symbol == symbol")
+        rows = rt.query("from StockTable select *")
+        assert rows == []
+        rt.shutdown()
+        mgr.shutdown()
