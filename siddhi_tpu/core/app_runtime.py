@@ -86,6 +86,46 @@ class SiddhiAppRuntime:
         }
         self._store_query_cache: dict[str, object] = {}
 
+        # named windows: input junction under the window id, processing runtime
+        # in between, output junction feeding `from W` queries
+        from siddhi_tpu.core.window_runtime import NamedWindow
+
+        self.named_windows: dict[str, NamedWindow] = {}
+        for wid, wd in app.window_definitions.items():
+            nw = NamedWindow(wd, self.interner)
+            self.named_windows[wid] = nw
+            in_j = StreamJunction(nw.schema, self.interner, self.batch_size)
+            self.junctions[wid] = in_j
+            nw.out_junction = StreamJunction(
+                nw.schema, self.interner, self.batch_size
+            )
+
+            def receive(batch: EventBatch, now: int, _nw=nw) -> None:
+                with self._process_lock:
+                    out, aux = _nw.receive(batch, now)
+                    _nw.out_junction.publish_batch(out, now)
+                if _nw.needs_scheduler:
+                    self._schedule_at(aux, _nw.timer_target)
+
+            in_j.subscribe(receive)
+            if nw.needs_scheduler:
+                def fire(t_ms: int, _nw=nw, _recv=receive) -> None:
+                    _recv(self._timer_batch(_nw.schema, t_ms), t_ms)
+
+                nw.timer_target = fire
+
+        # triggers: each defines a stream <id>(triggered_time long)
+        from siddhi_tpu.core.trigger import TriggerRuntime
+        from siddhi_tpu.core.types import AttrType
+
+        self.triggers: dict[str, TriggerRuntime] = {}
+        for tid, td in app.trigger_definitions.items():
+            schema = StreamSchema(tid, [("triggered_time", AttrType.LONG)])
+            self.stream_schemas[tid] = schema
+            self.triggers[tid] = TriggerRuntime(
+                td, self._junction(tid), self._scheduler, lambda: self.clock()
+            )
+
         unnamed = 0
         for elem in app.execution_elements:
             if isinstance(elem, Query):
@@ -129,9 +169,12 @@ class SiddhiAppRuntime:
         if target in self.tables:
             return  # table writes are compiled into the query step
         existing = self.stream_schemas.get(target)
+        if existing is None and target in self.named_windows:
+            existing = self.named_windows[target].schema
         inferred = qr.out_schema
         if existing is None:
             self.stream_schemas[target] = inferred
+            existing = inferred
         elif [t for _, t in existing.attrs] != [t for _, t in inferred.attrs]:
             raise SiddhiAppCreationError(
                 f"insert into '{target}': selector output {inferred.attrs} "
@@ -139,7 +182,7 @@ class SiddhiAppRuntime:
             )
         target_junction = self._junction(target)
         transform = _make_insert_transform(out.output_events)
-        rename = _make_rename(inferred, self.stream_schemas[target])
+        rename = _make_rename(inferred, existing)
 
         def publish(out_batch: EventBatch, now: int, _t=target_junction) -> None:
             _t.publish_batch(rename(transform(out_batch)), now)
@@ -170,6 +213,12 @@ class SiddhiAppRuntime:
                 f"{type(stream).__name__} queries land in later milestones"
             )
         in_schema = self.stream_schemas.get(stream.stream_id)
+        src_junction = None
+        if in_schema is None and stream.stream_id in self.named_windows:
+            # `from W`: consume the named window's emission stream
+            nw = self.named_windows[stream.stream_id]
+            in_schema = nw.schema
+            src_junction = nw.out_junction
         if in_schema is None:
             raise DefinitionNotExistError(
                 f"stream '{stream.stream_id}' is not defined"
@@ -183,7 +232,7 @@ class SiddhiAppRuntime:
         self._wire_insert(qr)
 
         decode = self._decode
-        in_junction = self._junction(stream.stream_id)
+        in_junction = src_junction or self._junction(stream.stream_id)
 
         def receive(batch: EventBatch, now: int, _qr=qr) -> None:
             with self._process_lock:
@@ -253,6 +302,8 @@ class SiddhiAppRuntime:
             sch = self.stream_schemas.get(s.stream_id)
             if sch is None and s.stream_id in self.tables:
                 sch = self.tables[s.stream_id].schema
+            if sch is None and s.stream_id in self.named_windows:
+                sch = self.named_windows[s.stream_id].schema
             if sch is None:
                 raise DefinitionNotExistError(f"stream '{s.stream_id}' is not defined")
             schemas.append(sch)
@@ -263,6 +314,7 @@ class SiddhiAppRuntime:
             query, qid, schemas[0], schemas[1], self.interner,
             group_capacity=self.group_capacity, join_capacity=join_capacity,
             tables=self.tables,
+            findables={**self.tables, **self.named_windows},
         )
         self.queries[qid] = qr
         self._wire_insert(qr)
@@ -281,14 +333,17 @@ class SiddhiAppRuntime:
             j = self._junction(join.left.stream_id)
             j.subscribe(lambda b, now: (receive_side(b, now, "l"), receive_side(b, now, "r")))
         else:
-            if not qr.table_sides["l"]:
-                self._junction(join.left.stream_id).subscribe(
-                    lambda b, now: receive_side(b, now, "l")
-                )
-            if not qr.table_sides["r"]:
-                self._junction(join.right.stream_id).subscribe(
-                    lambda b, now: receive_side(b, now, "r")
-                )
+            for side, stream in (("l", join.left), ("r", join.right)):
+                nw = qr.window_sides[side]
+                if nw is not None:
+                    # named-window side: driven by the window's emissions
+                    nw.out_junction.subscribe(
+                        lambda b, now, _s=side: receive_side(b, now, _s)
+                    )
+                elif not qr.table_sides[side]:
+                    self._junction(stream.stream_id).subscribe(
+                        lambda b, now, _s=side: receive_side(b, now, _s)
+                    )
 
         for side, schema in qr.side_schemas.items():
             if qr.needs_scheduler[side]:
@@ -304,6 +359,24 @@ class SiddhiAppRuntime:
         if not qr.needs_scheduler or "next_timer" not in aux:
             return
         self._schedule_at(aux, qr.timer_target)
+
+    def _arm_rate_limiter(self, qr) -> None:
+        """Recurring flush timer for time/snapshot rate limiters
+        (reference: time-based OutputRateLimiter scheduler wiring)."""
+        rl = getattr(qr, "rate_limiter", None)
+        if rl is None or rl.period_ms is None:
+            return
+        period = rl.period_ms
+
+        def fire(t_ms: int, _qr=qr, _rl=rl) -> None:
+            if not self._running:
+                return
+            with self._process_lock:
+                _qr._deliver(_rl.on_timer(t_ms), t_ms)
+            self._scheduler.notify_at(t_ms + period, fire)
+
+        self._scheduler.start()
+        self._scheduler.notify_at(self.clock() + period, fire)
 
     def _schedule_at(self, aux: dict, target) -> None:
         if target is None or "next_timer" not in aux:
@@ -362,6 +435,7 @@ class SiddhiAppRuntime:
                 sqr = StoreQueryRuntime(
                     sq, self.tables, self.interner,
                     group_capacity=self.group_capacity,
+                    windows=self.named_windows,
                 )
                 self._store_query_cache[store_query] = sqr
         else:
@@ -369,6 +443,7 @@ class SiddhiAppRuntime:
             sqr = StoreQueryRuntime(
                 store_query, self.tables, self.interner,
                 group_capacity=self.group_capacity,
+                windows=self.named_windows,
             )
         with self._process_lock:
             return sqr.execute(self.clock())
@@ -383,9 +458,16 @@ class SiddhiAppRuntime:
             if isinstance(qr, PatternQueryRuntime) and qr.needs_scheduler:
                 aux = qr.prime(self.clock())
                 self._maybe_schedule(qr, aux)
+            self._arm_rate_limiter(qr)
+        # triggers fire last so their events find fully-wired queries
+        # (reference: SiddhiAppRuntime.start sources-last ordering)
+        for tr in self.triggers.values():
+            tr.start()
 
     def shutdown(self) -> None:
         self._running = False
+        for tr in self.triggers.values():
+            tr.stop()
         self._scheduler.shutdown()
 
     def persist(self):  # M11

@@ -74,15 +74,17 @@ class NoWindow(WindowStage):
 
 
 class TableSide:
-    """A join side backed by a table (reference: TableWindowProcessor — the
-    join probes the table via find; the table side never triggers output)."""
+    """A join side backed by a shared findable: a table (reference:
+    TableWindowProcessor — probe-only, never triggers) or a named window
+    (reference: WindowWindowProcessor — its emission stream actively drives
+    the join while probes read the shared buffer)."""
 
     is_table = True
 
     def __init__(self, stream: SingleInputStream, table):
         if stream.handlers:
             raise SiddhiAppCreationError(
-                f"table '{stream.stream_id}' cannot carry filters/windows "
+                f"'{stream.stream_id}' cannot carry filters/windows "
                 "on a join side"
             )
         self.stream_id = stream.stream_id
@@ -90,19 +92,24 @@ class TableSide:
         self.schema = table.schema
         self.table = table
         self.window = None
+        # tables are passive probe targets; named windows also trigger
+        self.passive = not getattr(table, "is_named_window", False)
 
     def init_state(self):
         return {}
 
+    def filter_batch(self, batch: EventBatch, now) -> EventBatch:
+        return batch
+
     def probe_view(self, state_slice, tstates):
-        st = tstates[self.table.table_id]
-        return st["cols"], st["ts"], st["valid"]
+        return self.table.view(tstates[self.table.table_id])
 
 
 class JoinSide:
     """One side of the join: pre-window filters + window stage."""
 
     is_table = False
+    passive = False
 
     def __init__(
         self,
@@ -198,24 +205,24 @@ class CompiledJoin:
         # (reference: JoinInputStreamParser.java:214-231)
         trigger = join.trigger
         if join.unidirectional == "left":
-            if self.left.is_table:
+            if self.left.passive:
                 raise SiddhiAppCreationError(
                     "unidirectional cannot be set on the table side of a join"
                 )
             trigger = JoinEventTrigger.LEFT
         elif join.unidirectional == "right":
-            if self.right.is_table:
+            if self.right.passive:
                 raise SiddhiAppCreationError(
                     "unidirectional cannot be set on the table side of a join"
                 )
             trigger = JoinEventTrigger.RIGHT
         self.emit_left = (
             trigger in (JoinEventTrigger.ALL, JoinEventTrigger.LEFT)
-            and not self.left.is_table
+            and not self.left.passive
         )
         self.emit_right = (
             trigger in (JoinEventTrigger.ALL, JoinEventTrigger.RIGHT)
-            and not self.right.is_table
+            and not self.right.passive
         )
         self.on = None
         if join.on is not None:
@@ -244,16 +251,24 @@ class CompiledJoin:
         # (reference: preJoinProcessor — probe happens BEFORE own-window insert)
         cur_rows = batch.valid & (batch.kind == KIND_CURRENT)
 
-        # own-window insert; its EXPIRED output feeds probe 2
-        flow_in = Flow(batch=batch, ref=arr.ref, now=now)
-        wstate, wflow = arr.window.apply(state[side], flow_in)
-        if "next_timer" in wflow.aux:
-            aux["next_timer"] = wflow.aux["next_timer"]
+        if arr.is_table:
+            # named-window side: arrivals are the window's emission stream —
+            # they probe the other side but never re-buffer (the shared window
+            # state already holds them); its EXPIRED emissions feed probe 2
+            wstate = state[side]
+            exp_src = batch
+        else:
+            # own-window insert; its EXPIRED output feeds probe 2
+            flow_in = Flow(batch=batch, ref=arr.ref, now=now)
+            wstate, wflow = arr.window.apply(state[side], flow_in)
+            if "next_timer" in wflow.aux:
+                aux["next_timer"] = wflow.aux["next_timer"]
+            exp_src = wflow.batch
 
         probes = [(batch, cur_rows, jnp.int8(KIND_CURRENT))]
         if self.output_expired and emits:
-            exp_rows = wflow.batch.valid & (wflow.batch.kind == KIND_EXPIRED)
-            probes.append((wflow.batch, exp_rows, jnp.int8(KIND_EXPIRED)))
+            exp_rows = exp_src.valid & (exp_src.kind == KIND_EXPIRED)
+            probes.append((exp_src, exp_rows, jnp.int8(KIND_EXPIRED)))
         if not emits:
             probes = []
 
@@ -370,6 +385,7 @@ class JoinQueryRuntime(BaseQueryRuntime):
         group_capacity: Optional[int] = None,
         join_capacity: int = DEFAULT_JOIN_CAPACITY,
         tables: Optional[dict] = None,
+        findables: Optional[dict] = None,
     ):
         join = query.input_stream
         assert isinstance(join, JoinInputStream)
@@ -392,8 +408,14 @@ class JoinQueryRuntime(BaseQueryRuntime):
             scope,
             out_capacity=join_capacity,
             output_expired=output_expired,
-            tables=tables,
+            tables=findables if findables is not None else tables,
         )
+        # findable join sides that are NOT app tables (named windows): their
+        # live state is read-only threaded into the step
+        self.join_findables = {}
+        for side_obj in (self.join.left, self.join.right):
+            if side_obj.is_table and side_obj.table.table_id not in (tables or {}):
+                self.join_findables[side_obj.table.table_id] = side_obj.table
         combined_attrs = [
             (n, t) for n, t in left_schema.attrs
         ] + [(n, t) for n, t in right_schema.attrs]
@@ -411,9 +433,19 @@ class JoinQueryRuntime(BaseQueryRuntime):
             "l": not self.join.left.is_table and self.join.left.window.needs_scheduler,
             "r": not self.join.right.is_table and self.join.right.window.needs_scheduler,
         }
+        # findable sides have no junction of their own; active (named-window)
+        # sides are instead driven by the window's emission junction
         self.table_sides = {
             "l": self.join.left.is_table,
             "r": self.join.right.is_table,
+        }
+        self.window_sides = {
+            "l": self.join.left.table
+            if self.join.left.is_table and not self.join.left.passive
+            else None,
+            "r": self.join.right.table
+            if self.join.right.is_table and not self.join.right.passive
+            else None,
         }
         self.side_schemas = {"l": left_schema, "r": right_schema}
         self.timer_targets: dict[str, object] = {}

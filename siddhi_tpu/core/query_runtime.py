@@ -126,6 +126,22 @@ class BaseQueryRuntime:
         self._warned_join_overflow = False
         self._warned_table_overflow = False
 
+        from siddhi_tpu.core.ratelimit import (
+            EventAllLimiter,
+            TimeAllLimiter,
+            build_rate_limiter,
+        )
+
+        grouped = bool(query.selector.group_by)
+        self.rate_limiter = build_rate_limiter(query.output_rate, grouped)
+        if (
+            self.rate_limiter is not None
+            and grouped
+            and not isinstance(self.rate_limiter, (EventAllLimiter, TimeAllLimiter))
+        ):
+            # per-group limiters need the group key beside each output row
+            self.selector.emit_group_key = True
+
     def _attach_tables(self, tables: dict, interner) -> None:
         """Compile this query's table-output op and attach ONLY the tables the
         query actually reads (in-conditions, join sides) or writes (output
@@ -134,15 +150,24 @@ class BaseQueryRuntime:
         UpdateOrInsertIntoTableCallback, query/output/callback/*)."""
         from siddhi_tpu.core.table import collect_used_tables, compile_table_output
 
+        self._interner = interner
         tables = dict(tables or {})
         self.table_op = compile_table_output(
             self.query.output_stream, self.out_schema, tables, interner
         )
+        if self.table_op is not None and self.rate_limiter is not None:
+            raise SiddhiAppCreationError(
+                "output rate limiting into a table is not supported yet"
+            )
         used = collect_used_tables(self.query, tables)
         self.tables = {tid: tables[tid] for tid in sorted(used)}
 
     def _collect_table_states(self) -> dict:
-        return {tid: t.state for tid, t in self.tables.items()}
+        st = {tid: t.state for tid, t in self.tables.items()}
+        # join sides backed by other findables (named windows) are read-only
+        for fid, f in getattr(self, "join_findables", {}).items():
+            st[fid] = f.state
+        return st
 
     def _writeback_table_states(self, tstates: dict) -> None:
         for tid, t in self.tables.items():
@@ -213,6 +238,21 @@ class BaseQueryRuntime:
 
         `decode` = app-runtime host decoder (batch -> event triples).
         """
+        if self.rate_limiter is not None:
+            rows = decode(self.out_schema, out)
+            keys = None
+            if "__group_key__" in out.cols:
+                import numpy as np
+
+                idx = np.nonzero(np.asarray(out.valid))[0]
+                keys = np.asarray(out.cols["__group_key__"])[idx]
+            rows4 = [
+                (ts, kind, data, int(keys[i]) if keys is not None else None)
+                for i, (ts, kind, data) in enumerate(rows)
+            ]
+            released = self.rate_limiter.process(rows4, now)
+            self._deliver(released, now)
+            return
         if self.query_callbacks:
             events = decode(self.out_schema, out)
             if events:
@@ -229,6 +269,38 @@ class BaseQueryRuntime:
                         cb(ts, ins or None, removed or None)
         if self.publish_fn is not None:
             self.publish_fn(out, now)
+
+    def _deliver(self, rows4: list, now: int) -> None:
+        """Route rate-limiter-released rows to callbacks and the downstream
+        junction (re-encoded into a device batch)."""
+        if not rows4:
+            return
+        if self.query_callbacks:
+            ins = [(ts, kind, data) for ts, kind, data, _k in rows4 if kind == KIND_CURRENT]
+            removed = [(ts, kind, data) for ts, kind, data, _k in rows4 if kind == KIND_EXPIRED]
+            want = self.output_events
+            if want is OutputEventsFor.CURRENT:
+                removed = []
+            elif want is OutputEventsFor.EXPIRED:
+                ins = []
+            if ins or removed:
+                ts = rows4[-1][0]
+                for cb in self.query_callbacks:
+                    cb(ts, ins or None, removed or None)
+        if self.publish_fn is not None:
+            # pad to a fixed capacity so downstream jitted steps keep one
+            # stable shape (variable sizes would each trigger a recompile)
+            cap = 64
+            for ofs in range(0, len(rows4), cap):
+                chunk = rows4[ofs : ofs + cap]
+                batch = self.out_schema.to_batch(
+                    [r[0] for r in chunk],
+                    [r[2] for r in chunk],
+                    self._interner,
+                    capacity=cap,
+                    kinds=[r[1] for r in chunk],
+                )
+                self.publish_fn(batch, now)
 
 
 class QueryRuntime(BaseQueryRuntime):

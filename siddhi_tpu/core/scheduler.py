@@ -70,3 +70,8 @@ class SystemTimeScheduler:
         with self._cv:
             self._stop = True
             self._cv.notify()
+        # join so no timer target is mid-flight (e.g. inside a device call)
+        # when the interpreter tears down — that aborts the process
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)

@@ -227,6 +227,13 @@ class CompiledSelector:
             )
             valid = valid & ~conflict.any(axis=1)
 
+        # per-group rate limiters need each row's group key beside it
+        # (reference: GroupByKeyGenerator key threading into rate limiters)
+        if getattr(self, "emit_group_key", False) and ctx is not None:
+            out_cols["__group_key__"] = jnp.broadcast_to(
+                ctx.key, flow.batch.valid.shape
+            )
+
         out = EventBatch(
             ts=flow.batch.ts, kind=flow.batch.kind, valid=valid, cols=out_cols
         )

@@ -39,22 +39,25 @@ class StoreQueryRuntime:
         tables: dict,
         interner,
         group_capacity=None,
+        windows: dict | None = None,
     ):
         store = sq.input_store
         if store is None:
             raise SiddhiAppCreationError(
                 "store queries without a 'from <store>' clause are not supported"
             )
-        table = tables.get(store.store_id)
+        windows = windows or {}
+        table = tables.get(store.store_id) or windows.get(store.store_id)
         if table is None:
             raise DefinitionNotExistError(
-                f"'{store.store_id}' is not a defined table"
+                f"'{store.store_id}' is not a defined table or window"
             )
         if store.within is not None or store.per is not None:
             raise SiddhiAppCreationError(
                 "'within'/'per' apply to aggregation store queries"
             )
-        self.table = table
+        self.table = table  # findable source: InMemoryTable or NamedWindow
+        self.is_window = store.store_id in windows
         self.tables = dict(tables)
         self.ref = store.alias or store.store_id
 
@@ -94,14 +97,22 @@ class StoreQueryRuntime:
 
     def _step_impl(self, tstates, now):
         st = tstates[self.table.table_id]
-        # iterate in insertion order (reference: holder iteration order)
-        order = jnp.argsort(jnp.where(st["valid"], st["seq"], _MAX64))
-        batch = EventBatch(
-            ts=st["ts"][order],
-            kind=jnp.zeros_like(st["ts"], dtype=jnp.int8),
-            valid=st["valid"][order],
-            cols={n: c[order] for n, c in st["cols"].items()},
-        )
+        if self.is_window:
+            # named window: view() already yields insertion order
+            cols, ts, mask = self.table.view(st)
+            batch = EventBatch(
+                ts=ts, kind=jnp.zeros_like(ts, dtype=jnp.int8),
+                valid=mask, cols=cols,
+            )
+        else:
+            # iterate in insertion order (reference: holder iteration order)
+            order = jnp.argsort(jnp.where(st["valid"], st["seq"], _MAX64))
+            batch = EventBatch(
+                ts=st["ts"][order],
+                kind=jnp.zeros_like(st["ts"], dtype=jnp.int8),
+                valid=st["valid"][order],
+                cols={n: c[order] for n, c in st["cols"].items()},
+            )
         flow = Flow(batch=batch, ref=self.ref, now=now, tables=tstates)
         if self.on is not None:
             mask = self.on(flow.env())
@@ -123,8 +134,10 @@ class StoreQueryRuntime:
 
     def execute(self, now: int) -> list[Event]:
         tstates = {tid: t.state for tid, t in self.tables.items()}
+        if self.is_window:
+            tstates[self.table.table_id] = self.table.state
         tstates, out = self._step(tstates, jnp.asarray(now, dtype=jnp.int64))
         for tid, t in self.tables.items():
-            t.state = tstates[tid]
+            t.state = tstates[tid]  # windows are read-only: not written back
         rows = self.out_schema.from_batch(out, self.interner)
         return [Event(ts, data) for ts, _kind, data in rows]

@@ -1,0 +1,66 @@
+"""Triggers: timestamp-event injection streams.
+
+Reference: core/trigger/PeriodicTrigger.java:30-90, CronTrigger.java,
+StartTrigger.java — `define trigger T at every 5 sec | 'cron expr' | 'start'`
+creates a stream T(triggered_time long) and injects the trigger time into its
+junction on schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.query_api.definition import TriggerDefinition
+
+
+class TriggerRuntime:
+    def __init__(
+        self,
+        definition: TriggerDefinition,
+        junction,
+        scheduler,
+        clock: Callable[[], int],
+    ):
+        self.definition = definition
+        self.id = definition.id
+        self.junction = junction
+        self.scheduler = scheduler
+        self.clock = clock
+        self._running = False
+        self.cron = None
+        if definition.at_cron is not None:
+            from siddhi_tpu.utils.cron import CronSchedule
+
+            try:
+                self.cron = CronSchedule(definition.at_cron)
+            except ValueError as e:
+                raise SiddhiAppCreationError(
+                    f"trigger '{self.id}': {e}"
+                ) from None
+
+    def start(self) -> None:
+        self._running = True
+        d = self.definition
+        if d.at_start:
+            now = self.clock()
+            self.junction.send_rows([now], [(now,)], now=now)
+            return
+        self.scheduler.start()
+        self.scheduler.notify_at(self._next_after(self.clock()), self._fire)
+
+    def _next_after(self, t_ms: int) -> int:
+        d = self.definition
+        if d.at_every_ms is not None:
+            return t_ms + d.at_every_ms
+        return self.cron.next_fire_ms(t_ms)
+
+    def _fire(self, t_ms: int) -> None:
+        if not self._running:
+            return
+        self.junction.send_rows([t_ms], [(t_ms,)], now=t_ms)
+        if self._running:
+            self.scheduler.notify_at(self._next_after(t_ms), self._fire)
+
+    def stop(self) -> None:
+        self._running = False

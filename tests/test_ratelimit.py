@@ -1,0 +1,114 @@
+"""Output rate limiter tests.
+
+Reference: modules/siddhi-core/src/test/java/org/wso2/siddhi/core/query/
+ratelimit/ (EventOutputRateLimitTestCase, TimeOutputRateLimitTestCase,
+SnapshotOutputRateLimitTestCase).
+"""
+
+import time
+
+from siddhi_tpu import SiddhiManager
+
+
+def build(ql):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, rem: got.extend(e.data for e in ins or []))
+    rt.start()
+    return mgr, rt, got
+
+
+BASE = "define stream S (symbol string, price float);\n"
+
+
+class TestEventRate:
+    def test_all_every_3_events(self):
+        mgr, rt, got = build(BASE + """
+        @info(name='q')
+        from S select symbol, price output all every 3 events insert into Out;
+        """)
+        h = rt.get_input_handler("S")
+        for i in range(5):
+            h.send((f"E{i}", float(i)), timestamp=i)
+        # released in a chunk of 3; 2 still buffered
+        assert got == [("E0", 0.0), ("E1", 1.0), ("E2", 2.0)]
+        h.send(("E5", 5.0), timestamp=5)
+        assert len(got) == 6
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_first_every_3_events(self):
+        mgr, rt, got = build(BASE + """
+        @info(name='q')
+        from S select symbol output first every 3 events insert into Out;
+        """)
+        h = rt.get_input_handler("S")
+        for i in range(7):
+            h.send((f"E{i}", float(i)), timestamp=i)
+        assert got == [("E0",), ("E3",), ("E6",)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_last_every_3_events(self):
+        mgr, rt, got = build(BASE + """
+        @info(name='q')
+        from S select symbol output last every 3 events insert into Out;
+        """)
+        h = rt.get_input_handler("S")
+        for i in range(6):
+            h.send((f"E{i}", float(i)), timestamp=i)
+        assert got == [("E2",), ("E5",)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_last_per_group_every_3_events(self):
+        mgr, rt, got = build(BASE + """
+        @info(name='q')
+        from S select symbol, sum(price) as total group by symbol
+        output last every 3 events insert into Out;
+        """)
+        h = rt.get_input_handler("S")
+        h.send(("A", 1.0), timestamp=1)
+        h.send(("B", 2.0), timestamp=2)
+        h.send(("A", 3.0), timestamp=3)
+        # chunk of 3 closes: last row per key — A's total 4.0, B's total 2.0
+        assert sorted(got) == [("A", 4.0), ("B", 2.0)]
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestTimeRate:
+    def test_all_every_period(self):
+        mgr, rt, got = build(BASE + """
+        @info(name='q')
+        from S select symbol output all every 100 milliseconds insert into Out;
+        """)
+        h = rt.get_input_handler("S")
+        h.send(("A", 1.0))
+        h.send(("B", 2.0))
+        assert got == []  # buffered until the period boundary
+        t0 = time.time()
+        while len(got) < 2 and time.time() - t0 < 5.0:
+            time.sleep(0.05)
+        assert sorted(got) == [("A",), ("B",)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_snapshot(self):
+        mgr, rt, got = build(BASE + """
+        @info(name='q')
+        from S select symbol, sum(price) as total group by symbol
+        output snapshot every 100 milliseconds insert into Out;
+        """)
+        h = rt.get_input_handler("S")
+        h.send(("A", 1.0))
+        h.send(("A", 2.0))
+        h.send(("B", 5.0))
+        t0 = time.time()
+        while ("B", 5.0) not in got and time.time() - t0 < 10.0:
+            time.sleep(0.05)
+        # snapshot re-emits the latest aggregate per key
+        assert ("A", 3.0) in got and ("B", 5.0) in got
+        rt.shutdown()
+        mgr.shutdown()
