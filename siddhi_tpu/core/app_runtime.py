@@ -105,7 +105,13 @@ class SiddhiAppRuntime:
                     out, aux = _nw.receive(batch, now)
                     _nw.out_junction.publish_batch(out, now)
                 if _nw.needs_scheduler:
-                    self._schedule_at(aux, _nw.timer_target)
+                    if _nw.host_next_timer is not None:
+                        self._scheduler.start()
+                        self._scheduler.notify_at(
+                            _nw.host_next_timer(self.clock()), _nw.timer_target
+                        )
+                    else:
+                        self._schedule_at(aux, _nw.timer_target)
 
             in_j.subscribe(receive)
             if nw.needs_scheduler:
@@ -113,6 +119,39 @@ class SiddhiAppRuntime:
                     _recv(self._timer_batch(_nw.schema, t_ms), t_ms)
 
                 nw.timer_target = fire
+
+        # incremental aggregations: duration tables are registered app tables
+        # (reference: AggregationParser.java:701-708 table map registration)
+        from siddhi_tpu.core.aggregation import AggregationRuntime
+
+        agg_groups = self._capacity_annotation("app:aggGroupCapacity", 64)
+        self.aggregations: dict[str, AggregationRuntime] = {}
+        for aid, ad in app.aggregation_definitions.items():
+            in_sid = ad.basic_single_input_stream.stream_id
+            in_schema = self.stream_schemas.get(in_sid)
+            if in_schema is None:
+                raise DefinitionNotExistError(
+                    f"aggregation '{aid}': stream '{in_sid}' is not defined"
+                )
+            ar = AggregationRuntime(
+                ad, in_schema, self.interner, group_capacity=agg_groups
+            )
+            self.aggregations[aid] = ar
+            for t in ar.tables.values():
+                self.tables[t.table_id] = t
+
+            def agg_receive(batch: EventBatch, now: int, _ar=ar) -> None:
+                with self._process_lock:
+                    aux = _ar.receive(batch, now)
+                if "next_timer" in aux:
+                    self._schedule_at(aux, _ar.timer_target)
+
+            self._junction(in_sid).subscribe(agg_receive)
+
+            def agg_fire(t_ms: int, _ar=ar, _schema=in_schema, _recv=agg_receive) -> None:
+                _recv(self._timer_batch(_schema, t_ms), t_ms)
+
+            ar.timer_target = agg_fire
 
         # triggers: each defines a stream <id>(triggered_time long)
         from siddhi_tpu.core.trigger import TriggerRuntime
@@ -356,6 +395,12 @@ class SiddhiAppRuntime:
         return schema.from_batch(batch, self.interner)
 
     def _maybe_schedule(self, qr: QueryRuntime, aux: dict) -> None:
+        hnt = getattr(qr, "host_next_timer", None)
+        if hnt is not None:
+            if getattr(qr, "timer_target", None) is not None:
+                self._scheduler.start()
+                self._scheduler.notify_at(hnt(self.clock()), qr.timer_target)
+            return
         if not qr.needs_scheduler or "next_timer" not in aux:
             return
         self._schedule_at(aux, qr.timer_target)
@@ -436,6 +481,7 @@ class SiddhiAppRuntime:
                     sq, self.tables, self.interner,
                     group_capacity=self.group_capacity,
                     windows=self.named_windows,
+                    aggregations=self.aggregations,
                 )
                 self._store_query_cache[store_query] = sqr
         else:
@@ -444,6 +490,7 @@ class SiddhiAppRuntime:
                 store_query, self.tables, self.interner,
                 group_capacity=self.group_capacity,
                 windows=self.named_windows,
+                aggregations=self.aggregations,
             )
         with self._process_lock:
             return sqr.execute(self.clock())
@@ -458,6 +505,11 @@ class SiddhiAppRuntime:
             if isinstance(qr, PatternQueryRuntime) and qr.needs_scheduler:
                 aux = qr.prime(self.clock())
                 self._maybe_schedule(qr, aux)
+            if getattr(qr, "host_next_timer", None) and getattr(qr, "timer_target", None):
+                self._scheduler.start()
+                self._scheduler.notify_at(
+                    qr.host_next_timer(self.clock()), qr.timer_target
+                )
             self._arm_rate_limiter(qr)
         # triggers fire last so their events find fully-wired queries
         # (reference: SiddhiAppRuntime.start sources-last ordering)

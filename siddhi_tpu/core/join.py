@@ -191,7 +191,7 @@ class CompiledJoin:
 
         self.left = make_side(join.left, left_schema)
         self.right = make_side(join.right, right_schema)
-        if self.left.is_table and self.right.is_table:
+        if self.left.passive and self.right.passive:
             raise SiddhiAppCreationError("cannot join two tables; use a store query")
         if self.left.ref == self.right.ref:
             raise SiddhiAppCreationError(

@@ -207,6 +207,19 @@ class BaseQueryRuntime:
                 self.query_id,
             )
         if (
+            not getattr(self, "_warned_window_overflow", False)
+            and "window_overflow" in aux
+            and bool(aux["window_overflow"])
+        ):
+            self._warned_window_overflow = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "query '%s': window emission/key buffer overflowed; events "
+                "were dropped — reduce batch size or raise window capacity",
+                self.query_id,
+            )
+        if (
             not self._warned_table_overflow
             and "table_overflow" in aux
             and bool(aux["table_overflow"])
@@ -351,6 +364,9 @@ class QueryRuntime(BaseQueryRuntime):
         self.needs_scheduler = (
             self.chain.window is not None and self.chain.window.needs_scheduler
         )
+        # cron-driven windows compute their next fire host-side
+        cron = getattr(self.chain.window, "cron_schedule", None)
+        self.host_next_timer = cron.next_fire_ms if cron is not None else None
         self._step = jax.jit(self._step_impl)
 
     # ---- device program --------------------------------------------------

@@ -40,6 +40,7 @@ class StoreQueryRuntime:
         interner,
         group_capacity=None,
         windows: dict | None = None,
+        aggregations: dict | None = None,
     ):
         store = sq.input_store
         if store is None:
@@ -47,22 +48,57 @@ class StoreQueryRuntime:
                 "store queries without a 'from <store>' clause are not supported"
             )
         windows = windows or {}
-        table = tables.get(store.store_id) or windows.get(store.store_id)
-        if table is None:
-            raise DefinitionNotExistError(
-                f"'{store.store_id}' is not a defined table or window"
-            )
-        if store.within is not None or store.per is not None:
-            raise SiddhiAppCreationError(
-                "'within'/'per' apply to aggregation store queries"
-            )
-        self.table = table  # findable source: InMemoryTable or NamedWindow
+        aggregations = aggregations or {}
+
+        self.aggregation = aggregations.get(store.store_id)
+        self.is_agg = self.aggregation is not None
+        self.per = None
+        self.within = None
+        if self.is_agg:
+            from siddhi_tpu.core.aggregation import parse_per, parse_within_value
+            from siddhi_tpu.query_api.expression import Constant
+
+            if store.per is None:
+                raise SiddhiAppCreationError(
+                    "aggregation store queries need a per '<duration>' clause"
+                )
+            if not isinstance(store.per, Constant):
+                raise SiddhiAppCreationError("'per' must be a constant duration")
+            self.per = parse_per(store.per.value)
+            if store.within is not None:
+                w1, w2 = store.within
+                if not isinstance(w1, Constant) or (
+                    w2 is not None and not isinstance(w2, Constant)
+                ):
+                    raise SiddhiAppCreationError("'within' operands must be constants")
+                if w2 is None:
+                    self.within = parse_within_value(w1.value)
+                else:
+                    self.within = (
+                        parse_within_value(w1.value)[0],
+                        parse_within_value(w2.value)[0],
+                    )
+            source_schema = self.aggregation.out_schema
+            table = self.aggregation
+        else:
+            table = tables.get(store.store_id) or windows.get(store.store_id)
+            if table is None:
+                raise DefinitionNotExistError(
+                    f"'{store.store_id}' is not a defined table, window, "
+                    "or aggregation"
+                )
+            if store.within is not None or store.per is not None:
+                raise SiddhiAppCreationError(
+                    "'within'/'per' apply to aggregation store queries"
+                )
+            source_schema = table.schema
+        self.table = table  # findable source: table, window, or aggregation
         self.is_window = store.store_id in windows
         self.tables = dict(tables)
         self.ref = store.alias or store.store_id
 
         scope = Scope(interner)
-        scope.add_stream(self.ref, table.schema.attr_types)
+        scope.add_stream(self.ref, source_schema.attr_types)
         scope.default_ref = self.ref
         for t in self.tables.values():
             scope.add_table(t)
@@ -76,7 +112,7 @@ class StoreQueryRuntime:
         self.selector = CompiledSelector(
             sq.selector,
             scope,
-            input_attrs=table.schema.attrs,
+            input_attrs=source_schema.attrs,
             batch_mode=True,  # one row per group key (store queries pull once)
             group_capacity=group_capacity,
         )
@@ -95,24 +131,27 @@ class StoreQueryRuntime:
 
     # ---- device program --------------------------------------------------
 
-    def _step_impl(self, tstates, now):
-        st = tstates[self.table.table_id]
-        if self.is_window:
-            # named window: view() already yields insertion order
-            cols, ts, mask = self.table.view(st)
-            batch = EventBatch(
-                ts=ts, kind=jnp.zeros_like(ts, dtype=jnp.int8),
-                valid=mask, cols=cols,
-            )
+    def _step_impl(self, tstates, now, agg_batch: EventBatch | None = None):
+        if agg_batch is not None:
+            batch = agg_batch
         else:
-            # iterate in insertion order (reference: holder iteration order)
-            order = jnp.argsort(jnp.where(st["valid"], st["seq"], _MAX64))
-            batch = EventBatch(
-                ts=st["ts"][order],
-                kind=jnp.zeros_like(st["ts"], dtype=jnp.int8),
-                valid=st["valid"][order],
-                cols={n: c[order] for n, c in st["cols"].items()},
-            )
+            st = tstates[self.table.table_id]
+            if self.is_window:
+                # named window: view() already yields insertion order
+                cols, ts, mask = self.table.view(st)
+                batch = EventBatch(
+                    ts=ts, kind=jnp.zeros_like(ts, dtype=jnp.int8),
+                    valid=mask, cols=cols,
+                )
+            else:
+                # iterate in insertion order (reference: holder iteration order)
+                order = jnp.argsort(jnp.where(st["valid"], st["seq"], _MAX64))
+                batch = EventBatch(
+                    ts=st["ts"][order],
+                    kind=jnp.zeros_like(st["ts"], dtype=jnp.int8),
+                    valid=st["valid"][order],
+                    cols={n: c[order] for n, c in st["cols"].items()},
+                )
         flow = Flow(batch=batch, ref=self.ref, now=now, tables=tstates)
         if self.on is not None:
             mask = self.on(flow.env())
@@ -134,9 +173,19 @@ class StoreQueryRuntime:
 
     def execute(self, now: int) -> list[Event]:
         tstates = {tid: t.state for tid, t in self.tables.items()}
-        if self.is_window:
-            tstates[self.table.table_id] = self.table.state
-        tstates, out = self._step(tstates, jnp.asarray(now, dtype=jnp.int64))
+        if self.is_agg:
+            batch = self.table.find(self.per, self.within, now)
+            if not hasattr(self, "_agg_step"):
+                self._agg_step = jax.jit(
+                    lambda ts_, b, n: self._step_impl(ts_, n, agg_batch=b)
+                )
+            tstates, out = self._agg_step(
+                tstates, batch, jnp.asarray(now, dtype=jnp.int64)
+            )
+        else:
+            if self.is_window:
+                tstates[self.table.table_id] = self.table.state
+            tstates, out = self._step(tstates, jnp.asarray(now, dtype=jnp.int64))
         for tid, t in self.tables.items():
             t.state = tstates[tid]  # windows are read-only: not written back
         rows = self.out_schema.from_batch(out, self.interner)

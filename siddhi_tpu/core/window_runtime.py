@@ -50,6 +50,8 @@ class NamedWindow:
         self.out_events = definition.output_events  # current | expired | all
         self.state = self.stage.init_state()
         self.needs_scheduler = self.stage.needs_scheduler
+        cron = getattr(self.stage, "cron_schedule", None)
+        self.host_next_timer = cron.next_fire_ms if cron is not None else None
         self.out_junction = None  # wired by the app runtime
         self.timer_target = None
         self._step = jax.jit(self._step_impl)

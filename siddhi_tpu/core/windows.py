@@ -46,10 +46,14 @@ NO_TIMER = jnp.iinfo(jnp.int64).max
 DEFAULT_TIME_CAPACITY = 1024
 
 
-def _const_param(spec: WindowSpec, i: int, what: str) -> int:
+def _const_raw(spec: WindowSpec, i: int, what: str):
     if i >= len(spec.parameters) or not isinstance(spec.parameters[i], Constant):
         raise SiddhiAppCreationError(f"window {spec.name}: parameter {i} must be a constant {what}")
-    return int(spec.parameters[i].value)
+    return spec.parameters[i].value
+
+
+def _const_param(spec: WindowSpec, i: int, what: str) -> int:
+    return int(_const_raw(spec, i, what))
 
 
 class WindowStage:
@@ -59,6 +63,8 @@ class WindowStage:
     # tumbling windows flip the selector into batch group-by output mode
     # (reference: QueryParser batchProcessingAllowed -> QuerySelector)
     is_batch = False
+    # cron-driven windows schedule fire times host-side (CronSchedule)
+    cron_schedule = None
 
     def init_state(self):
         raise NotImplementedError
@@ -609,6 +615,65 @@ def make_window(
             schema, ref, capacity=time_capacity, duration_ms=t, time_attr=attr,
             start_time=start,
         )
+    if name == "sort":
+        from siddhi_tpu.core.windows_special import SortWindow
+        from siddhi_tpu.query_api.expression import Constant, Variable
+
+        n = _const_param(spec, 0, "length")
+        keys: list[tuple[str, bool]] = []
+        i = 1
+        params = spec.parameters
+        while i < len(params):
+            p = params[i]
+            if not isinstance(p, Variable):
+                raise SiddhiAppCreationError(
+                    "sort window parameters after the length must be "
+                    "attribute [, 'asc'|'desc'] pairs"
+                )
+            desc = False
+            if i + 1 < len(params) and isinstance(params[i + 1], Constant) and str(
+                params[i + 1].value
+            ).lower() in ("asc", "desc"):
+                desc = str(params[i + 1].value).lower() == "desc"
+                i += 1
+            keys.append((p.attribute, desc))
+            i += 1
+        return SortWindow(schema, ref, n, keys)
+    if name == "frequent":
+        from siddhi_tpu.core.windows_special import FrequentWindow
+        from siddhi_tpu.query_api.expression import Variable
+
+        n = _const_param(spec, 0, "count")
+        attrs = []
+        for p in spec.parameters[1:]:
+            if not isinstance(p, Variable):
+                raise SiddhiAppCreationError("frequent window keys must be attributes")
+            attrs.append(p.attribute)
+        return FrequentWindow(schema, ref, n, attrs)
+    if name == "lossyfrequent":
+        from siddhi_tpu.core.windows_special import LossyFrequentWindow
+        from siddhi_tpu.query_api.expression import Variable
+
+        support = _const_raw(spec, 0, "support threshold")
+        if len(spec.parameters) > 1 and not isinstance(spec.parameters[1], Variable):
+            error = _const_raw(spec, 1, "error bound")
+            rest = spec.parameters[2:]
+        else:
+            error = float(support) / 10.0  # reference default error bound
+            rest = spec.parameters[1:]
+        attrs = []
+        for p in rest:
+            if not isinstance(p, Variable):
+                raise SiddhiAppCreationError(
+                    "lossyFrequent window keys must be attributes"
+                )
+            attrs.append(p.attribute)
+        return LossyFrequentWindow(schema, ref, float(support), float(error), attrs)
+    if name == "cron":
+        from siddhi_tpu.core.windows_special import CronWindow
+
+        expr = _const_raw(spec, 0, "cron expression")
+        return CronWindow(schema, ref, str(expr), capacity=time_capacity)
     raise SiddhiAppCreationError(f"unknown window type '{spec.name}'")
 
 

@@ -1,0 +1,626 @@
+"""Sort, frequent, and lossyFrequent windows — per-event scan kernels.
+
+Reference: query/processor/stream/window/SortWindowProcessor.java:145-173
+(keep N smallest per comparator, evict the greatest as EXPIRED),
+FrequentWindowProcessor.java:106-160 (Misra-Gries top-N counting),
+LossyFrequentWindowProcessor.java:139-200 (lossy counting with
+support/error bounds).
+
+These windows have per-event sequential semantics (each arrival can evict a
+data-dependent victim), so the device program is a `lax.scan` over the batch
+rows carrying the buffer state, with emissions accumulated into a
+fixed-capacity output buffer — the same shape the NFA engine uses.
+"""
+
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import (
+    EventBatch,
+    KIND_CURRENT,
+    KIND_EXPIRED,
+    KIND_RESET,
+    KIND_TIMER,
+    StreamSchema,
+)
+from siddhi_tpu.core.flow import Flow
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.core.windows import WindowStage
+from siddhi_tpu.ops.group import mix_keys
+
+
+# ---------------------------------------------------------------------------
+# shared: fixed-capacity emission accumulator
+# ---------------------------------------------------------------------------
+
+
+def _out_init(cap: int, schema: StreamSchema):
+    empty = schema.empty_batch(cap)
+    return {
+        "ts": empty.ts,
+        "kind": empty.kind,
+        "valid": empty.valid,
+        "cols": empty.cols,
+    }
+
+
+def _out_append(out, n, ovf, cols, ts, kind, flag, cap):
+    """Append one row when `flag`; silently drops (sets ovf) past capacity."""
+    pos = jnp.where(flag & (n < cap), n, cap)  # cap == out-of-bounds: dropped
+    new = {
+        "ts": out["ts"].at[pos].set(ts, mode="drop"),
+        "kind": out["kind"].at[pos].set(jnp.int8(kind), mode="drop"),
+        "valid": out["valid"].at[pos].set(True, mode="drop"),
+        "cols": {
+            k: out["cols"][k].at[pos].set(v.astype(out["cols"][k].dtype), mode="drop")
+            for k, v in cols.items()
+        },
+    }
+    return (
+        new,
+        (n + (flag & (n < cap)).astype(jnp.int32)).astype(jnp.int32),
+        ovf | (flag & (n >= cap)),
+    )
+
+
+def _out_append_many(out, n, ovf, cols, ts, kind, flags, cap):
+    """Append every flagged row (vectorized compaction into the buffer)."""
+    flags_i = flags.astype(jnp.int32)
+    rank = jnp.cumsum(flags_i) - flags_i
+    pos = jnp.where(flags & (n + rank < cap), n + rank, cap)
+    ts_b = jnp.broadcast_to(ts, flags.shape)
+    new = {
+        "ts": out["ts"].at[pos].set(ts_b, mode="drop"),
+        "kind": out["kind"].at[pos].set(jnp.int8(kind), mode="drop"),
+        "valid": out["valid"].at[pos].set(True, mode="drop"),
+        "cols": {
+            k: out["cols"][k].at[pos].set(v.astype(out["cols"][k].dtype), mode="drop")
+            for k, v in cols.items()
+        },
+    }
+    total = flags_i.sum()
+    return (
+        new,
+        jnp.minimum(n + total, cap).astype(jnp.int32),
+        ovf | ((n + total) > cap),
+    )
+
+
+def _out_flow(out, flow: Flow, aux) -> Flow:
+    batch = EventBatch(ts=out["ts"], kind=out["kind"], valid=out["valid"], cols=out["cols"])
+    return Flow(
+        batch=batch, ref=flow.ref, now=flow.now, extra_cols={},
+        aux=aux, tables=flow.tables,
+    )
+
+
+def _key_col(cols, ts, attrs, key_attrs):
+    """int64 group key from the chosen attributes (all attrs when none given),
+    like the reference's string-concat key (FrequentWindowProcessor.generateKey)."""
+    names = key_attrs if key_attrs else [n for n, _ in attrs]
+    parts = []
+    types = dict(attrs)
+    for n in names:
+        c = cols[n]
+        if types[n] in (AttrType.FLOAT, AttrType.DOUBLE):
+            c = jnp.asarray(c).view(jnp.int32).astype(jnp.int64)
+        parts.append(jnp.asarray(c).astype(jnp.int64))
+    return mix_keys(parts)
+
+
+# ---------------------------------------------------------------------------
+# sort window
+# ---------------------------------------------------------------------------
+
+
+class SortWindow(WindowStage):
+    """#window.sort(N, attr asc|desc, ...) — retains the N least events per the
+    comparator; each overflow evicts the greatest (ties: most recent)."""
+
+    def __init__(self, schema: StreamSchema, ref: str, n: int, keys: list[tuple[str, bool]]):
+        self.schema = schema
+        self.ref = ref
+        self.n = int(n)
+        if not keys:
+            raise SiddhiAppCreationError("sort window needs at least one sort attribute")
+        for name, _desc in keys:
+            if schema.type_of(name) in (AttrType.STRING, AttrType.OBJECT):
+                raise SiddhiAppCreationError(
+                    "sort window on STRING/OBJECT attributes is not supported "
+                    "(interned ids are not lexicographic)"
+                )
+        self.keys = keys
+
+    def init_state(self):
+        w = self.n
+        return {
+            "cols": {
+                n: jnp.zeros((w,), a.dtype)
+                for n, a in self.schema.empty_batch(1).cols.items()
+            },
+            "ts": jnp.zeros((w,), jnp.int64),
+            "occ": jnp.zeros((w,), jnp.bool_),
+            "seq": jnp.zeros((w,), jnp.int64),
+            "next": jnp.zeros((), jnp.int64),
+        }
+
+    def _sort_keys(self, cols):
+        out = []
+        for name, desc in self.keys:
+            c = cols[name]
+            if c.dtype == jnp.bool_:
+                c = c.astype(jnp.int32)
+            out.append(-c if desc else c)
+        return out
+
+    def apply(self, state, flow: Flow):
+        b = flow.batch
+        bsz = b.capacity
+        w = self.n
+        cap = 2 * bsz
+        out0 = _out_init(cap, self.schema)
+
+        def body(carry, row):
+            st, out, n, ovf = carry
+            is_cur = row["valid"] & (row["kind"] == KIND_CURRENT)
+            row_cols = {k: row[f"c.{k}"] for k in b.cols}
+            # emit the arrival
+            out, n, ovf = _out_append(
+                out, n, ovf, row_cols, row["ts"], KIND_CURRENT, is_cur, cap
+            )
+            # candidate set: w slots + the arrival
+            cand_cols = {
+                k: jnp.concatenate([st["cols"][k], row_cols[k][None]])
+                for k in st["cols"]
+            }
+            cand_ts = jnp.concatenate([st["ts"], row["ts"][None]])
+            cand_occ = jnp.concatenate([st["occ"], is_cur[None]])
+            cand_seq = jnp.concatenate([st["seq"], st["next"][None]])
+            full = st["occ"].all() & is_cur
+            # victim: lexicographic max by sort keys, ties -> latest insertion
+            skeys = self._sort_keys(cand_cols) + [cand_seq]
+            best = jnp.int32(0)
+            for i in range(1, w + 1):
+                gt = jnp.bool_(False)
+                eq = jnp.bool_(True)
+                for kcol in skeys:
+                    a, bb = kcol[i], kcol[best]
+                    gt = gt | (eq & (a > bb))
+                    eq = eq & (a == bb)
+                # unoccupied candidates never win
+                gt = gt & cand_occ[i]
+                lose = ~cand_occ[best]
+                best = jnp.where(gt | lose, jnp.int32(i), best)
+            # if full: emit the victim as EXPIRED (ts = now) and remove it
+            out, n, ovf = _out_append(
+                out, n, ovf,
+                {k: c[best] for k, c in cand_cols.items()},
+                flow.now, KIND_EXPIRED, full, cap,
+            )
+            keep = cand_occ.at[best].set(
+                jnp.where(full, False, cand_occ[best])
+            )
+            # compact candidates back into w slots: new row takes the victim's
+            # slot when full, else the first free slot
+            free_slot = jnp.where(
+                full,
+                jnp.where(best == w, w, best),  # best==w: arrival itself evicted
+                jnp.argmax(~st["occ"]),
+            ).astype(jnp.int32)
+            write = is_cur & (free_slot < w) & keep[w]
+            slot = jnp.clip(free_slot, 0, w - 1)
+            new_st = {
+                "cols": {
+                    k: jnp.where(
+                        write,
+                        st["cols"][k].at[slot].set(row_cols[k].astype(st["cols"][k].dtype)),
+                        st["cols"][k],
+                    )
+                    for k in st["cols"]
+                },
+                "ts": jnp.where(write, st["ts"].at[slot].set(row["ts"]), st["ts"]),
+                "occ": jnp.where(
+                    write,
+                    keep[:w].at[slot].set(True),
+                    keep[:w],
+                ),
+                "seq": jnp.where(write, st["seq"].at[slot].set(st["next"]), st["seq"]),
+                "next": st["next"] + is_cur.astype(jnp.int64),
+            }
+            return (new_st, out, n, ovf), None
+
+        xs = {
+            "ts": b.ts, "kind": b.kind, "valid": b.valid,
+            **{f"c.{k}": c for k, c in b.cols.items()},
+        }
+        (st, out, _n, ovf), _ = lax.scan(
+            body, (state, out0, jnp.int32(0), jnp.bool_(False)), xs
+        )
+        aux = dict(flow.aux)
+        aux["window_overflow"] = ovf
+        return st, _out_flow(out, flow, aux)
+
+    def view(self, state):
+        order = jnp.argsort(
+            jnp.where(state["occ"], state["seq"], jnp.iinfo(jnp.int64).max)
+        ).astype(jnp.int32)
+        return (
+            {k: c[order] for k, c in state["cols"].items()},
+            state["ts"][order],
+            state["occ"][order],
+        )
+
+
+# ---------------------------------------------------------------------------
+# cron window
+# ---------------------------------------------------------------------------
+
+
+class CronWindow(WindowStage):
+    """#window.cron('expr') — collect arrivals; at each cron fire emit the
+    previous bucket as EXPIRED (ts = now), a RESET, then the collected bucket
+    as CURRENT (reference: CronWindowProcessor.dispatchEvents:173-198). The
+    fire times are TIMER rows scheduled host-side from the cron expression."""
+
+    is_batch = True
+    needs_scheduler = True
+
+    def __init__(self, schema: StreamSchema, ref: str, cron_expr: str, capacity: int = 256):
+        from siddhi_tpu.utils.cron import CronSchedule
+
+        self.schema = schema
+        self.ref = ref
+        self.w = int(capacity)
+        try:
+            self.cron_schedule = CronSchedule(cron_expr)
+        except ValueError as e:
+            raise SiddhiAppCreationError(f"cron window: {e}") from None
+
+    def init_state(self):
+        w = self.w
+        zero = {
+            n: jnp.zeros((w,), a.dtype)
+            for n, a in self.schema.empty_batch(1).cols.items()
+        }
+        return {
+            "cur_cols": zero,
+            "cur_ts": jnp.zeros((w,), jnp.int64),
+            "cur_n": jnp.zeros((), jnp.int32),
+            "prev_cols": {n: jnp.zeros_like(a) for n, a in zero.items()},
+            "prev_ts": jnp.zeros((w,), jnp.int64),
+            "prev_n": jnp.zeros((), jnp.int32),
+        }
+
+    def apply(self, state, flow: Flow):
+        b = flow.batch
+        bsz = b.capacity
+        w = self.w
+        cap = bsz + 2 * (2 * w + 1)  # room for two flushes per batch
+        out0 = _out_init(cap, self.schema)
+        slots = jnp.arange(w, dtype=jnp.int32)
+
+        def body(carry, row):
+            st, out, n, ovf = carry
+            is_cur = row["valid"] & (row["kind"] == KIND_CURRENT)
+            is_timer = row["valid"] & (row["kind"] == KIND_TIMER)
+            row_cols = {k: row[f"c.{k}"] for k in b.cols}
+
+            # flush on a TIMER fire when the open bucket holds anything
+            flush = is_timer & (st["cur_n"] > 0)
+            prev_mask = flush & (slots < st["prev_n"])
+            out, n, ovf = _out_append_many(
+                out, n, ovf, st["prev_cols"], flow.now, KIND_EXPIRED, prev_mask, cap
+            )
+            out, n, ovf = _out_append(
+                out, n, ovf,
+                {k: v[0] for k, v in st["prev_cols"].items()},
+                flow.now, KIND_RESET, flush, cap,
+            )
+            cur_mask = flush & (slots < st["cur_n"])
+            out2 = out
+            # currents keep their original arrival timestamps
+            flags_i = cur_mask.astype(jnp.int32)
+            rank = jnp.cumsum(flags_i) - flags_i
+            pos = jnp.where(cur_mask & (n + rank < cap), n + rank, cap)
+            out = {
+                "ts": out2["ts"].at[pos].set(st["cur_ts"], mode="drop"),
+                "kind": out2["kind"].at[pos].set(jnp.int8(KIND_CURRENT), mode="drop"),
+                "valid": out2["valid"].at[pos].set(True, mode="drop"),
+                "cols": {
+                    k: out2["cols"][k].at[pos].set(st["cur_cols"][k], mode="drop")
+                    for k in out2["cols"]
+                },
+            }
+            total = flags_i.sum()
+            ovf = ovf | ((n + total) > cap)
+            n = jnp.minimum(n + total, cap).astype(jnp.int32)
+
+            st_flushed = {
+                "cur_cols": {k: jnp.zeros_like(v) for k, v in st["cur_cols"].items()},
+                "cur_ts": jnp.zeros_like(st["cur_ts"]),
+                "cur_n": jnp.zeros_like(st["cur_n"]),
+                "prev_cols": st["cur_cols"],
+                "prev_ts": st["cur_ts"],
+                "prev_n": st["cur_n"],
+            }
+            st1 = {
+                k: (
+                    {kk: jnp.where(flush, st_flushed[k][kk], st[k][kk]) for kk in st[k]}
+                    if isinstance(st[k], dict)
+                    else jnp.where(flush, st_flushed[k], st[k])
+                )
+                for k in st
+            }
+
+            # append the arrival into the open bucket
+            slot = jnp.clip(st1["cur_n"], 0, w - 1)
+            can = is_cur & (st1["cur_n"] < w)
+            ovf = ovf | (is_cur & (st1["cur_n"] >= w))
+            st2 = {
+                "cur_cols": {
+                    k: jnp.where(
+                        can,
+                        st1["cur_cols"][k].at[slot].set(row_cols[k].astype(st1["cur_cols"][k].dtype)),
+                        st1["cur_cols"][k],
+                    )
+                    for k in st1["cur_cols"]
+                },
+                "cur_ts": jnp.where(can, st1["cur_ts"].at[slot].set(row["ts"]), st1["cur_ts"]),
+                "cur_n": st1["cur_n"] + can.astype(jnp.int32),
+                "prev_cols": st1["prev_cols"],
+                "prev_ts": st1["prev_ts"],
+                "prev_n": st1["prev_n"],
+            }
+            return (st2, out, n, ovf), None
+
+        xs = {
+            "ts": b.ts, "kind": b.kind, "valid": b.valid,
+            **{f"c.{k}": c for k, c in b.cols.items()},
+        }
+        (st, out, _n, ovf), _ = lax.scan(
+            body, (state, out0, jnp.int32(0), jnp.bool_(False)), xs
+        )
+        aux = dict(flow.aux)
+        aux["window_overflow"] = ovf
+        return st, _out_flow(out, flow, aux)
+
+    def view(self, state):
+        mask = jnp.arange(self.w, dtype=jnp.int32) < state["cur_n"]
+        return dict(state["cur_cols"]), state["cur_ts"], mask
+
+
+# ---------------------------------------------------------------------------
+# frequent window (Misra-Gries)
+# ---------------------------------------------------------------------------
+
+
+class FrequentWindow(WindowStage):
+    """#window.frequent(N [, attrs...]) — retains the latest event per key for
+    the N most frequent keys."""
+
+    def __init__(self, schema: StreamSchema, ref: str, n: int, key_attrs: list[str]):
+        self.schema = schema
+        self.ref = ref
+        self.n = int(n)
+        self.key_attrs = key_attrs
+
+    def init_state(self):
+        w = self.n
+        return {
+            "cols": {
+                n: jnp.zeros((w,), a.dtype)
+                for n, a in self.schema.empty_batch(1).cols.items()
+            },
+            "ts": jnp.zeros((w,), jnp.int64),
+            "occ": jnp.zeros((w,), jnp.bool_),
+            "key": jnp.zeros((w,), jnp.int64),
+            "cnt": jnp.zeros((w,), jnp.int32),
+        }
+
+    def apply(self, state, flow: Flow):
+        b = flow.batch
+        bsz = b.capacity
+        w = self.n
+        cap = 2 * bsz + w
+        out0 = _out_init(cap, self.schema)
+
+        def body(carry, row):
+            st, out, n, ovf = carry
+            is_cur = row["valid"] & (row["kind"] == KIND_CURRENT)
+            row_cols = {k: row[f"c.{k}"] for k in b.cols}
+            key = _key_col(
+                {k: v[None] for k, v in row_cols.items()},
+                row["ts"][None], self.schema.attrs, self.key_attrs,
+            )[0]
+            hit = st["occ"] & (st["key"] == key)
+            exists = hit.any() & is_cur
+            slot_hit = jnp.argmax(hit).astype(jnp.int32)
+            has_free = (~st["occ"]).any()
+            new_key = is_cur & ~exists
+
+            # new key with the table full: decrement ALL counts; zeros evict
+            decr = new_key & ~has_free
+            cnt1 = jnp.where(decr & st["occ"], st["cnt"] - 1, st["cnt"])
+            evict = decr & st["occ"] & (cnt1 == 0)
+            # emit evictions as EXPIRED (ts = now), in slot order
+            out, n, ovf = _out_append_many(
+                out, n, ovf, st["cols"], flow.now, KIND_EXPIRED, evict, cap
+            )
+            occ1 = st["occ"] & ~evict
+            free_after = (~occ1).any()
+            insert = new_key & free_after  # fresh key takes a freed/free slot
+            slot_free = jnp.argmax(~occ1).astype(jnp.int32)
+            slot = jnp.where(exists, slot_hit, slot_free)
+            write = exists | insert
+            passed = exists | insert  # dropped new keys do NOT flow downstream
+            out, n, ovf = _out_append(
+                out, n, ovf, row_cols, row["ts"], KIND_CURRENT, passed, cap
+            )
+            slot_c = jnp.clip(slot, 0, w - 1)
+            new_st = {
+                "cols": {
+                    k: jnp.where(
+                        write,
+                        st["cols"][k].at[slot_c].set(row_cols[k].astype(st["cols"][k].dtype)),
+                        st["cols"][k],
+                    )
+                    for k in st["cols"]
+                },
+                "ts": jnp.where(write, st["ts"].at[slot_c].set(row["ts"]), st["ts"]),
+                "occ": jnp.where(write, occ1.at[slot_c].set(True), occ1),
+                "key": jnp.where(write, st["key"].at[slot_c].set(key), st["key"]),
+                "cnt": jnp.where(
+                    exists,
+                    cnt1.at[slot_c].add(1),
+                    jnp.where(insert, cnt1.at[slot_c].set(1), cnt1),
+                ),
+            }
+            return (new_st, out, n, ovf), None
+
+        xs = {
+            "ts": b.ts, "kind": b.kind, "valid": b.valid,
+            **{f"c.{k}": c for k, c in b.cols.items()},
+        }
+        (st, out, _n, ovf), _ = lax.scan(
+            body, (state, out0, jnp.int32(0), jnp.bool_(False)), xs
+        )
+        aux = dict(flow.aux)
+        aux["window_overflow"] = ovf
+        return st, _out_flow(out, flow, aux)
+
+    def view(self, state):
+        return dict(state["cols"]), state["ts"], state["occ"]
+
+
+# ---------------------------------------------------------------------------
+# lossyFrequent window (lossy counting)
+# ---------------------------------------------------------------------------
+
+
+class LossyFrequentWindow(WindowStage):
+    """#window.lossyFrequent(supportThreshold, errorBound [, attrs...])."""
+
+    def __init__(
+        self,
+        schema: StreamSchema,
+        ref: str,
+        support: float,
+        error: float,
+        key_attrs: list[str],
+    ):
+        self.schema = schema
+        self.ref = ref
+        self.support = float(support)
+        self.error = float(error)
+        if not (0 < self.error < 1) or not (0 < self.support < 1):
+            raise SiddhiAppCreationError(
+                "lossyFrequent support/error must be in (0, 1)"
+            )
+        self.width = max(1, int(1.0 / self.error + 0.9999999))
+        # lossy counting keeps O((1/e)·log(eN)) keys; 4/e is ample in practice
+        self.cap_keys = max(64, int(4.0 / self.error))
+        self.key_attrs = key_attrs
+
+    def init_state(self):
+        c = self.cap_keys
+        return {
+            "cols": {
+                n: jnp.zeros((c,), a.dtype)
+                for n, a in self.schema.empty_batch(1).cols.items()
+            },
+            "ts": jnp.zeros((c,), jnp.int64),
+            "occ": jnp.zeros((c,), jnp.bool_),
+            "key": jnp.zeros((c,), jnp.int64),
+            "cnt": jnp.zeros((c,), jnp.int64),
+            "bucket": jnp.zeros((c,), jnp.int64),
+            "total": jnp.zeros((), jnp.int64),
+        }
+
+    def apply(self, state, flow: Flow):
+        b = flow.batch
+        bsz = b.capacity
+        c = self.cap_keys
+        # worst case per batch: B currents + all keys pruned once
+        cap = bsz + c
+        out0 = _out_init(cap, self.schema)
+        width = self.width
+
+        def body(carry, row):
+            st, out, n, ovf = carry
+            is_cur = row["valid"] & (row["kind"] == KIND_CURRENT)
+            row_cols = {k: row[f"c.{k}"] for k in b.cols}
+            key = _key_col(
+                {k: v[None] for k, v in row_cols.items()},
+                row["ts"][None], self.schema.attrs, self.key_attrs,
+            )[0]
+            total = st["total"] + is_cur.astype(jnp.int64)
+            cur_bucket = jnp.where(
+                total <= 1, jnp.int64(1), (total + width - 1) // width
+            )
+            hit = st["occ"] & (st["key"] == key)
+            exists = hit.any() & is_cur
+            slot_hit = jnp.argmax(hit).astype(jnp.int32)
+            slot_free = jnp.argmax(~st["occ"]).astype(jnp.int32)
+            has_free = (~st["occ"]).any()
+            insert = is_cur & ~exists & has_free
+            ovf = ovf | (is_cur & ~exists & ~has_free)
+            write = exists | insert
+            slot = jnp.clip(jnp.where(exists, slot_hit, slot_free), 0, c - 1)
+            cnt = jnp.where(
+                exists,
+                st["cnt"].at[slot].add(1),
+                jnp.where(insert, st["cnt"].at[slot].set(1), st["cnt"]),
+            )
+            bucket = jnp.where(
+                insert, st["bucket"].at[slot].set(cur_bucket - 1), st["bucket"]
+            )
+            occ = jnp.where(write, st["occ"].at[slot].set(True), st["occ"])
+            cols = {
+                k: jnp.where(
+                    write,
+                    st["cols"][k].at[slot].set(row_cols[k].astype(st["cols"][k].dtype)),
+                    st["cols"][k],
+                )
+                for k in st["cols"]
+            }
+            ts = jnp.where(write, st["ts"].at[slot].set(row["ts"]), st["ts"])
+            # the arrival flows downstream iff its key meets (s - e) * total
+            # (reference: LossyFrequentWindowProcessor.java:172-180)
+            my_cnt = cnt[slot]
+            passed = is_cur & write & (
+                my_cnt.astype(jnp.float32)
+                >= (self.support - self.error) * total.astype(jnp.float32)
+            )
+            out, n, ovf = _out_append(
+                out, n, ovf, row_cols, row["ts"], KIND_CURRENT, passed, cap
+            )
+            # prune at bucket boundaries: cnt + bucket <= current bucket
+            prune_now = is_cur & (total % width == 0)
+            doomed = prune_now & occ & (cnt + bucket <= cur_bucket)
+            out, n, ovf = _out_append_many(
+                out, n, ovf, cols, flow.now, KIND_EXPIRED, doomed, cap
+            )
+            occ = occ & ~doomed
+            new_st = {
+                "cols": cols, "ts": ts, "occ": occ, "key":
+                jnp.where(write, st["key"].at[slot].set(key), st["key"]),
+                "cnt": cnt, "bucket": bucket, "total": total,
+            }
+            return (new_st, out, n, ovf), None
+
+        xs = {
+            "ts": b.ts, "kind": b.kind, "valid": b.valid,
+            **{f"c.{k}": c2 for k, c2 in b.cols.items()},
+        }
+        (st, out, _n, ovf), _ = lax.scan(
+            body, (state, out0, jnp.int32(0), jnp.bool_(False)), xs
+        )
+        aux = dict(flow.aux)
+        aux["window_overflow"] = ovf
+        return st, _out_flow(out, flow, aux)
+
+    def view(self, state):
+        return dict(state["cols"]), state["ts"], state["occ"]

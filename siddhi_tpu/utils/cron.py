@@ -65,17 +65,21 @@ class CronSchedule:
         if len(fields) != 6:
             raise ValueError(f"cron expression needs 5-7 fields: {expr!r}")
         self.expr = expr
-        names = [{}, {}, {}, {}, _MONTHS, _DOWS]
-        self.sec, self.min, self.hour, self.dom, self.mon, self.dow = (
+        names = [{}, {}, {}, {}, _MONTHS]
+        self.sec, self.min, self.hour, self.dom, self.mon = (
             _parse_field(f, lo, hi, nm)
-            for f, (lo, hi), nm in zip(fields, _FIELD_RANGES, names)
+            for f, (lo, hi), nm in zip(fields[:5], _FIELD_RANGES[:5], names)
         )
         if posix:
-            # POSIX day-of-week numbering: 0 (or 7) = SUN, 1 = MON, ...
-            # remap onto the Quartz 1=SUN..7=SAT encoding used internally
+            # POSIX day-of-week numbering: 0 (or 7) = SUN, 1 = MON ... 6 = SAT;
+            # names map to their POSIX numbers, then everything remaps onto the
+            # Quartz 1=SUN..7=SAT encoding used internally
+            posix_names = {d: (q - 1) for d, q in _DOWS.items()}
             self.dow = frozenset(
-                (v % 7) + 1 for v in _parse_field(fields[5], 0, 7, _DOWS)
+                (v % 7) + 1 for v in _parse_field(fields[5], 0, 7, posix_names)
             )
+        else:
+            self.dow = _parse_field(fields[5], *_FIELD_RANGES[5], _DOWS)
         self.dom_any = fields[3] in ("*", "?")
         self.dow_any = fields[5] in ("*", "?")
 

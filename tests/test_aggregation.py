@@ -1,0 +1,93 @@
+"""Incremental aggregation tests.
+
+Reference: modules/siddhi-core/src/test/java/org/wso2/siddhi/core/aggregation/
+AggregationTestCase.java (45 tests) — event-time bucket rollup sec..year and
+store-query reads with within/per.
+"""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+BASE_TS = 1_496_289_720_000  # 2017-06-01 04:05:20 GMT (reference test epoch)
+
+
+def build(ql):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    rt.start()
+    return mgr, rt
+
+
+APP = """
+define stream TradeStream (symbol string, price float, volume long, ts long);
+define aggregation TradeAgg
+from TradeStream
+select symbol, avg(price) as avgPrice, sum(volume) as total
+group by symbol
+aggregate by ts every sec, min;
+"""
+
+
+class TestIncrementalAggregation:
+    def test_rollup_and_store_query(self):
+        mgr, rt = build(APP)
+        h = rt.get_input_handler("TradeStream")
+        # two events in second 0, one in second 1, one in second 2
+        h.send(("WSO2", 50.0, 10, BASE_TS), timestamp=1)
+        h.send(("WSO2", 70.0, 20, BASE_TS + 500), timestamp=2)
+        h.send(("WSO2", 60.0, 5, BASE_TS + 1000), timestamp=3)
+        h.send(("IBM", 100.0, 1, BASE_TS + 2000), timestamp=4)
+
+        rows = rt.query("from TradeAgg per 'sec' select AGG_TIMESTAMP, symbol, avgPrice, total")
+        got = sorted(e.data for e in rows)
+        assert got == [
+            (BASE_TS, "WSO2", 60.0, 30),          # closed bucket (spilled)
+            (BASE_TS + 1000, "WSO2", 60.0, 5),    # closed by the IBM event
+            (BASE_TS + 2000, "IBM", 100.0, 1),    # in-flight bucket
+        ]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_minute_rollup(self):
+        mgr, rt = build(APP)
+        h = rt.get_input_handler("TradeStream")
+        h.send(("WSO2", 50.0, 10, BASE_TS), timestamp=1)
+        h.send(("WSO2", 70.0, 30, BASE_TS + 30_000), timestamp=2)   # same minute
+        h.send(("WSO2", 10.0, 100, BASE_TS + 65_000), timestamp=3)  # next minute
+        rows = rt.query("from TradeAgg per 'min' select AGG_TIMESTAMP, symbol, total")
+        got = sorted(e.data for e in rows)
+        minute0 = BASE_TS - (BASE_TS % 60_000)
+        assert got == [
+            (minute0, "WSO2", 40),           # closed minute bucket
+            (minute0 + 60_000, "WSO2", 100),  # in-flight minute
+        ]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_within_filter(self):
+        mgr, rt = build(APP)
+        h = rt.get_input_handler("TradeStream")
+        h.send(("WSO2", 50.0, 10, BASE_TS), timestamp=1)
+        h.send(("WSO2", 70.0, 20, BASE_TS + 10_000), timestamp=2)
+        rows = rt.query(
+            f"from TradeAgg within {BASE_TS}L, {BASE_TS + 5_000}L per 'sec' "
+            "select symbol, total"
+        )
+        assert [e.data for e in rows] == [("WSO2", 10)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_group_by_store_query_aggregation(self):
+        mgr, rt = build(APP)
+        h = rt.get_input_handler("TradeStream")
+        h.send(("WSO2", 50.0, 10, BASE_TS), timestamp=1)
+        h.send(("IBM", 20.0, 5, BASE_TS + 100), timestamp=2)
+        h.send(("WSO2", 70.0, 20, BASE_TS + 1_100), timestamp=3)
+        # sum over all buckets per symbol via the store-query selector
+        rows = rt.query(
+            "from TradeAgg per 'sec' select symbol, sum(total) as t group by symbol"
+        )
+        assert sorted(e.data for e in rows) == [("IBM", 5), ("WSO2", 30)]
+        rt.shutdown()
+        mgr.shutdown()
