@@ -244,6 +244,8 @@ class AggregationRuntime:
             [(AGG_TS, AttrType.LONG)] + [(s.name, s.out_type) for s in self.out_specs],
         )
 
+        self._empty = self._empty_store()
+        self._store_dtypes = {b: self._empty["vals"][b].dtype for b in self.bases}
         self.state = self.init_state()
         self._step = jax.jit(self._step_impl)
         self._finds = {}
@@ -273,21 +275,23 @@ class AggregationRuntime:
             "bucket": jnp.full((), -1, jnp.int64),
         }
 
-    def init_state(self):
+    def _empty_spill(self):
         g, s = self.g, SPILLS_PER_BATCH
-        spill = {
+        return {
             "ts": jnp.zeros((s,), jnp.int64),
             "keys": jnp.zeros((s, g), jnp.int64),
             "used": jnp.zeros((s, g), jnp.bool_),
             "vals": {
-                bname: jnp.zeros((s, g), self._empty_store()["vals"][bname].dtype)
+                bname: jnp.zeros((s, g), self._store_dtypes[bname])
                 for bname in self.bases
             },
         }
+
+    def init_state(self):
         return {
             "stores": [self._empty_store() for _ in self.durations],
             # spill buffers are zeroed per step; kept in state for pytree shape
-            "spill": [dict(jax.tree_util.tree_map(lambda x: x, spill)) for _ in self.durations],
+            "spill": [self._empty_spill() for _ in self.durations],
             "spill_n": [jnp.zeros((), jnp.int32) for _ in self.durations],
         }
 
@@ -364,20 +368,7 @@ class AggregationRuntime:
 
         g = self.g
         n_dur = len(self.durations)
-        spill0 = [
-            {
-                "ts": jnp.zeros((SPILLS_PER_BATCH,), jnp.int64),
-                "keys": jnp.zeros((SPILLS_PER_BATCH, g), jnp.int64),
-                "used": jnp.zeros((SPILLS_PER_BATCH, g), jnp.bool_),
-                "vals": {
-                    bname: jnp.zeros(
-                        (SPILLS_PER_BATCH, g), self._empty_store()["vals"][bname].dtype
-                    )
-                    for bname in self.bases
-                },
-            }
-            for _ in range(n_dur)
-        ]
+        spill0 = [self._empty_spill() for _ in range(n_dur)]
         spill_n0 = [jnp.zeros((), jnp.int32) for _ in range(n_dur)]
 
         def body(carry, row):
@@ -413,7 +404,7 @@ class AggregationRuntime:
                 ovf = ovf | (close & (sn >= SPILLS_PER_BATCH))
                 sn = sn + close.astype(jnp.int32)
                 closed = (st["keys"], st["used"], st["vals"], st["bucket"])
-                empty = self._empty_store()
+                empty = self._empty
                 nb = align_bucket(r_ts, self.durations[di])
                 st = {
                     "keys": jnp.where(close, empty["keys"], st["keys"]),
@@ -572,7 +563,7 @@ class AggregationRuntime:
         g = self.g
         per_idx = self.durations.index(per)
         # merge in-flight stores (finest..per) into one temp store aligned to per
-        temp = self._empty_store()
+        temp = dict(self._empty)
         temp = {**temp, "bucket": jnp.full((), -1, jnp.int64)}
         ovf = jnp.bool_(False)
         for di in range(per_idx + 1):
@@ -701,6 +692,11 @@ def parse_within_value(v) -> tuple[int, int]:
         if wild(p):
             level = i
             break
+    for p in parts[level + 1 :] if level < 6 else []:
+        if not wild(p):
+            raise SiddhiAppCreationError(
+                f"within {v!r}: components after a wildcard must be wildcards"
+            )
     vals = [int(p) if not wild(p) else 0 for p in parts]
     y_, mo_, d_, h_, mi_, s_ = vals
     if level == 0:

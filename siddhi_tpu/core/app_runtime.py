@@ -165,6 +165,9 @@ class SiddhiAppRuntime:
                 td, self._junction(tid), self._scheduler, lambda: self.clock()
             )
 
+        from siddhi_tpu.core.partition import PartitionRuntime
+
+        self.partitions: list[PartitionRuntime] = []
         unnamed = 0
         for elem in app.execution_elements:
             if isinstance(elem, Query):
@@ -173,7 +176,9 @@ class SiddhiAppRuntime:
                 unnamed += 1
                 self._add_query(qid, elem)
             elif isinstance(elem, Partition):
-                raise SiddhiAppCreationError("partitions land in M10")
+                self.partitions.append(
+                    PartitionRuntime(elem, self, f"partition{len(self.partitions)}")
+                )
 
     # ---- assembly --------------------------------------------------------
 

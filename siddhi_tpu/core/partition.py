@@ -1,0 +1,366 @@
+"""Partitions: per-key isolated query state.
+
+Reference: core/partition/PartitionRuntime.java:68-370 — `partition with (expr
+of Stream) begin ... end` lazily clones the whole inner query graph per key
+value (:256-315) and routes events into per-key local junctions; range
+partitions pick the first matching condition (executor/RangePartitionExecutor).
+
+TPU-native design: instead of cloned object graphs, the inner query's carried
+state gets a leading partition axis [P] and the step is `jax.vmap`ed over it —
+one compiled program, every partition's windows/aggregators advancing in
+parallel on device (SURVEY §2.7: partition -> vmap/segment over the key
+dimension). A shared key->slot table (same machinery as group-by) maps key
+values to partition slots; `#inner` streams stay [P]-shaped between inner
+queries, never flattening until output leaves the partition.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import (
+    EventBatch,
+    KIND_CURRENT,
+    KIND_TIMER,
+    StreamSchema,
+)
+from siddhi_tpu.core.executor import Env, Scope, TS_ATTR, compile_expression
+from siddhi_tpu.core.query_runtime import QueryRuntime
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.ops.group import assign_slots
+from siddhi_tpu.query_api.execution import (
+    InsertIntoStream,
+    Partition,
+    Query,
+    RangePartitionType,
+    SingleInputStream,
+    ValuePartitionType,
+)
+
+DEFAULT_PARTITIONS = 32
+NO_TIMER = jnp.iinfo(jnp.int64).max
+
+
+def _tile(x, p):
+    return jnp.repeat(x[None], p, axis=0)
+
+
+class PartitionedQueryRuntime(QueryRuntime):
+    """One inner query with a leading [P] partition axis on its state.
+
+    `key_of(env) -> (keys [B] int64, matched [B] bool)` routes outer-stream
+    batches; None means the input is an `#inner` stream whose batches arrive
+    already [P]-shaped.
+    """
+
+    def __init__(
+        self,
+        query: Query,
+        query_id: str,
+        in_schema: StreamSchema,
+        interner,
+        p_capacity: int,
+        key_of: Optional[Callable],
+        group_capacity=None,
+    ):
+        super().__init__(
+            query, query_id, in_schema, interner,
+            group_capacity=group_capacity, tables={},
+        )
+        self.p = int(p_capacity)
+        self.key_of = key_of
+        self.inner_publish = None  # set when inserting into an #inner stream
+        self._pstep_outer = jax.jit(self._pstep_outer_impl)
+        self._pstep_inner = jax.jit(self._pstep_inner_impl)
+
+    def init_state(self):
+        one = super().init_state()
+        return jax.tree_util.tree_map(lambda x: _tile(x, self.p), one)
+
+    # ---- device ------------------------------------------------------------
+
+    def _vmapped(self, states, make_valid, batch: EventBatch, now):
+        def one(state, p):
+            b2 = EventBatch(batch.ts, batch.kind, make_valid(p), batch.cols)
+            st, _ts, out, aux = self._step_impl(state, {}, b2, now)
+            return st, out, aux
+
+        states2, outs, auxs = jax.vmap(one)(states, jnp.arange(self.p))
+        aux = {}
+        for k, v in auxs.items():
+            if k == "next_timer":
+                aux[k] = v.min()
+            else:
+                aux[k] = v.any()
+        return states2, outs, aux
+
+    def _pstep_outer_impl(self, ptable, states, batch: EventBatch, now):
+        cols = {(self.ref, None, n): c for n, c in batch.cols.items()}
+        cols[(self.ref, None, TS_ATTR)] = batch.ts
+        env = Env(cols, now=now)
+        keys, matched = self.key_of(env)
+        active = batch.valid & (batch.kind == KIND_CURRENT) & matched
+        pk, pu, pn, slot, _same, povf = assign_slots(
+            ptable["keys"], ptable["used"], ptable["n"], keys, active
+        )
+        is_timer = batch.valid & (batch.kind == KIND_TIMER)
+
+        def make_valid(p):
+            return (active & (slot == p)) | is_timer
+
+        states2, outs, aux = self._vmapped(states, make_valid, batch, now)
+        aux["partition_overflow"] = aux.get("partition_overflow", jnp.bool_(False)) | povf
+        return {"keys": pk, "used": pu, "n": pn}, states2, outs, aux
+
+    def _pstep_inner_impl(self, states, pbatch, now):
+        """pbatch: EventBatch with leading [P] axis on every lane."""
+        def one(state, b2):
+            st, _ts, out, aux = self._step_impl(state, {}, b2, now)
+            return st, out, aux
+
+        states2, outs, auxs = jax.vmap(one)(states, pbatch)
+        aux = {}
+        for k, v in auxs.items():
+            aux[k] = v.min() if k == "next_timer" else v.any()
+        return states2, outs, aux
+
+    # ---- host ----------------------------------------------------------------
+
+    def receive_partitioned(self, ptable, batch: EventBatch, now: int):
+        """Outer-stream arrival. Returns (ptable', flat_out, p_out, aux)."""
+        with self._receive_lock:
+            if self.state is None:
+                self.state = self.init_state()
+            ptable, self.state, outs, aux = self._pstep_outer(
+                ptable, self.state, batch, jnp.asarray(now, jnp.int64)
+            )
+        self._warn_aux(aux)
+        return ptable, _flatten(outs), outs, aux
+
+    def receive_inner(self, pbatch, now: int):
+        with self._receive_lock:
+            if self.state is None:
+                self.state = self.init_state()
+            self.state, outs, aux = self._pstep_inner(
+                self.state, pbatch, jnp.asarray(now, jnp.int64)
+            )
+        self._warn_aux(aux)
+        return _flatten(outs), outs, aux
+
+
+def _flatten(outs: EventBatch) -> EventBatch:
+    """[P, K] partitioned output -> [K*P] flat batch ordered by output
+    position first (temporal order), partition second."""
+    def f(x):
+        return jnp.swapaxes(x, 0, 1).reshape(-1)
+
+    return EventBatch(
+        ts=f(outs.ts),
+        kind=f(outs.kind),
+        valid=f(outs.valid),
+        cols={n: f(c) for n, c in outs.cols.items()},
+    )
+
+
+class PartitionRuntime:
+    """Host orchestration of one `partition with (...) begin ... end` block."""
+
+    def __init__(self, partition: Partition, app_runtime, pid: str):
+        self.partition = partition
+        self.app = app_runtime
+        self.pid = pid
+        self.p = app_runtime._capacity_annotation(
+            "app:partitionCapacity", DEFAULT_PARTITIONS
+        )
+        interner = app_runtime.interner
+
+        # key executors per partitioned stream
+        # (reference: Value/RangePartitionExecutor)
+        self.key_fns: dict[str, Callable] = {}
+        for pt in partition.partition_types:
+            schema = app_runtime.stream_schemas.get(pt.stream_id)
+            if schema is None:
+                raise SiddhiAppCreationError(
+                    f"partition: stream '{pt.stream_id}' is not defined"
+                )
+            scope = Scope(interner)
+            scope.add_stream(pt.stream_id, schema.attr_types)
+            if isinstance(pt, ValuePartitionType):
+                ce = compile_expression(pt.expression, scope)
+                if ce.type is AttrType.OBJECT:
+                    raise SiddhiAppCreationError("cannot partition by OBJECT")
+                is_float = ce.type in (AttrType.FLOAT, AttrType.DOUBLE)
+
+                def key_of(env, _ce=ce, _f=is_float):
+                    v = _ce(env)
+                    if _f:
+                        v = jnp.asarray(v).view(jnp.int32)
+                    k = v.astype(jnp.int64)
+                    return k, jnp.ones_like(k, dtype=jnp.bool_)
+
+            else:
+                assert isinstance(pt, RangePartitionType)
+                conds = []
+                for rp in pt.ranges:
+                    c = compile_expression(rp.condition, scope)
+                    if c.type is not AttrType.BOOL:
+                        raise SiddhiAppCreationError(
+                            "range partition conditions must be boolean"
+                        )
+                    conds.append(c)
+
+                def key_of(env, _conds=tuple(conds)):
+                    key = None
+                    matched = None
+                    for i, c in enumerate(_conds):
+                        m = c(env)
+                        if key is None:
+                            key = jnp.where(m, jnp.int64(i), jnp.int64(-1))
+                            matched = m
+                        else:
+                            key = jnp.where(~matched & m, jnp.int64(i), key)
+                            matched = matched | m
+                    return key, matched  # unmatched rows are dropped
+
+            self.key_fns[pt.stream_id] = key_of
+
+        # shared partition key table (one key space per partition block,
+        # reference: PartitionRuntime per-key instance map)
+        self.ptable = {
+            "keys": jnp.zeros((self.p,), jnp.int64),
+            "used": jnp.zeros((self.p,), jnp.bool_),
+            "n": jnp.zeros((), jnp.int32),
+        }
+
+        # inner (#stream) plumbing: [P]-shaped pub/sub
+        self.inner_schemas: dict[str, StreamSchema] = {}
+        self.inner_subscribers: dict[str, list] = {}
+
+        self.queries: list[PartitionedQueryRuntime] = []
+        unnamed = 0
+        for q in partition.queries:
+            from siddhi_tpu.query_api.annotation import find_annotation
+
+            info = find_annotation(q.annotations, "info")
+            qid = (info.element("name") if info else None) or f"{pid}_query{unnamed}"
+            unnamed += 1
+            self._add_query(qid, q)
+
+    def _add_query(self, qid: str, query: Query) -> None:
+        app = self.app
+        stream = query.input_stream
+        if not isinstance(stream, SingleInputStream):
+            raise SiddhiAppCreationError(
+                "joins/patterns inside partitions are not supported yet"
+            )
+        is_inner = stream.is_inner
+        if is_inner:
+            in_schema = self.inner_schemas.get(stream.stream_id)
+            if in_schema is None:
+                raise SiddhiAppCreationError(
+                    f"inner stream '#{stream.stream_id}' is not produced by an "
+                    "earlier query in this partition"
+                )
+            key_of = None
+        else:
+            in_schema = app.stream_schemas.get(stream.stream_id)
+            if in_schema is None:
+                raise SiddhiAppCreationError(
+                    f"stream '{stream.stream_id}' is not defined"
+                )
+            key_of = self.key_fns.get(stream.stream_id)
+            if key_of is None:
+                raise SiddhiAppCreationError(
+                    f"partition has no key for stream '{stream.stream_id}'"
+                )
+
+        qr = PartitionedQueryRuntime(
+            query, qid, in_schema, app.interner,
+            p_capacity=self.p, key_of=key_of,
+            group_capacity=app.group_capacity,
+        )
+        self.queries.append(qr)
+        app.queries[qid] = qr
+
+        out = query.output_stream
+        target = getattr(out, "target", None)
+        if target is not None and not getattr(out, "is_inner", False) and (
+            target in app.tables
+        ):
+            raise SiddhiAppCreationError(
+                "writing to a table from inside a partition is not supported yet"
+            )
+        inner_target = isinstance(out, InsertIntoStream) and out.is_inner
+        if inner_target:
+            self.inner_schemas[out.target] = StreamSchema(
+                out.target, qr.out_schema.attrs
+            )
+            subs = self.inner_subscribers.setdefault(out.target, [])
+
+            def publish_inner(p_out, now, _subs=subs):
+                for fn in _subs:
+                    fn(p_out, now)
+
+            qr.inner_publish = publish_inner
+        else:
+            app._wire_insert(qr)
+
+        decode = app._decode
+
+        if is_inner:
+            def recv_inner(p_out, now, _qr=qr):
+                flat, p_out2, aux = _qr.receive_inner(p_out, now)
+                self._route(_qr, flat, p_out2, now, decode)
+                app._maybe_schedule(_qr, aux)
+
+            self.inner_subscribers[stream.stream_id].append(recv_inner)
+
+            if qr.needs_scheduler:
+                # TIMER batches for [P]-shaped inner inputs are tiled across
+                # the partition axis (every partition's clock advances)
+                def fire_inner(t_ms: int, _qr=qr, _schema=in_schema) -> None:
+                    one = app._timer_batch(_schema, t_ms)
+                    pbatch = jax.tree_util.tree_map(
+                        lambda x: _tile(x, _qr.p), one
+                    )
+                    with app._process_lock:
+                        flat, p_out2, aux = _qr.receive_inner(pbatch, t_ms)
+                        self._route(_qr, flat, p_out2, t_ms, decode)
+                    app._maybe_schedule(_qr, aux)
+
+                qr.timer_target = fire_inner
+        else:
+            def receive(batch: EventBatch, now: int, _qr=qr) -> None:
+                with app._process_lock:
+                    self.ptable, flat, p_out, aux = _qr.receive_partitioned(
+                        self.ptable, batch, now
+                    )
+                    self._route(_qr, flat, p_out, now, decode)
+                app._maybe_schedule(_qr, aux)
+
+            app._junction(stream.stream_id).subscribe(receive)
+
+            if qr.needs_scheduler:
+                def fire(t_ms: int, _qr=qr, _schema=in_schema) -> None:
+                    batch = app._timer_batch(_schema, t_ms)
+                    with app._process_lock:
+                        self.ptable, flat, p_out, aux = _qr.receive_partitioned(
+                            self.ptable, batch, t_ms
+                        )
+                        self._route(_qr, flat, p_out, t_ms, decode)
+                    app._maybe_schedule(_qr, aux)
+
+                qr.timer_target = fire
+
+    def _route(self, qr, flat: EventBatch, p_out, now: int, decode) -> None:
+        if qr.inner_publish is not None:
+            qr.inner_publish(p_out, now)
+            # callbacks on inner-targeted queries still see the flat view
+            if qr.query_callbacks:
+                qr.route_output(flat, now, decode)
+        else:
+            qr.route_output(flat, now, decode)
