@@ -527,11 +527,94 @@ class SiddhiAppRuntime:
             tr.stop()
         self._scheduler.shutdown()
 
-    def persist(self):  # M11
-        raise NotImplementedError("persistence lands in M11")
+    # ---- snapshot / persistence (reference: SiddhiAppRuntime.persist/
+    # restore/restoreRevision/restoreLastRevision :560-600) -----------------
 
-    def restore_last_revision(self):  # M11
-        raise NotImplementedError("persistence lands in M11")
+    @property
+    def snapshot_service(self):
+        svc = getattr(self, "_snapshot_service", None)
+        if svc is None:
+            from siddhi_tpu.core.persistence import SnapshotService
+
+            svc = self._snapshot_service = SnapshotService(self)
+        return svc
+
+    def snapshot(self) -> bytes:
+        return self.snapshot_service.full_snapshot()
+
+    def restore(self, snapshot: bytes) -> None:
+        self.snapshot_service.restore(snapshot)
+
+    def _store(self):
+        store = self.manager.persistence_store
+        if store is None:
+            raise SiddhiAppCreationError(
+                "no persistence store set; call "
+                "manager.set_persistence_store(...) first"
+            )
+        return store
+
+    def persist(self) -> str:
+        import time as _time
+
+        store = self._store()
+        svc = self.snapshot_service
+        if getattr(store, "incremental", False):
+            data = svc.incremental_snapshot()
+        else:
+            data = svc.full_snapshot(track_base=True)
+        # strictly monotone revision ids (two persists can share a millisecond)
+        now = int(_time.time() * 1000)
+        last = getattr(self, "_last_rev_ms", -1)
+        now = max(now, last + 1)
+        self._last_rev_ms = now
+        revision = f"{now}_{self.name}"
+        store.save(self.name, revision, data)
+        return revision
+
+    def restore_revision(self, revision: str) -> None:
+        store = self._store()
+        data = store.load(self.name, revision)
+        if data is None:
+            raise SiddhiAppCreationError(f"no revision '{revision}'")
+        if getattr(store, "incremental", False):
+            # replay: the latest full snapshot at-or-before this revision,
+            # plus every delta after it up to this revision
+            chain = self._incremental_chain(store, upto=revision)
+            self.snapshot_service.restore(*chain)
+        else:
+            self.snapshot_service.restore(data)
+
+    def restore_last_revision(self) -> None:
+        store = self._store()
+        last = store.get_last_revision(self.name)
+        if last is None:
+            return
+        self.restore_revision(last)
+
+    def _incremental_chain(self, store, upto: str) -> list[bytes]:
+        """[latest full at-or-before `upto`] + [the target delta] — every
+        delta is diffed against the last persisted FULL snapshot, so earlier
+        deltas must NOT be replayed (their leaves may have reverted since)."""
+        import pickle as _pickle
+
+        revs = [
+            r for r in store.list_revisions(self.name)
+            if int(r.split("_", 1)[0]) <= int(upto.split("_", 1)[0])
+        ]
+        base: bytes | None = None
+        target: bytes | None = None
+        for r in revs:
+            data = store.load(self.name, r)
+            if data is None:
+                continue
+            if _pickle.loads(data)["type"] == "full":
+                base, target = data, None
+            elif r == upto:
+                target = data
+        if base is None:
+            return []
+        return [base] if target is None else [base, target]
 
 
 def _pattern_timer_batch(t_ms: int) -> EventBatch:

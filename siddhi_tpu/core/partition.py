@@ -190,16 +190,14 @@ class PartitionRuntime:
             scope = Scope(interner)
             scope.add_stream(pt.stream_id, schema.attr_types)
             if isinstance(pt, ValuePartitionType):
+                from siddhi_tpu.core.groupby import _as_key_col
+
                 ce = compile_expression(pt.expression, scope)
                 if ce.type is AttrType.OBJECT:
                     raise SiddhiAppCreationError("cannot partition by OBJECT")
-                is_float = ce.type in (AttrType.FLOAT, AttrType.DOUBLE)
 
-                def key_of(env, _ce=ce, _f=is_float):
-                    v = _ce(env)
-                    if _f:
-                        v = jnp.asarray(v).view(jnp.int32)
-                    k = v.astype(jnp.int64)
+                def key_of(env, _ce=ce):
+                    k = _as_key_col(_ce(env), _ce.type)
                     return k, jnp.ones_like(k, dtype=jnp.bool_)
 
             else:
@@ -300,8 +298,14 @@ class PartitionRuntime:
                 out.target, qr.out_schema.attrs
             )
             subs = self.inner_subscribers.setdefault(out.target, [])
+            from siddhi_tpu.core.app_runtime import _make_insert_transform
 
-            def publish_inner(p_out, now, _subs=subs):
+            # honor `insert [current|expired|all] events into #T` and rewrite
+            # inserted kinds to CURRENT, like the outer insert path
+            transform = _make_insert_transform(out.output_events)
+
+            def publish_inner(p_out, now, _subs=subs, _t=transform):
+                p_out = _t(p_out)  # elementwise: works on the [P, K] lanes
                 for fn in _subs:
                     fn(p_out, now)
 

@@ -1,0 +1,308 @@
+"""Snapshot / persistence: checkpoint and restore of all carried state.
+
+Reference: util/snapshot/SnapshotService.java:45-520 — walks every registered
+`Snapshotable` (window queues, NFA token lists, tables, aggregator buckets,
+rate limiters) under the ThreadBarrier, Java-serializes a nested map;
+util/persistence/{InMemory,FileSystem,IncrementalFileSystem}PersistenceStore
+keep revisions named `<timestamp>_<appName>`; restore paths
+SiddhiAppRuntime.restore/restoreRevision/restoreLastRevision (:560-600).
+
+Here every stateful component's carried state is a device pytree; a snapshot
+is the pytree forest pulled to host numpy plus the host-side bits (intern
+table, rate-limiter buffers), pickled. Incremental snapshots store only the
+leaves that changed since the previous full snapshot (the analog of the
+reference's base/delta split over table operation logs).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+import re
+import threading
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# persistence stores
+# ---------------------------------------------------------------------------
+
+
+class InMemoryPersistenceStore:
+    """reference: util/persistence/InMemoryPersistenceStore.java:30."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._data: dict[str, dict[str, bytes]] = {}
+
+    def save(self, app_name: str, revision: str, snapshot: bytes) -> None:
+        with self._lock:
+            self._data.setdefault(app_name, {})[revision] = snapshot
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(app_name, {}).get(revision)
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        with self._lock:
+            revs = self._data.get(app_name)
+            if not revs:
+                return None
+            return max(revs, key=lambda r: int(r.split("_", 1)[0]))
+
+    def list_revisions(self, app_name: str) -> list[str]:
+        with self._lock:
+            return sorted(
+                self._data.get(app_name, {}), key=lambda r: int(r.split("_", 1)[0])
+            )
+
+    def clear_all_revisions(self, app_name: str) -> None:
+        with self._lock:
+            self._data.pop(app_name, None)
+
+
+class FileSystemPersistenceStore:
+    """reference: util/persistence/FileSystemPersistenceStore.java:32."""
+
+    def __init__(self, base_path: str) -> None:
+        self.base_path = base_path
+
+    def _dir(self, app_name: str) -> str:
+        return os.path.join(self.base_path, app_name)
+
+    def save(self, app_name: str, revision: str, snapshot: bytes) -> None:
+        d = self._dir(app_name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, revision), "wb") as f:
+            f.write(snapshot)
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        p = os.path.join(self._dir(app_name), revision)
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        d = self._dir(app_name)
+        if not os.path.isdir(d):
+            return None
+        revs = [f for f in os.listdir(d) if re.match(r"^\d+_", f)]
+        if not revs:
+            return None
+        return max(revs, key=lambda r: int(r.split("_", 1)[0]))
+
+    def list_revisions(self, app_name: str) -> list[str]:
+        d = self._dir(app_name)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            (f for f in os.listdir(d) if re.match(r"^\d+_", f)),
+            key=lambda r: int(r.split("_", 1)[0]),
+        )
+
+    def clear_all_revisions(self, app_name: str) -> None:
+        d = self._dir(app_name)
+        if os.path.isdir(d):
+            for f in os.listdir(d):
+                os.unlink(os.path.join(d, f))
+
+
+class IncrementalFileSystemPersistenceStore(FileSystemPersistenceStore):
+    """Marker subclass: SnapshotService stores base + delta revisions here
+    (reference: IncrementalFileSystemPersistenceStore)."""
+
+    incremental = True
+
+
+# ---------------------------------------------------------------------------
+# snapshot service
+# ---------------------------------------------------------------------------
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _to_device(tree):
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda x: jnp.asarray(x), tree)
+
+
+def _flat_with_paths(tree) -> dict:
+    """{path_str: leaf} using jax's path-aware flatten (structure-exact)."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {jax.tree_util.keystr(path): leaf for path, leaf in leaves}
+
+
+class SnapshotService:
+    """reference: util/snapshot/SnapshotService.java — here the registry is
+    the app runtime's component maps; the app process lock is the barrier."""
+
+    def __init__(self, app_runtime) -> None:
+        self.rt = app_runtime
+        self._last_full: Optional[dict] = None  # {element: {path: leaf}}
+
+    # ---- collection -------------------------------------------------------
+
+    def _elements(self) -> dict:
+        """Every stateful component's live state, keyed by stable element id."""
+        rt = self.rt
+        out: dict[str, object] = {}
+        for qid, qr in rt.queries.items():
+            if qr.state is not None:
+                out[f"query:{qid}"] = qr.state
+            rl = getattr(qr, "rate_limiter", None)
+            if rl is not None:
+                out[f"rate:{qid}"] = dict(vars(rl))
+        for tid, t in rt.tables.items():
+            out[f"table:{tid}"] = t.state
+        for wid, nw in rt.named_windows.items():
+            out[f"window:{wid}"] = nw.state
+        for aid, ar in rt.aggregations.items():
+            out[f"aggregation:{aid}"] = ar.state
+        for i, pr in enumerate(rt.partitions):
+            out[f"partition:{i}:keys"] = pr.ptable
+        return out
+
+    def _restore_elements(self, elements: dict) -> None:
+        rt = self.rt
+        for key, value in elements.items():
+            kind, _, name = key.partition(":")
+            if kind == "query":
+                qr = rt.queries.get(name)
+                if qr is not None:
+                    qr.state = _to_device(value)
+            elif kind == "rate":
+                qr = rt.queries.get(name)
+                rl = getattr(qr, "rate_limiter", None) if qr else None
+                if rl is not None:
+                    vars(rl).update(value)
+            elif kind == "table":
+                t = rt.tables.get(name)
+                if t is not None:
+                    t.state = _to_device(value)
+            elif kind == "window":
+                nw = rt.named_windows.get(name)
+                if nw is not None:
+                    nw.state = _to_device(value)
+            elif kind == "aggregation":
+                ar = rt.aggregations.get(name)
+                if ar is not None:
+                    ar.state = _to_device(value)
+            elif kind == "partition":
+                idx = int(name.split(":")[0])
+                if idx < len(rt.partitions):
+                    rt.partitions[idx].ptable = _to_device(value)
+
+    # ---- full / incremental snapshots -------------------------------------
+
+    def full_snapshot(self, track_base: bool = False) -> bytes:
+        with self.rt._process_lock:  # the reference's ThreadBarrier stop-world
+            all_elems = self._elements()
+            elements = {
+                k: _to_host(v) for k, v in all_elems.items()
+                if not k.startswith("rate:")
+            }
+            rates = {k: v for k, v in all_elems.items() if k.startswith("rate:")}
+            interner = list(self.rt.interner._from_id[1:])
+        if track_base:
+            # deltas are diffed against the last PERSISTED full snapshot only
+            # (a bytes-API snapshot must not shift the delta base)
+            self._last_full = {k: _flat_with_paths(v) for k, v in elements.items()}
+        payload = {
+            "type": "full",
+            "app": self.rt.name,
+            "time": int(time.time() * 1000),
+            "interner": interner,
+            "elements": elements,
+            "rates": rates,
+        }
+        buf = io.BytesIO()
+        pickle.dump(payload, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        return buf.getvalue()
+
+    def incremental_snapshot(self) -> bytes:
+        """Leaves changed since the last full snapshot (falls back to full
+        when no base exists) — the analog of the reference's base/delta split."""
+        if self._last_full is None:
+            return self.full_snapshot(track_base=True)
+        with self.rt._process_lock:
+            all_elems = self._elements()
+            elements = {
+                k: _to_host(v) for k, v in all_elems.items()
+                if not k.startswith("rate:")
+            }
+            rates = {k: v for k, v in all_elems.items() if k.startswith("rate:")}
+            interner = list(self.rt.interner._from_id[1:])
+        delta: dict[str, dict] = {}
+        for k, v in elements.items():
+            flat = _flat_with_paths(v)
+            base = self._last_full.get(k, {})
+            changed = {
+                p: leaf
+                for p, leaf in flat.items()
+                if p not in base
+                or not isinstance(leaf, np.ndarray)
+                or base[p].shape != leaf.shape
+                or not np.array_equal(base[p], leaf, equal_nan=True)
+            }
+            if changed:
+                delta[k] = changed
+        payload = {
+            "type": "incremental",
+            "app": self.rt.name,
+            "time": int(time.time() * 1000),
+            "interner": interner,
+            "delta": delta,
+            "rates": rates,
+        }
+        buf = io.BytesIO()
+        pickle.dump(payload, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        return buf.getvalue()
+
+    # ---- restore -----------------------------------------------------------
+
+    def restore(self, *snapshots: bytes) -> None:
+        """Restore a full snapshot followed by incremental deltas, in order."""
+        if not snapshots:
+            return
+        payloads = [pickle.loads(s) for s in snapshots]
+        if payloads[0]["type"] != "full":
+            raise ValueError("restore needs a full snapshot first")
+        with self.rt._process_lock:
+            # interner: restored ids must resolve to their original strings
+            interner = self.rt.interner
+            for i, v in enumerate(payloads[-1]["interner"], start=1):
+                if i < len(interner._from_id):
+                    if interner._from_id[i] != v:
+                        raise ValueError(
+                            f"intern table conflict at id {i}: "
+                            f"{interner._from_id[i]!r} != {v!r}"
+                        )
+                else:
+                    interner._to_id[v] = i
+                    interner._from_id.append(v)
+            elements = dict(payloads[0]["elements"])
+            rates = dict(payloads[0].get("rates", {}))
+            for p in payloads[1:]:
+                if p["type"] != "incremental":
+                    raise ValueError("later snapshots must be incremental")
+                for k, changed in p["delta"].items():
+                    if k not in elements:
+                        continue
+                    paths, treedef = jax.tree_util.tree_flatten_with_path(elements[k])
+                    leaves = [
+                        changed.get(jax.tree_util.keystr(path), leaf)
+                        for path, leaf in paths
+                    ]
+                    elements[k] = jax.tree_util.tree_unflatten(treedef, leaves)
+                rates.update(p.get("rates", {}))
+            self._restore_elements(elements)
+            self._restore_elements(rates)

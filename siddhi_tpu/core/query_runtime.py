@@ -207,6 +207,20 @@ class BaseQueryRuntime:
                 self.query_id,
             )
         if (
+            not getattr(self, "_warned_partition_overflow", False)
+            and "partition_overflow" in aux
+            and bool(aux["partition_overflow"])
+        ):
+            self._warned_partition_overflow = True
+            import logging
+
+            logging.getLogger(__name__).error(
+                "query '%s': partition key table overflowed; events of "
+                "overflowed keys were dropped — raise it with "
+                "@app:partitionCapacity(size='N')",
+                self.query_id,
+            )
+        if (
             not getattr(self, "_warned_window_overflow", False)
             and "window_overflow" in aux
             and bool(aux["window_overflow"])
