@@ -53,9 +53,41 @@ class SiddhiAppRuntime:
         self.clock = system_clock_ms
         self._running = False
         self._lock = threading.RLock()
-        from siddhi_tpu.core.scheduler import SystemTimeScheduler
+        self._debugger = None
 
-        self._scheduler = SystemTimeScheduler()
+        # @app:playback(idle.time, increment): event-time clock + scheduler
+        # (reference: SiddhiAppParser.java:166-212)
+        self._playback_clock = None
+        pb = find_annotation(app.annotations, "app:playback")
+        if pb is not None:
+            from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+            from siddhi_tpu.core.timestamp import EventTimeClock, EventTimeScheduler
+
+            idle = pb.element("idle.time")
+            inc = pb.element("increment")
+            self._playback_clock = EventTimeClock(
+                idle_ms=SiddhiCompiler.parse_time_constant(idle) if idle else None,
+                increment_ms=SiddhiCompiler.parse_time_constant(inc) if inc else None,
+            )
+            self.clock = self._playback_clock.now
+            self._scheduler = EventTimeScheduler(self._playback_clock)
+        else:
+            from siddhi_tpu.core.scheduler import SystemTimeScheduler
+
+            self._scheduler = SystemTimeScheduler()
+
+        # @app:statistics(reporter='console'|'log', interval='N')
+        # (reference: SiddhiAppParser.java:106-142)
+        self.statistics_manager = None
+        st = find_annotation(app.annotations, "app:statistics")
+        if st is not None:
+            from siddhi_tpu.core.statistics import StatisticsManager
+
+            self.statistics_manager = StatisticsManager(
+                self.name,
+                reporter=st.element("reporter", "console"),
+                interval_s=float(st.element("interval", "60")),
+            )
 
         self.stream_schemas: dict[str, StreamSchema] = {}
         self.junctions: dict[str, StreamJunction] = {}
@@ -74,6 +106,23 @@ class SiddhiAppRuntime:
             self.stream_schemas[sid] = StreamSchema(
                 sid, [(a.name, a.type) for a in d.attributes]
             )
+            # @async(buffer.size, workers, batch.size.max) — buffered ingress
+            # ring with worker batching (reference: StreamJunction.java:87-117)
+            a = find_annotation(d.annotations, "async")
+            if a is not None:
+                j = self._junction(sid)
+                j.enable_async(
+                    buffer_size=int(a.element("buffer.size", "1024")),
+                    workers=int(a.element("workers", "1")),
+                    batch_max=int(a.element("batch.size.max", "0")) or None,
+                )
+            if self.statistics_manager is not None:
+                tracker = self.statistics_manager.throughput_tracker(
+                    f"stream.{sid}"
+                )
+                self._junction(sid).on_publish_stats = tracker.add
+                bt = self.statistics_manager.buffered_tracker(f"stream.{sid}")
+                bt.register(self._junction(sid).queued)
 
         from siddhi_tpu.core.table import DEFAULT_TABLE_CAPACITY, InMemoryTable
 
@@ -164,6 +213,29 @@ class SiddhiAppRuntime:
             self.triggers[tid] = TriggerRuntime(
                 td, self._junction(tid), self._scheduler, lambda: self.clock()
             )
+
+        # @source/@sink transports on stream definitions
+        # (reference: DefinitionParserHelper.addEventSource/Sink :302,419)
+        from siddhi_tpu.core.io import build_sink, build_source
+        from siddhi_tpu.query_api.annotation import find_all
+
+        self.sources: list = []
+        self.sinks: list = []
+        for sid, d in app.stream_definitions.items():
+            schema = self.stream_schemas[sid]
+            for ann in find_all(d.annotations, "source"):
+                # via get_input_handler so playback apps advance event time
+                self.sources.append(
+                    build_source(ann, sid, schema, self.get_input_handler(sid))
+                )
+            for ann in find_all(d.annotations, "sink"):
+                sink = build_sink(ann, sid, schema)
+                self.sinks.append(sink)
+                self._junction(sid).add_stream_callback(
+                    lambda rows, _s=sink: _s.on_events(
+                        [Event(t, data) for t, data in rows]
+                    )
+                )
 
         from siddhi_tpu.core.partition import PartitionRuntime
 
@@ -277,11 +349,39 @@ class SiddhiAppRuntime:
 
         decode = self._decode
         in_junction = src_junction or self._junction(stream.stream_id)
+        lt = (
+            self.statistics_manager.latency_tracker(f"query.{qid}")
+            if self.statistics_manager is not None
+            else None
+        )
 
-        def receive(batch: EventBatch, now: int, _qr=qr) -> None:
+        def receive(
+            batch: EventBatch, now: int, _qr=qr, _lt=lt, _qid=qid,
+            _schema=in_schema,
+        ) -> None:
+            dbg = self._debugger
+            if dbg is not None:
+                from siddhi_tpu.core.debugger import QueryTerminal
+
+                dbg.check(
+                    _qid, QueryTerminal.IN,
+                    lambda: [Event(t, d) for t, _k, d in decode(_schema, batch)],
+                )
+            if _lt is not None:
+                _lt.mark_in()
             with self._process_lock:
                 out_batch, aux = _qr.receive(batch, now)
                 _qr.route_output(out_batch, now, decode)
+            if _lt is not None:
+                _lt.mark_out()
+            if dbg is not None:
+                dbg.check(
+                    _qid, QueryTerminal.OUT,
+                    lambda: [
+                        Event(t, d)
+                        for t, _k, d in decode(_qr.out_schema, out_batch)
+                    ],
+                )
             self._maybe_schedule(_qr, aux)
 
         in_junction.subscribe(receive)
@@ -441,9 +541,24 @@ class SiddhiAppRuntime:
     # ---- public API (reference: SiddhiAppRuntime callbacks/handlers) -----
 
     def get_input_handler(self, stream_id: str) -> InputHandler:
-        return InputHandler(self._junction(stream_id), lambda: self.clock())
+        h = InputHandler(self._junction(stream_id), lambda: self.clock())
+        if self._playback_clock is not None:
+            return _PlaybackInputHandler(h, self._playback_clock)
+        return h
 
     input_handler = get_input_handler
+
+    def debug(self):
+        """Step-mode debugger (reference: SiddhiAppRuntime.debug:509)."""
+        from siddhi_tpu.core.debugger import SiddhiDebugger
+
+        if self._debugger is None:
+            self._debugger = SiddhiDebugger(self)
+        return self._debugger
+
+    def enable_stats(self, enabled: bool) -> None:
+        if self.statistics_manager is not None:
+            self.statistics_manager.enabled = enabled
 
     def add_callback(self, name: str, callback: Callable) -> None:
         """Stream callback `cb(events: list[Event])` or query callback
@@ -502,6 +617,10 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._running = True
+        if self.statistics_manager is not None:
+            self.statistics_manager.start_reporting()
+        if self._playback_clock is not None:
+            self._playback_clock.start_heartbeat()
         # absent-at-start patterns must arm their timers before any event
         # (reference: SiddhiAppRuntime.start -> eternalReferencedHolders.start)
         from siddhi_tpu.core.pattern_runtime import PatternQueryRuntime
@@ -516,15 +635,31 @@ class SiddhiAppRuntime:
                     qr.host_next_timer(self.clock()), qr.timer_target
                 )
             self._arm_rate_limiter(qr)
-        # triggers fire last so their events find fully-wired queries
-        # (reference: SiddhiAppRuntime.start sources-last ordering)
+        # lifecycle ordering (reference: SiddhiAppRuntime.start:353-394):
+        # sinks connect before sources so no event finds a dead egress;
+        # triggers and sources begin last, into fully-wired queries
+        for sink in self.sinks:
+            sink.connect_with_retry()
+        for src in self.sources:
+            src.connect_with_retry()
         for tr in self.triggers.values():
             tr.start()
 
     def shutdown(self) -> None:
         self._running = False
+        for src in self.sources:
+            src.stop()  # cancels pending reconnect retries too
         for tr in self.triggers.values():
             tr.stop()
+        for j in self.junctions.values():
+            if j.is_async:
+                j.stop_async()
+        for sink in self.sinks:
+            sink.stop()
+        if self.statistics_manager is not None:
+            self.statistics_manager.stop_reporting()
+        if self._playback_clock is not None:
+            self._playback_clock.stop()
         self._scheduler.shutdown()
 
     # ---- snapshot / persistence (reference: SiddhiAppRuntime.persist/
@@ -627,6 +762,32 @@ def _pattern_timer_batch(t_ms: int) -> EventBatch:
         valid=_jnp.asarray([True]),
         cols={},
     )
+
+
+class _PlaybackInputHandler:
+    """Advances the playback clock to each event's timestamp before dispatch
+    (reference: EventTimeBasedMillisTimestampGenerator wiring)."""
+
+    def __init__(self, inner: InputHandler, clock):
+        self._inner = inner
+        self._pb = clock
+
+    def send(self, data, timestamp=None):
+        if timestamp is not None:
+            self._pb.advance(timestamp)
+        self._inner.send(data, timestamp)
+
+    def send_many(self, rows, timestamps=None):
+        if timestamps:
+            self._pb.advance(max(timestamps))
+        self._inner.send_many(rows, timestamps)
+
+    def send_columns(self, timestamps, cols, now=None):
+        import numpy as np
+
+        if len(timestamps):
+            self._pb.advance(int(np.max(timestamps)))
+        self._inner.send_columns(timestamps, cols, now)
 
 
 def _make_insert_transform(output_events: OutputEventsFor):

@@ -1,0 +1,92 @@
+"""Step-mode debugger: breakpoints at query IN/OUT terminals.
+
+Reference: debugger/SiddhiDebugger.java:36-260 — acquireBreakPoint(query,
+IN|OUT) blocks the processing thread on a semaphore when events cross the
+terminal; next() steps to the following breakpoint, play() releases until the
+same breakpoint recurs; getQueryState inspects the snapshot map. Wired through
+SiddhiAppRuntime.debug() (SiddhiAppRuntime.java:509-528).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Callable, Optional
+
+
+class QueryTerminal(enum.Enum):
+    IN = "IN"
+    OUT = "OUT"
+
+
+class SiddhiDebugger:
+    def __init__(self, app_runtime):
+        self.rt = app_runtime
+        self._breakpoints: set[tuple[str, QueryTerminal]] = set()
+        self._lock = threading.Lock()
+        self._gate = threading.Semaphore(0)
+        self._blocked = threading.Event()
+        self._current_bp: Optional[tuple[str, QueryTerminal]] = None
+        self._free_until: Optional[tuple[str, QueryTerminal]] = None
+        self.callback: Optional[Callable] = None  # (events, qid, terminal, dbg)
+
+    def set_debugger_callback(self, fn: Callable) -> None:
+        self.callback = fn
+
+    def acquire_break_point(self, query_name: str, terminal: QueryTerminal) -> None:
+        with self._lock:
+            self._breakpoints.add((query_name, terminal))
+
+    def release_break_point(self, query_name: str, terminal: QueryTerminal) -> None:
+        with self._lock:
+            self._breakpoints.discard((query_name, terminal))
+
+    def release_all_break_points(self) -> None:
+        with self._lock:
+            self._breakpoints.clear()
+
+    def next(self) -> None:
+        """Release the blocked thread to run to the NEXT breakpoint hit."""
+        self._gate.release()
+
+    def play(self) -> None:
+        """Release the blocked thread and run freely until the SAME breakpoint
+        is hit again (reference: SiddhiDebugger.play semantics)."""
+        with self._lock:
+            self._free_until = self._current_bp
+        self._gate.release()
+
+    def get_query_state(self, query_name: str):
+        qr = self.rt.queries.get(query_name)
+        if qr is None or qr.state is None:
+            return None
+        import numpy as np
+        import jax
+
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), qr.state)
+
+    # ---- engine hook (called from query receive paths) --------------------
+
+    def check(self, query_name: str, terminal: QueryTerminal, events_thunk) -> None:
+        """`events_thunk() -> list` is only evaluated when the breakpoint is
+        armed (decoding is not free on the hot path)."""
+        bp = (query_name, terminal)
+        with self._lock:
+            hit = bp in self._breakpoints
+            if hit and self._free_until is not None:
+                if bp == self._free_until:
+                    self._free_until = None  # play() ran back to this point
+                else:
+                    return  # free-running past other breakpoints
+        if not hit:
+            return
+        events = events_thunk()
+        if not events:
+            return
+        if self.callback is not None:
+            self.callback(events, query_name, terminal, self)
+        with self._lock:
+            self._current_bp = bp
+        self._blocked.set()
+        self._gate.acquire()  # block the processing thread until next()/play()
+        self._blocked.clear()

@@ -1,0 +1,519 @@
+"""Sources, sinks, mappers, the in-memory broker, and distributed sinks.
+
+Reference: stream/input/source/Source.java:42-126 (connect-with-retry,
+pause/resume), SourceMapper.java, InMemorySource.java; stream/output/sink/
+Sink.java:47-177 (publish with reconnect), SinkMapper.java, distributed
+strategies stream/output/sink/distributed/* + util/transport/
+{Single,Multi}ClientDistributedSink.java; util/transport/InMemoryBroker.java:29-53
+(static topic pub/sub) and BackoffRetryCounter.java.
+
+Host-side subsystem: transports feed the junction ingest path (which packs
+columnar device batches); egress drains decoded events through mappers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.extension import lookup
+
+
+class ConnectionUnavailableError(Exception):
+    """reference: exception/ConnectionUnavailableException."""
+
+
+# ---------------------------------------------------------------------------
+# in-memory broker (reference: util/transport/InMemoryBroker.java)
+# ---------------------------------------------------------------------------
+
+
+class InMemoryBroker:
+    _lock = threading.RLock()
+    _topics: dict[str, list] = {}
+
+    @classmethod
+    def subscribe(cls, subscriber) -> None:
+        """subscriber: object with .topic and .on_message(payload)."""
+        with cls._lock:
+            cls._topics.setdefault(subscriber.topic, []).append(subscriber)
+
+    @classmethod
+    def unsubscribe(cls, subscriber) -> None:
+        with cls._lock:
+            subs = cls._topics.get(subscriber.topic, [])
+            if subscriber in subs:
+                subs.remove(subscriber)
+
+    @classmethod
+    def publish(cls, topic: str, payload) -> None:
+        with cls._lock:
+            subs = list(cls._topics.get(topic, []))
+        for s in subs:
+            s.on_message(payload)
+
+
+class _BrokerSubscriber:
+    def __init__(self, topic: str, fn: Callable):
+        self.topic = topic
+        self.on_message = fn
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff (reference: util/transport/BackoffRetryCounter.java)
+# ---------------------------------------------------------------------------
+
+
+class BackoffRetryCounter:
+    INTERVALS_MS = [50, 100, 500, 1000, 5000, 10000, 30000, 60000]
+
+    def __init__(self) -> None:
+        self._i = 0
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def next_interval_ms(self) -> int:
+        iv = self.INTERVALS_MS[min(self._i, len(self.INTERVALS_MS) - 1)]
+        self._i += 1
+        return iv
+
+
+# ---------------------------------------------------------------------------
+# source mappers (wire payload -> event rows)
+# ---------------------------------------------------------------------------
+
+
+class SourceMapper:
+    """reference: stream/input/source/SourceMapper.java."""
+
+    def init(self, schema, options: dict) -> None:
+        self.schema = schema
+        self.options = options
+
+    def map(self, payload) -> list[tuple]:
+        raise NotImplementedError
+
+
+class PassThroughSourceMapper(SourceMapper):
+    """Payload is a row tuple, an Event, or a list of either."""
+
+    def map(self, payload) -> list[tuple]:
+        if isinstance(payload, Event):
+            return [tuple(payload.data)]
+        if isinstance(payload, (list,)) and payload and isinstance(
+            payload[0], (tuple, list, Event)
+        ):
+            return [
+                tuple(p.data) if isinstance(p, Event) else tuple(p) for p in payload
+            ]
+        return [tuple(payload)]
+
+
+class JsonSourceMapper(SourceMapper):
+    """JSON object (or list) keyed by attribute name; reference ecosystem:
+    siddhi-map-json's default mapping."""
+
+    def map(self, payload) -> list[tuple]:
+        obj = json.loads(payload) if isinstance(payload, (str, bytes)) else payload
+        objs = obj if isinstance(obj, list) else [obj]
+        out = []
+        for o in objs:
+            if "event" in o:  # siddhi-map-json envelope {"event": {...}}
+                o = o["event"]
+            out.append(tuple(o.get(n) for n in self.schema.attr_names))
+        return out
+
+
+class KeyValueSourceMapper(SourceMapper):
+    def map(self, payload) -> list[tuple]:
+        objs = payload if isinstance(payload, list) else [payload]
+        return [tuple(o.get(n) for n in self.schema.attr_names) for o in objs]
+
+
+class TextSourceMapper(SourceMapper):
+    """`attr:value` lines (reference ecosystem: siddhi-map-text default)."""
+
+    def map(self, payload) -> list[tuple]:
+        fields: dict[str, str] = {}
+        for line in str(payload).splitlines():
+            if ":" in line:
+                k, _, v = line.partition(":")
+                fields[k.strip()] = v.strip().strip('"')
+        from siddhi_tpu.core.types import AttrType
+
+        row = []
+        for name, t in self.schema.attrs:
+            v: Any = fields.get(name)
+            if v is None:
+                row.append(None)
+            elif t in (AttrType.INT, AttrType.LONG):
+                row.append(int(v))
+            elif t in (AttrType.FLOAT, AttrType.DOUBLE):
+                row.append(float(v))
+            elif t is AttrType.BOOL:
+                row.append(v.lower() == "true")
+            else:
+                row.append(v)
+        return [tuple(row)]
+
+
+SOURCE_MAPPERS = {
+    "passthrough": PassThroughSourceMapper,
+    "json": JsonSourceMapper,
+    "keyvalue": KeyValueSourceMapper,
+    "text": TextSourceMapper,
+}
+
+
+# ---------------------------------------------------------------------------
+# sink mappers (events -> wire payload)
+# ---------------------------------------------------------------------------
+
+
+class SinkMapper:
+    def init(self, schema, options: dict) -> None:
+        self.schema = schema
+        self.options = options
+
+    def map(self, events: list[Event]):
+        raise NotImplementedError
+
+
+class PassThroughSinkMapper(SinkMapper):
+    def map(self, events: list[Event]):
+        return events
+
+
+class JsonSinkMapper(SinkMapper):
+    def map(self, events: list[Event]):
+        return json.dumps(
+            [
+                {"event": dict(zip(self.schema.attr_names, e.data))}
+                for e in events
+            ]
+        )
+
+
+class KeyValueSinkMapper(SinkMapper):
+    def map(self, events: list[Event]):
+        return [dict(zip(self.schema.attr_names, e.data)) for e in events]
+
+
+class TextSinkMapper(SinkMapper):
+    def map(self, events: list[Event]):
+        return "\n\n".join(
+            "\n".join(f"{n}:{v!r}" for n, v in zip(self.schema.attr_names, e.data))
+            for e in events
+        )
+
+
+SINK_MAPPERS = {
+    "passthrough": PassThroughSinkMapper,
+    "json": JsonSinkMapper,
+    "keyvalue": KeyValueSinkMapper,
+    "text": TextSinkMapper,
+}
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+
+class Source:
+    """Transport SPI (reference: Source.java:42-126). Subclasses implement
+    connect/disconnect; arriving payloads go through self.mapper into
+    self.input_handler."""
+
+    def init(self, stream_id: str, options: dict, mapper: SourceMapper, input_handler) -> None:
+        self.stream_id = stream_id
+        self.options = options
+        self.mapper = mapper
+        self.input_handler = input_handler
+        self.paused = False
+        self._retry = BackoffRetryCounter()
+        self.connected = False
+        self._stopped = False
+
+    def connect(self) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        """Cancel pending reconnects and disconnect."""
+        self._stopped = True
+        self.disconnect()
+
+    def pause(self) -> None:
+        self.paused = True
+
+    def resume(self) -> None:
+        self.paused = False
+
+    def connect_with_retry(self) -> None:
+        """reference: Source.connectWithRetry:126 — exponential backoff in a
+        daemon thread until the transport comes up (or disconnect() cancels)."""
+        if self._stopped:
+            return
+        try:
+            self.connect()
+            self.connected = True
+            self._retry.reset()
+        except ConnectionUnavailableError:
+            iv = self._retry.next_interval_ms()
+
+            def retry():
+                time.sleep(iv / 1000.0)
+                if not self._stopped:
+                    self.connect_with_retry()
+
+            threading.Thread(target=retry, daemon=True).start()
+
+    def deliver(self, payload) -> None:
+        if self.paused:
+            return
+        rows = self.mapper.map(payload)
+        if rows:
+            self.input_handler.send_many(rows)
+
+
+class InMemorySource(Source):
+    """reference: stream/input/source/InMemorySource.java — broker topic."""
+
+    def connect(self) -> None:
+        topic = self.options.get("topic")
+        if topic is None:
+            raise SiddhiAppCreationError("@source(type='inMemory') needs a topic")
+        self._sub = _BrokerSubscriber(topic, self.deliver)
+        InMemoryBroker.subscribe(self._sub)
+
+    def disconnect(self) -> None:
+        sub = getattr(self, "_sub", None)
+        if sub is not None:
+            InMemoryBroker.unsubscribe(sub)
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+
+class Sink:
+    """reference: Sink.java:47-177 — publish with reconnect on
+    ConnectionUnavailableError."""
+
+    def init(self, stream_id: str, options: dict, mapper: Optional[SinkMapper]) -> None:
+        self.stream_id = stream_id
+        self.options = options
+        self.mapper = mapper
+        self.connected = False
+        self._retry = BackoffRetryCounter()
+        self._stopped = False
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    def connect_with_retry(self) -> None:
+        if self._stopped:
+            return
+        try:
+            self.connect()
+            self.connected = True
+            self._retry.reset()
+        except ConnectionUnavailableError:
+            iv = self._retry.next_interval_ms()
+
+            def retry():
+                time.sleep(iv / 1000.0)
+                if not self._stopped:
+                    self.connect_with_retry()
+
+            threading.Thread(target=retry, daemon=True).start()
+
+    def publish(self, payload) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        self._stopped = True
+        self.disconnect()
+
+    def on_events(self, events: list[Event]) -> None:
+        payload = self.mapper.map(events) if self.mapper else events
+        try:
+            self.publish(payload)
+        except ConnectionUnavailableError:
+            # reference: Sink.java:128-160 — reconnect, drop this payload
+            self.connected = False
+            self.connect_with_retry()
+
+
+class InMemorySink(Sink):
+    def connect(self) -> None:
+        self.topic = self.options.get("topic")
+        if self.topic is None:
+            raise SiddhiAppCreationError("@sink(type='inMemory') needs a topic")
+
+    def publish(self, payload) -> None:
+        InMemoryBroker.publish(self.topic, payload)
+
+
+class LogSink(Sink):
+    """reference: LogSink — event-level tracing egress."""
+
+    def connect(self) -> None:
+        import logging
+
+        self._log = logging.getLogger(f"siddhi_tpu.sink.{self.stream_id}")
+
+    def publish(self, payload) -> None:
+        self._log.info("%s : %s", self.stream_id, payload)
+
+
+SOURCES = {"inmemory": InMemorySource}
+SINKS = {"inmemory": InMemorySink, "log": LogSink}
+
+
+# ---------------------------------------------------------------------------
+# distributed sinks (reference: stream/output/sink/distributed/*)
+# ---------------------------------------------------------------------------
+
+
+class DistributedSink:
+    """Egress fan-out over N destination sinks with a distribution strategy
+    (reference: RoundRobin/Partitioned/Broadcast DistributionStrategy)."""
+
+    def __init__(self, sinks: list[Sink], strategy: str, partition_key: Optional[str], schema):
+        self.sinks = sinks
+        self.strategy = strategy.lower()
+        self.partition_key = partition_key
+        self.schema = schema
+        self._rr = 0
+        if self.strategy not in ("roundrobin", "partitioned", "broadcast"):
+            raise SiddhiAppCreationError(
+                f"unknown distribution strategy '{strategy}'"
+            )
+        if self.strategy == "partitioned" and partition_key is None:
+            raise SiddhiAppCreationError(
+                "partitioned distribution needs partitionKey"
+            )
+
+    def connect_with_retry(self) -> None:
+        for s in self.sinks:
+            s.connect_with_retry()
+
+    def disconnect(self) -> None:
+        for s in self.sinks:
+            s.disconnect()
+
+    def stop(self) -> None:
+        for s in self.sinks:
+            s.stop()
+
+    def on_events(self, events: list[Event]) -> None:
+        n = len(self.sinks)
+        if self.strategy == "broadcast":
+            for s in self.sinks:
+                s.on_events(events)
+        elif self.strategy == "roundrobin":
+            for e in events:
+                self.sinks[self._rr % n].on_events([e])
+                self._rr += 1
+        else:  # partitioned
+            import zlib
+
+            idx = self.schema.index_of(self.partition_key)
+            buckets: dict[int, list[Event]] = {}
+            for e in events:
+                # stable across processes (Python's hash() is salted)
+                h = zlib.crc32(repr(e.data[idx]).encode())
+                buckets.setdefault(h % n, []).append(e)
+            for i, evs in buckets.items():
+                self.sinks[i].on_events(evs)
+
+
+# ---------------------------------------------------------------------------
+# assembly from @source/@sink annotations
+# ---------------------------------------------------------------------------
+
+
+def _options(ann) -> dict:
+    return {k: v for k, v in ann.elements if k is not None}
+
+
+def _make_source_mapper(map_ann, schema) -> SourceMapper:
+    mtype = (map_ann.element("type") if map_ann else None) or "passThrough"
+    cls = SOURCE_MAPPERS.get(mtype.lower()) or lookup("source_mapper", mtype)
+    if cls is None:
+        raise SiddhiAppCreationError(f"unknown source mapper '{mtype}'")
+    m = cls()
+    m.init(schema, _options(map_ann) if map_ann else {})
+    return m
+
+
+def _make_sink_mapper(map_ann, schema) -> SinkMapper:
+    mtype = (map_ann.element("type") if map_ann else None) or "passThrough"
+    cls = SINK_MAPPERS.get(mtype.lower()) or lookup("sink_mapper", mtype)
+    if cls is None:
+        raise SiddhiAppCreationError(f"unknown sink mapper '{mtype}'")
+    m = cls()
+    m.init(schema, _options(map_ann) if map_ann else {})
+    return m
+
+
+def build_source(ann, stream_id: str, schema, input_handler) -> Source:
+    from siddhi_tpu.query_api.annotation import find_annotation
+
+    stype = ann.element("type")
+    if stype is None:
+        raise SiddhiAppCreationError("@source needs a type")
+    cls = SOURCES.get(stype.lower()) or lookup("source", stype)
+    if cls is None:
+        raise SiddhiAppCreationError(f"unknown source type '{stype}'")
+    mapper = _make_source_mapper(find_annotation(ann.annotations, "map"), schema)
+    src = cls()
+    src.init(stream_id, _options(ann), mapper, input_handler)
+    return src
+
+
+def build_sink(ann, stream_id: str, schema) -> object:
+    from siddhi_tpu.query_api.annotation import find_annotation, find_all
+
+    stype = ann.element("type")
+    if stype is None:
+        raise SiddhiAppCreationError("@sink needs a type")
+    cls = SINKS.get(stype.lower()) or lookup("sink", stype)
+    if cls is None:
+        raise SiddhiAppCreationError(f"unknown sink type '{stype}'")
+    map_ann = find_annotation(ann.annotations, "map")
+    dist = find_annotation(ann.annotations, "distribution")
+    if dist is None:
+        mapper = _make_sink_mapper(map_ann, schema)
+        sink = cls()
+        sink.init(stream_id, _options(ann), mapper)
+        return sink
+    # distributed: one destination sink per @destination, base options shared
+    dests = find_all(dist.annotations, "destination")
+    if not dests:
+        raise SiddhiAppCreationError("@distribution needs @destination entries")
+    sinks = []
+    for d in dests:
+        mapper = _make_sink_mapper(map_ann, schema)
+        s = cls()
+        s.init(stream_id, {**_options(ann), **_options(d)}, mapper)
+        sinks.append(s)
+    return DistributedSink(
+        sinks,
+        dist.element("strategy", "roundRobin"),
+        dist.element("partitionKey"),
+        schema,
+    )

@@ -155,12 +155,16 @@ class SnapshotService:
         """Every stateful component's live state, keyed by stable element id."""
         rt = self.rt
         out: dict[str, object] = {}
+        import copy
+
         for qid, qr in rt.queries.items():
             if qr.state is not None:
                 out[f"query:{qid}"] = qr.state
             rl = getattr(qr, "rate_limiter", None)
             if rl is not None:
-                out[f"rate:{qid}"] = dict(vars(rl))
+                # deep copy: the live buffers keep mutating once the process
+                # lock is released, while pickling happens outside it
+                out[f"rate:{qid}"] = copy.deepcopy(dict(vars(rl)))
         for tid, t in rt.tables.items():
             out[f"table:{tid}"] = t.state
         for wid, nw in rt.named_windows.items():

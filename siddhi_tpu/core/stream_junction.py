@@ -46,6 +46,90 @@ class StreamJunction:
     def add_stream_callback(self, fn: Callable) -> None:
         self.stream_callbacks.append(fn)
 
+    # ---- @async ingress (reference: StreamJunction.java:262-298 Disruptor
+    # ring + StreamHandler batching into EventExchangeHolders) --------------
+
+    def enable_async(
+        self, buffer_size: int = 1024, workers: int = 1, batch_max: int | None = None
+    ) -> None:
+        import queue
+
+        self._queue: "queue.Queue" = queue.Queue(maxsize=int(buffer_size))
+        # a packed batch can never exceed the junction's device batch shape
+        self._batch_max = min(
+            int(batch_max) if batch_max else self.batch_size, self.batch_size
+        )
+        self._async_stop = threading.Event()
+        self._workers = []
+        for _ in range(max(1, int(workers))):
+            t = threading.Thread(target=self._drain, daemon=True)
+            t.start()
+            self._workers.append(t)
+        self.is_async = True
+
+    def queued(self) -> int:
+        q = getattr(self, "_queue", None)
+        return q.qsize() if q is not None else 0
+
+    def _drain(self) -> None:
+        import queue as _q
+
+        while not self._async_stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except _q.Empty:
+                continue
+            ts_list, rows, now = [item[0]], [item[1]], item[2]
+            # opportunistically batch up to batch_max (reference:
+            # batch.size.max on the Disruptor consumer)
+            while len(rows) < self._batch_max:
+                try:
+                    nxt = self._queue.get_nowait()
+                except _q.Empty:
+                    break
+                ts_list.append(nxt[0])
+                rows.append(nxt[1])
+                now = nxt[2]
+            try:
+                batch = self.schema.to_batch(
+                    ts_list, rows, self.interner, capacity=self.batch_size
+                )
+                self.publish_batch(batch, now)
+            except Exception:  # a poisoned batch must not kill the worker
+                import logging
+                import traceback
+
+                logging.getLogger(__name__).error(
+                    "async worker for stream '%s' dropped a batch:\n%s",
+                    self.schema.stream_id,
+                    traceback.format_exc(),
+                )
+
+    def stop_async(self) -> None:
+        ev = getattr(self, "_async_stop", None)
+        if ev is None:
+            return
+        # drain what's left before stopping
+        import time as _time
+
+        t0 = _time.monotonic()
+        while self.queued() > 0 and _time.monotonic() - t0 < 5.0:
+            _time.sleep(0.01)
+        dropped = self.queued()
+        if dropped:
+            import logging
+
+            logging.getLogger(__name__).error(
+                "async shutdown for stream '%s' timed out with %d events "
+                "still queued — they were dropped",
+                self.schema.stream_id, dropped,
+            )
+        ev.set()
+        for t in self._workers:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
+        self._workers = []
+
     # ---- publishing ------------------------------------------------------
 
     def publish_batch(self, batch: EventBatch, now: int) -> None:
@@ -62,13 +146,21 @@ class StreamJunction:
                     for cb in self.stream_callbacks:
                         cb(rows)
 
+    is_async = False
+
     def send_rows(
         self,
         timestamps: Sequence[int],
         rows: Sequence[Sequence[Any]],
         now: int | None = None,
     ) -> None:
-        """Pack host rows and publish, chunking to the junction batch size."""
+        """Pack host rows and publish, chunking to the junction batch size.
+        In @async mode rows enqueue into the ingress ring (blocking when full
+        = back-pressure) and a worker thread batches + publishes."""
+        if self.is_async:
+            for ts, row in zip(timestamps, rows):
+                self._queue.put((ts, tuple(row), now if now is not None else ts))
+            return
         n = len(rows)
         for ofs in range(0, max(n, 1), self.batch_size):
             ts_chunk = list(timestamps[ofs : ofs + self.batch_size])

@@ -1,0 +1,173 @@
+"""Source/sink/mapper/broker tests.
+
+Reference: modules/siddhi-core/src/test/java/org/wso2/siddhi/core/transport/
+InMemoryTransportTestCase (broker topics), TestFailingInMemorySource/Sink
+(retry on ConnectionUnavailableException), MultiClientDistributedSinkTestCase
+(round-robin/partitioned/broadcast egress).
+"""
+
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import Event
+from siddhi_tpu.core.extension import extension
+from siddhi_tpu.core.io import (
+    ConnectionUnavailableError,
+    InMemoryBroker,
+    InMemorySink,
+)
+
+
+class _Collector:
+    def __init__(self, topic):
+        self.topic = topic
+        self.got = []
+
+    def on_message(self, payload):
+        self.got.append(payload)
+
+
+class TestInMemoryTransport:
+    def test_source_sink_roundtrip(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @source(type='inMemory', topic='in_t', @map(type='passThrough'))
+        define stream S (symbol string, price float);
+        @sink(type='inMemory', topic='out_t', @map(type='passThrough'))
+        define stream Out (symbol string, price float);
+        from S[price > 10] select symbol, price insert into Out;
+        """)
+        col = _Collector("out_t")
+        InMemoryBroker.subscribe(col)
+        rt.start()
+        InMemoryBroker.publish("in_t", ("WSO2", 55.5))
+        InMemoryBroker.publish("in_t", ("IBM", 5.0))
+        InMemoryBroker.publish("in_t", ("GOOG", 20.0))
+        events = [e for batch in col.got for e in batch]
+        assert [tuple(e.data) for e in events] == [("WSO2", 55.5), ("GOOG", 20.0)]
+        InMemoryBroker.unsubscribe(col)
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_json_mappers(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @source(type='inMemory', topic='jin', @map(type='json'))
+        define stream S (symbol string, price float);
+        @sink(type='inMemory', topic='jout', @map(type='json'))
+        define stream Out (symbol string, price float);
+        from S select symbol, price insert into Out;
+        """)
+        col = _Collector("jout")
+        InMemoryBroker.subscribe(col)
+        rt.start()
+        InMemoryBroker.publish("jin", '{"event": {"symbol": "WSO2", "price": 55.5}}')
+        import json
+
+        assert json.loads(col.got[0]) == [
+            {"event": {"symbol": "WSO2", "price": 55.5}}
+        ]
+        InMemoryBroker.unsubscribe(col)
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestFailingSink:
+    def test_sink_reconnects_with_backoff(self):
+        fails = {"n": 2}
+
+        @extension("sink", "testFailing")
+        class FailingSink(InMemorySink):
+            def connect(self):
+                super().connect()
+                if fails["n"] > 0:
+                    fails["n"] -= 1
+                    raise ConnectionUnavailableError("down")
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream S (symbol string);
+        @sink(type='testFailing', topic='ft', @map(type='passThrough'))
+        define stream Out (symbol string);
+        from S select symbol insert into Out;
+        """)
+        col = _Collector("ft")
+        InMemoryBroker.subscribe(col)
+        rt.start()
+        sink = rt.sinks[0]
+        t0 = time.time()
+        while not sink.connected and time.time() - t0 < 5.0:
+            time.sleep(0.05)
+        assert sink.connected and fails["n"] == 0  # retried through backoff
+        rt.get_input_handler("S").send(("WSO2",))
+        assert [tuple(e.data) for b in col.got for e in b] == [("WSO2",)]
+        InMemoryBroker.unsubscribe(col)
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestDistributedSink:
+    def _run(self, strategy_clause, sends):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(f"""
+        define stream S (symbol string, volume long);
+        @sink(type='inMemory', @map(type='passThrough'),
+              @distribution(strategy='{strategy_clause}',
+                            @destination(topic='d1'), @destination(topic='d2')))
+        define stream Out (symbol string, volume long);
+        from S select symbol, volume insert into Out;
+        """)
+        c1, c2 = _Collector("d1"), _Collector("d2")
+        InMemoryBroker.subscribe(c1)
+        InMemoryBroker.subscribe(c2)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row in sends:
+            h.send(row)
+        rt.shutdown()
+        mgr.shutdown()
+        InMemoryBroker.unsubscribe(c1)
+        InMemoryBroker.unsubscribe(c2)
+        flat1 = [tuple(e.data) for b in c1.got for e in b]
+        flat2 = [tuple(e.data) for b in c2.got for e in b]
+        return flat1, flat2
+
+    def test_round_robin(self):
+        f1, f2 = self._run("roundRobin", [("A", 1), ("B", 2), ("C", 3), ("D", 4)])
+        assert f1 == [("A", 1), ("C", 3)]
+        assert f2 == [("B", 2), ("D", 4)]
+
+    def test_broadcast(self):
+        f1, f2 = self._run("broadcast", [("A", 1), ("B", 2)])
+        assert f1 == f2 == [("A", 1), ("B", 2)]
+
+    def test_partitioned(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream S (symbol string, volume long);
+        @sink(type='inMemory', @map(type='passThrough'),
+              @distribution(strategy='partitioned', partitionKey='symbol',
+                            @destination(topic='p1'), @destination(topic='p2')))
+        define stream Out (symbol string, volume long);
+        from S select symbol, volume insert into Out;
+        """)
+        c1, c2 = _Collector("p1"), _Collector("p2")
+        InMemoryBroker.subscribe(c1)
+        InMemoryBroker.subscribe(c2)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for row in [("A", 1), ("B", 2), ("A", 3), ("B", 4)]:
+            h.send(row)
+        rt.shutdown()
+        mgr.shutdown()
+        InMemoryBroker.unsubscribe(c1)
+        InMemoryBroker.unsubscribe(c2)
+        flat1 = [tuple(e.data) for b in c1.got for e in b]
+        flat2 = [tuple(e.data) for b in c2.got for e in b]
+        # same key always lands on the same destination
+        keys1 = {s for s, _ in flat1}
+        keys2 = {s for s, _ in flat2}
+        assert keys1.isdisjoint(keys2)
+        assert sorted(flat1 + flat2) == [("A", 1), ("A", 3), ("B", 2), ("B", 4)]
