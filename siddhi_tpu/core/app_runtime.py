@@ -124,6 +124,15 @@ class SiddhiAppRuntime:
                 bt = self.statistics_manager.buffered_tracker(f"stream.{sid}")
                 bt.register(self._junction(sid).queued)
 
+        # `define function f[python] ...` scripts register into the global
+        # function registry (reference: script executors via @Extension SPI;
+        # the registry is manager-global, so same-name redefinitions win last)
+        from siddhi_tpu.core.extension import extension as _ext
+        from siddhi_tpu.core.stream_function import make_script_function
+
+        for fid, fdef in app.function_definitions.items():
+            _ext("function", fid)(make_script_function(fdef))
+
         from siddhi_tpu.core.table import DEFAULT_TABLE_CAPACITY, InMemoryTable
 
         table_capacity = self._capacity_annotation(
@@ -224,7 +233,9 @@ class SiddhiAppRuntime:
         for sid, d in app.stream_definitions.items():
             schema = self.stream_schemas[sid]
             for ann in find_all(d.annotations, "source"):
-                # via get_input_handler so playback apps advance event time
+                # transport payloads carry no timestamps: sourced events are
+                # stamped with the app clock (wall time, or the current
+                # virtual time in @app:playback apps)
                 self.sources.append(
                     build_source(ann, sid, schema, self.get_input_handler(sid))
                 )

@@ -83,10 +83,10 @@ class SiddhiDebugger:
         events = events_thunk()
         if not events:
             return
+        with self._lock:
+            self._current_bp = bp  # before the callback: it may call play()
         if self.callback is not None:
             self.callback(events, query_name, terminal, self)
-        with self._lock:
-            self._current_bp = bp
         self._blocked.set()
         self._gate.acquire()  # block the processing thread until next()/play()
         self._blocked.clear()

@@ -83,6 +83,33 @@ class BackoffRetryCounter:
         return iv
 
 
+def _connect_with_retry(transport) -> None:
+    """Shared source/sink reconnect loop: exponential backoff on a single
+    daemon chain — concurrent publish failures do NOT spawn parallel chains
+    (reference: Source.connectWithRetry:126 / Sink.java:128-160)."""
+    with transport._conn_lock:
+        if transport._stopped or transport._reconnecting:
+            return
+        transport._reconnecting = True
+    try:
+        transport.connect()
+        transport.connected = True
+        transport._retry.reset()
+        with transport._conn_lock:
+            transport._reconnecting = False
+    except ConnectionUnavailableError:
+        iv = transport._retry.next_interval_ms()
+
+        def retry():
+            time.sleep(iv / 1000.0)
+            with transport._conn_lock:
+                transport._reconnecting = False
+            if not transport._stopped:
+                _connect_with_retry(transport)
+
+        threading.Thread(target=retry, daemon=True).start()
+
+
 # ---------------------------------------------------------------------------
 # source mappers (wire payload -> event rows)
 # ---------------------------------------------------------------------------
@@ -207,7 +234,7 @@ class KeyValueSinkMapper(SinkMapper):
 class TextSinkMapper(SinkMapper):
     def map(self, events: list[Event]):
         return "\n\n".join(
-            "\n".join(f"{n}:{v!r}" for n, v in zip(self.schema.attr_names, e.data))
+            "\n".join(f"{n}:{v}" for n, v in zip(self.schema.attr_names, e.data))
             for e in events
         )
 
@@ -239,6 +266,8 @@ class Source:
         self._retry = BackoffRetryCounter()
         self.connected = False
         self._stopped = False
+        self._reconnecting = False
+        self._conn_lock = threading.Lock()
 
     def connect(self) -> None:
         raise NotImplementedError
@@ -260,21 +289,7 @@ class Source:
     def connect_with_retry(self) -> None:
         """reference: Source.connectWithRetry:126 — exponential backoff in a
         daemon thread until the transport comes up (or disconnect() cancels)."""
-        if self._stopped:
-            return
-        try:
-            self.connect()
-            self.connected = True
-            self._retry.reset()
-        except ConnectionUnavailableError:
-            iv = self._retry.next_interval_ms()
-
-            def retry():
-                time.sleep(iv / 1000.0)
-                if not self._stopped:
-                    self.connect_with_retry()
-
-            threading.Thread(target=retry, daemon=True).start()
+        _connect_with_retry(self)
 
     def deliver(self, payload) -> None:
         if self.paused:
@@ -316,6 +331,8 @@ class Sink:
         self.connected = False
         self._retry = BackoffRetryCounter()
         self._stopped = False
+        self._reconnecting = False
+        self._conn_lock = threading.Lock()
 
     def connect(self) -> None:
         pass
@@ -324,21 +341,7 @@ class Sink:
         pass
 
     def connect_with_retry(self) -> None:
-        if self._stopped:
-            return
-        try:
-            self.connect()
-            self.connected = True
-            self._retry.reset()
-        except ConnectionUnavailableError:
-            iv = self._retry.next_interval_ms()
-
-            def retry():
-                time.sleep(iv / 1000.0)
-                if not self._stopped:
-                    self.connect_with_retry()
-
-            threading.Thread(target=retry, daemon=True).start()
+        _connect_with_retry(self)
 
     def publish(self, payload) -> None:
         raise NotImplementedError

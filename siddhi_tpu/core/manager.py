@@ -45,6 +45,10 @@ class SiddhiManager:
     def set_persistence_store(self, store) -> None:
         self.persistence_store = store
 
+    def set_config_manager(self, config_manager) -> None:
+        """Deployment config SPI (reference: SiddhiManager.setConfigManager)."""
+        self.config_manager = config_manager
+
     def persist(self) -> None:
         for rt in self._runtimes.values():
             rt.persist()

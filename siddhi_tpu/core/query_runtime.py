@@ -41,8 +41,10 @@ from siddhi_tpu.query_api.execution import (
 
 
 class CompiledSingleChain:
-    """filter* [window] filter* stages over one input stream (M3: filters only;
-    window stages attach in M4)."""
+    """Ordered filter / stream-function / window stages over one input stream
+    (reference: SingleInputStreamParser.generateProcessor chain assembly).
+    Stream functions append attribute columns; the chain's effective output
+    schema is `out_attrs`."""
 
     def __init__(
         self,
@@ -51,16 +53,19 @@ class CompiledSingleChain:
         scope: Scope,
         window_factory: Optional[Callable] = None,
     ):
+        from siddhi_tpu.core.stream_function import make_stream_function
+
         self.schema = schema
         self.ref = stream.alias or stream.stream_id
-        self.filters = []
         self.window = None
+        self.stages: list[tuple[str, object]] = []
+        attrs = dict(schema.attr_types)
         for h in stream.handlers:
             if isinstance(h, Filter):
                 cond = compile_expression(h.expression, scope)
                 if cond.type is not AttrType.BOOL:
                     raise SiddhiAppCreationError("filter must be a boolean expression")
-                self.filters.append((cond, self.window is not None))
+                self.stages.append(("filter", cond))
             elif isinstance(h, WindowHandler):
                 if self.window is not None:
                     raise SiddhiAppCreationError("only one window per stream")
@@ -68,22 +73,36 @@ class CompiledSingleChain:
                     raise SiddhiAppCreationError(
                         "windows are not available at this site"
                     )
-                self.window = window_factory(h.window, schema, self.ref)
+                win_schema = StreamSchema(schema.stream_id, list(attrs.items()))
+                self.window = window_factory(h.window, win_schema, self.ref)
+                self.stages.append(("window", self.window))
             elif isinstance(h, StreamFunctionHandler):
-                raise SiddhiAppCreationError(
-                    f"stream function '{h.name}' not supported yet"
+                stage = make_stream_function(
+                    h, attrs, self.ref, scope, schema.stream_id
                 )
+                for name, t in stage.new_attrs:
+                    if name in attrs:
+                        raise SiddhiAppCreationError(
+                            f"stream function '#{h.name}' output '{name}' "
+                            "collides with an existing attribute"
+                        )
+                    attrs[name] = t
+                    # later filters/selectors resolve the appended attrs
+                    scope.add_stream(self.ref, attrs)
+                self.stages.append(("fn", stage))
+        self.out_attrs: list[tuple[str, AttrType]] = list(attrs.items())
 
     def init_state(self):
         return self.window.init_state() if self.window is not None else ()
 
     def apply(self, state, flow: Flow):
-        pre = [c for c, after in self.filters if not after]
-        post = [c for c, after in self.filters if after]
-        flow = self._filter(flow, pre)
-        if self.window is not None:
-            state, flow = self.window.apply(state, flow)
-        flow = self._filter(flow, post)
+        for kind, stage in self.stages:
+            if kind == "filter":
+                flow = self._filter(flow, [stage])
+            elif kind == "fn":
+                flow = stage.apply(flow)
+            else:  # window
+                state, flow = stage.apply(state, flow)
         return state, flow
 
     @staticmethod
@@ -368,7 +387,7 @@ class QueryRuntime(BaseQueryRuntime):
         self.selector = CompiledSelector(
             query.selector,
             scope,
-            in_schema.attrs,
+            self.chain.out_attrs,  # includes stream-function appended attrs
             batch_mode=self.chain.window is not None and self.chain.window.is_batch,
             group_capacity=group_capacity,
         )

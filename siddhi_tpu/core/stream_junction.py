@@ -54,20 +54,88 @@ class StreamJunction:
     ) -> None:
         import queue
 
-        self._queue: "queue.Queue" = queue.Queue(maxsize=int(buffer_size))
         # a packed batch can never exceed the junction's device batch shape
         self._batch_max = min(
             int(batch_max) if batch_max else self.batch_size, self.batch_size
         )
         self._async_stop = threading.Event()
         self._workers = []
+        self._ring = None
+        from siddhi_tpu.core.types import AttrType
+
+        if all(t is not AttrType.OBJECT for _, t in self.schema.attrs):
+            # native lock-free ring (C++, the Disruptor analog); values ride
+            # as doubles — exact for f32/f64/bool/interned-string ids and for
+            # integers up to 2^53
+            try:
+                from siddhi_tpu.native import NativeIngressRing
+
+                self._ring = NativeIngressRing(
+                    int(buffer_size), len(self.schema.attrs)
+                )
+            except Exception:
+                self._ring = None  # no toolchain: python queue fallback
+        if self._ring is None:
+            self._queue = queue.Queue(maxsize=int(buffer_size))
+        if self._ring is not None:
+            workers = 1  # the native ring is single-consumer (MPSC)
         for _ in range(max(1, int(workers))):
-            t = threading.Thread(target=self._drain, daemon=True)
+            t = threading.Thread(
+                target=self._drain_ring if self._ring is not None else self._drain,
+                daemon=True,
+            )
             t.start()
             self._workers.append(t)
         self.is_async = True
 
+    def _encode_row(self, row) -> list[float]:
+        from siddhi_tpu.core.types import AttrType, null_value
+
+        out = []
+        for v, (_n, t) in zip(row, self.schema.attrs):
+            if t in (AttrType.STRING, AttrType.OBJECT):
+                out.append(float(self.interner.intern(v)))
+            elif v is None:
+                nv = null_value(t)
+                out.append(float(nv) if nv is not None else float("nan"))
+            else:
+                out.append(float(v))
+        return out
+
+    def _drain_ring(self) -> None:
+        import numpy as np
+
+        from siddhi_tpu.core.types import PHYSICAL_DTYPE
+
+        dtypes = [np.dtype(PHYSICAL_DTYPE[t]) for _n, t in self.schema.attrs]
+        names = self.schema.attr_names
+        while not self._async_stop.is_set():
+            try:
+                ts, rows = self._ring.pop_batch(self._batch_max)
+                if ts.shape[0] == 0:
+                    self._async_stop.wait(0.001)
+                    continue
+                cols = {
+                    n: rows[:, j].astype(dt)
+                    for j, (n, dt) in enumerate(zip(names, dtypes))
+                }
+                batch = self.schema.to_batch_cols(
+                    ts, cols, self.interner, capacity=self.batch_size
+                )
+                self.publish_batch(batch, int(ts[-1]))
+            except Exception:
+                import logging
+                import traceback
+
+                logging.getLogger(__name__).error(
+                    "async ring worker for stream '%s' dropped a batch:\n%s",
+                    self.schema.stream_id, traceback.format_exc(),
+                )
+
     def queued(self) -> int:
+        ring = getattr(self, "_ring", None)
+        if ring is not None:
+            return ring.size()
         q = getattr(self, "_queue", None)
         return q.qsize() if q is not None else 0
 
@@ -124,11 +192,18 @@ class StreamJunction:
                 "still queued — they were dropped",
                 self.schema.stream_id, dropped,
             )
+        # leave the async path BEFORE tearing the ring down so late sends fall
+        # through to the synchronous publish path instead of crashing
+        self.is_async = False
         ev.set()
         for t in self._workers:
             if t is not threading.current_thread():
                 t.join(timeout=2.0)
         self._workers = []
+        ring = getattr(self, "_ring", None)
+        if ring is not None:
+            ring.close()
+            self._ring = None
 
     # ---- publishing ------------------------------------------------------
 
@@ -158,8 +233,20 @@ class StreamJunction:
         In @async mode rows enqueue into the ingress ring (blocking when full
         = back-pressure) and a worker thread batches + publishes."""
         if self.is_async:
-            for ts, row in zip(timestamps, rows):
-                self._queue.put((ts, tuple(row), now if now is not None else ts))
+            ring = getattr(self, "_ring", None)
+            if ring is not None:
+                import time as _time
+
+                stop = self._async_stop
+                for ts, row in zip(timestamps, rows):
+                    enc = self._encode_row(row)
+                    while not ring.push(ts, enc):
+                        if stop.is_set():
+                            return  # shutting down: drop instead of hanging
+                        _time.sleep(0.0005)  # back-pressure without a hot spin
+            else:
+                for ts, row in zip(timestamps, rows):
+                    self._queue.put((ts, tuple(row), now if now is not None else ts))
             return
         n = len(rows)
         for ofs in range(0, max(n, 1), self.batch_size):

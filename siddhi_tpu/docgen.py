@@ -1,0 +1,95 @@
+"""Extension documentation generator.
+
+Reference: modules/siddhi-doc-gen — Maven mojos scanning @Extension metadata
+into FreeMarker markdown templates (core/MarkdownDocumentationGenerationMojo).
+Here: walks the built-in registries + extension registry and emits one
+markdown document per extension kind.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+from siddhi_tpu.core.extension import _REGISTRY
+
+_BUILTIN_SECTIONS = {
+    "Windows": [
+        ("length(N)", "Sliding window of the last N events."),
+        ("lengthBatch(N)", "Tumbling window flushing every N events."),
+        ("time(T)", "Sliding window over the last T of event time."),
+        ("timeBatch(T [, start])", "Tumbling window flushing every T."),
+        ("timeLength(T, N)", "Sliding window bounded by both T and N."),
+        ("externalTime(tsAttr, T)", "Sliding time window over an attribute clock."),
+        ("externalTimeBatch(tsAttr, T [, start])", "Tumbling window over an attribute clock."),
+        ("sort(N, attr [asc|desc], ...)", "Keeps the N least events per the comparator."),
+        ("frequent(N [, attrs...])", "Misra-Gries top-N key retention."),
+        ("lossyFrequent(support, error [, attrs...])", "Lossy-counting frequent keys."),
+        ("cron('expr')", "Tumbling window flushed on a cron schedule."),
+    ],
+    "Aggregators": [
+        ("sum/avg/count/min/max(x)", "Streaming aggregates with expired-event removal."),
+        ("stdDev(x)", "Streaming standard deviation."),
+        ("distinctCount(x)", "Distinct values inside the window."),
+        ("minForever/maxForever(x)", "All-time extremes (never removed)."),
+    ],
+    "Functions": [
+        ("cast/convert(v, 'type')", "Type conversion."),
+        ("coalesce(a, b, ...)", "First non-null argument."),
+        ("ifThenElse(cond, a, b)", "Conditional projection."),
+        ("instanceOf<Type>(v)", "Runtime type check."),
+        ("maximum/minimum(a, b, ...)", "Elementwise extremes."),
+        ("eventTimestamp()", "The event's timestamp."),
+        ("currentTimeMillis()", "The engine clock."),
+        ("default(v, d)", "Null replacement."),
+        ("UUID()", "Random identifier (host side)."),
+    ],
+    "Stream functions": [
+        ("#log([message])", "Pass-through event tracing."),
+        ("#pol2Cart(theta, rho [, z])", "Appends cartesian x/y[/z]."),
+    ],
+    "Sources": [("inMemory(topic)", "In-memory broker ingestion.")],
+    "Sinks": [
+        ("inMemory(topic)", "In-memory broker egress."),
+        ("log()", "Logging egress."),
+    ],
+    "Mappers": [
+        ("passThrough", "Raw tuples/Events."),
+        ("json", "JSON objects keyed by attribute (siddhi-map-json envelope)."),
+        ("keyvalue", "Dicts keyed by attribute."),
+        ("text", "attr:value line format."),
+    ],
+}
+
+
+def generate_markdown() -> str:
+    lines = ["# siddhi_tpu extensions", ""]
+    for section, entries in _BUILTIN_SECTIONS.items():
+        lines.append(f"## {section}")
+        lines.append("")
+        lines.append("| syntax | description |")
+        lines.append("|---|---|")
+        for syntax, desc in entries:
+            lines.append(f"| `{syntax}` | {desc} |")
+        lines.append("")
+    # user-registered extensions
+    for kind, reg in _REGISTRY.items():
+        if not reg:
+            continue
+        lines.append(f"## Registered `{kind}` extensions")
+        lines.append("")
+        lines.append("| name | doc |")
+        lines.append("|---|---|")
+        for name, obj in sorted(reg.items()):
+            doc = (inspect.getdoc(obj) or "").splitlines()
+            lines.append(f"| `{name}` | {doc[0] if doc else ''} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def write_docs(out_dir: str) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "extensions.md")
+    with open(path, "w") as f:
+        f.write(generate_markdown())
+    return path

@@ -1,0 +1,139 @@
+// Lock-free bounded MPSC ingress ring (Vyukov bounded-queue scheme).
+//
+// Reference analog: the LMAX Disruptor ring buffer behind @async streams
+// (modules/siddhi-core/.../core/stream/StreamJunction.java:262-298, the
+// engine's performance-critical substrate per SURVEY.md). Here the ring is
+// the native host-side stage in front of device micro-batching: producers
+// (any thread, no GIL needed) publish fixed-width numeric rows; one consumer
+// drains up to batch_max rows at a time straight into columnar buffers for
+// EventBatch packing.
+//
+// Each slot: [seq][ts][v0..v_{k-1}] — values are doubles (numeric attrs and
+// pre-interned string ids; integers are exact to 2^53).
+//
+// Build: g++ -O2 -shared -fPIC -o libsiddhi_ring.so ring.cpp  (see build.py)
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+namespace {
+
+struct Slot {
+    std::atomic<size_t> seq;
+    long long ts;
+    // payload doubles follow the struct in the arena
+};
+
+struct Ring {
+    size_t capacity;      // power of two
+    size_t mask;
+    size_t slot_doubles;  // payload width
+    size_t slot_stride;   // bytes per slot incl. payload
+    char* arena;
+    std::atomic<size_t> tail;  // producers claim here
+    std::atomic<size_t> head;  // single consumer
+    std::atomic<long long> dropped;
+};
+
+inline Slot* slot_at(Ring* r, size_t i) {
+    return reinterpret_cast<Slot*>(r->arena + (i & r->mask) * r->slot_stride);
+}
+
+inline double* payload(Slot* s) {
+    return reinterpret_cast<double*>(reinterpret_cast<char*>(s) + sizeof(Slot));
+}
+
+size_t next_pow2(size_t v) {
+    size_t p = 1;
+    while (p < v) p <<= 1;
+    return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+Ring* ring_create(size_t capacity, size_t slot_doubles) {
+    Ring* r = new (std::nothrow) Ring();
+    if (!r) return nullptr;
+    r->capacity = next_pow2(capacity < 2 ? 2 : capacity);
+    r->mask = r->capacity - 1;
+    r->slot_doubles = slot_doubles;
+    r->slot_stride = sizeof(Slot) + slot_doubles * sizeof(double);
+    // align stride to 64 bytes to keep slots off shared cache lines
+    r->slot_stride = (r->slot_stride + 63) & ~size_t(63);
+    r->arena = static_cast<char*>(std::calloc(r->capacity, r->slot_stride));
+    if (!r->arena) {
+        delete r;
+        return nullptr;
+    }
+    for (size_t i = 0; i < r->capacity; i++) {
+        slot_at(r, i)->seq.store(i, std::memory_order_relaxed);
+    }
+    r->tail.store(0, std::memory_order_relaxed);
+    r->head.store(0, std::memory_order_relaxed);
+    r->dropped.store(0, std::memory_order_relaxed);
+    return r;
+}
+
+void ring_destroy(Ring* r) {
+    if (!r) return;
+    std::free(r->arena);
+    delete r;
+}
+
+// Returns 1 on success, 0 when the ring is full (caller may retry = back-pressure).
+int ring_push(Ring* r, long long ts, const double* row) {
+    size_t pos = r->tail.load(std::memory_order_relaxed);
+    for (;;) {
+        Slot* s = slot_at(r, pos);
+        size_t seq = s->seq.load(std::memory_order_acquire);
+        intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+        if (dif == 0) {
+            if (r->tail.compare_exchange_weak(pos, pos + 1,
+                                              std::memory_order_relaxed)) {
+                s->ts = ts;
+                std::memcpy(payload(s), row, r->slot_doubles * sizeof(double));
+                s->seq.store(pos + 1, std::memory_order_release);
+                return 1;
+            }
+        } else if (dif < 0) {
+            return 0;  // full
+        } else {
+            pos = r->tail.load(std::memory_order_relaxed);
+        }
+    }
+}
+
+// Single consumer: drain up to `max` rows; returns the count.
+// out_ts: [max] int64; out_rows: [max * slot_doubles] doubles, row-major.
+size_t ring_pop_batch(Ring* r, long long* out_ts, double* out_rows, size_t max) {
+    size_t n = 0;
+    size_t pos = r->head.load(std::memory_order_relaxed);
+    while (n < max) {
+        Slot* s = slot_at(r, pos);
+        size_t seq = s->seq.load(std::memory_order_acquire);
+        if ((intptr_t)seq - (intptr_t)(pos + 1) < 0) break;  // empty
+        out_ts[n] = s->ts;
+        std::memcpy(out_rows + n * r->slot_doubles, payload(s),
+                    r->slot_doubles * sizeof(double));
+        s->seq.store(pos + r->capacity, std::memory_order_release);
+        pos++;
+        n++;
+    }
+    r->head.store(pos, std::memory_order_relaxed);
+    return n;
+}
+
+size_t ring_size(Ring* r) {
+    return r->tail.load(std::memory_order_relaxed) -
+           r->head.load(std::memory_order_relaxed);
+}
+
+size_t ring_capacity(Ring* r) { return r->capacity; }
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+"""REST deploy microservice.
+
+Reference: modules/siddhi-service — MSF4J endpoints
+`POST /siddhi/artifact/deploy` (body = SiddhiQL text) and
+`GET /siddhi/artifact/undeploy/{appName}`
+(src/gen/.../api/SiddhiApi.java:31-63, impl/SiddhiApiServiceImpl.java:54-110),
+holding one SiddhiManager. Here: a stdlib ThreadingHTTPServer wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class SiddhiService:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, manager=None):
+        from siddhi_tpu import SiddhiManager
+
+        self.manager = manager or SiddhiManager()
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/siddhi/artifact/deploy":
+                    self._reply(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                source = self.rfile.read(n).decode()
+                try:
+                    rt = service.manager.create_siddhi_app_runtime(source)
+                    rt.start()
+                    self._reply(
+                        200,
+                        {"status": "deployed", "appName": rt.name},
+                    )
+                except Exception as e:
+                    self._reply(400, {"error": str(e)})
+
+            def do_GET(self):
+                prefix = "/siddhi/artifact/undeploy/"
+                if not self.path.startswith(prefix):
+                    self._reply(404, {"error": "not found"})
+                    return
+                app_name = self.path[len(prefix):].strip("/")
+                rt = service.manager.get_siddhi_app_runtime(app_name)
+                if rt is None:
+                    self._reply(404, {"error": f"no app '{app_name}'"})
+                    return
+                rt.shutdown()
+                del service.manager._runtimes[app_name]
+                self._reply(200, {"status": "undeployed", "appName": app_name})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        self.manager.shutdown()

@@ -1,0 +1,50 @@
+"""Deployment config SPI.
+
+Reference: util/config/ConfigManager.java + ConfigReader SPI resolving
+per-extension system configs, with the in-memory impl
+InMemoryConfigManager.java:27-60. Extensions receive a ConfigReader scoped to
+their `namespace.name` prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ConfigReader:
+    def __init__(self, configs: dict[str, str], prefix: str):
+        self._configs = configs
+        self._prefix = prefix
+
+    def read_config(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        return self._configs.get(f"{self._prefix}.{name}", default)
+
+    def get_all_configs(self) -> dict[str, str]:
+        p = self._prefix + "."
+        return {
+            k[len(p):]: v for k, v in self._configs.items() if k.startswith(p)
+        }
+
+
+class ConfigManager:
+    def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
+        raise NotImplementedError
+
+    def extract_system_configs(self, name: str) -> dict:
+        raise NotImplementedError
+
+
+class InMemoryConfigManager(ConfigManager):
+    def __init__(
+        self,
+        configs: Optional[dict[str, str]] = None,
+        system_configs: Optional[dict[str, dict]] = None,
+    ):
+        self._configs = dict(configs or {})
+        self._system = dict(system_configs or {})
+
+    def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
+        return ConfigReader(self._configs, f"{namespace}.{name}")
+
+    def extract_system_configs(self, name: str) -> dict:
+        return self._system.get(name, {})

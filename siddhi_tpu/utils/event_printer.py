@@ -1,0 +1,23 @@
+"""Console event printer for samples/tests.
+
+Reference: core/util/EventPrinter.java — prints callback payloads.
+"""
+
+from __future__ import annotations
+
+
+def print_event(timestamp, in_events, removed_events) -> None:
+    """QueryCallback-shaped printer."""
+    print(
+        f"Events{{ @timestamp = {timestamp}, inEvents = "
+        f"{[tuple(e.data) for e in in_events] if in_events else None}, "
+        f"RemoveEvents = "
+        f"{[tuple(e.data) for e in removed_events] if removed_events else None} }}",
+        flush=True,
+    )
+
+
+def print_stream(events) -> None:
+    """StreamCallback-shaped printer."""
+    for e in events:
+        print(f"Event{{ timestamp={e.timestamp}, data={tuple(e.data)} }}", flush=True)

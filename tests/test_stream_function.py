@@ -1,0 +1,136 @@
+"""Stream function / script function / UDF tests.
+
+Reference: modules/siddhi-core/src/test/java/org/wso2/siddhi/core/query/
+streamfunction/Pol2CartFunctionTestCase, function/ScriptTestCase,
+extension/ExtensionTestCase.
+"""
+
+import math
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.extension import extension
+
+
+def run_app(ql, sends, callback_name="q"):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    ins = []
+    rt.add_callback(callback_name, lambda ts, i, r: ins.extend(e.data for e in i or []))
+    rt.start()
+    h = {}
+    for sid, row, ts in sends:
+        h.setdefault(sid, rt.get_input_handler(sid)).send(row, timestamp=ts)
+    rt.shutdown()
+    mgr.shutdown()
+    return ins
+
+
+class TestPol2Cart:
+    def test_appends_xy(self):
+        ql = """
+        define stream P (theta double, rho double);
+        @info(name='q')
+        from P#pol2Cart(theta, rho)
+        select x, y
+        insert into Out;
+        """
+        ins = run_app(ql, [("P", (0.0, 2.0), 1), ("P", (90.0, 3.0), 2)])
+        assert ins[0][0] == pytest.approx(2.0)
+        assert ins[0][1] == pytest.approx(0.0, abs=1e-6)
+        assert ins[1][0] == pytest.approx(0.0, abs=1e-6)
+        assert ins[1][1] == pytest.approx(3.0)
+
+    def test_appended_attr_usable_in_filter_and_window(self):
+        ql = """
+        define stream P (theta double, rho double);
+        @info(name='q')
+        from P#pol2Cart(theta, rho)[x > 1.0]#window.length(2)
+        select sum(x) as sx
+        insert into Out;
+        """
+        ins = run_app(ql, [
+            ("P", (0.0, 2.0), 1),    # x=2 passes
+            ("P", (90.0, 3.0), 2),   # x~0 filtered
+            ("P", (0.0, 5.0), 3),    # x=5 passes
+        ])
+        assert [round(v[0], 4) for v in ins] == [2.0, 7.0]
+
+
+class TestLogStreamProcessor:
+    def test_log_passthrough(self, caplog):
+        import logging
+
+        ql = """
+        define stream S (symbol string);
+        @info(name='q')
+        from S#log('saw event')
+        select symbol insert into Out;
+        """
+        with caplog.at_level(logging.INFO, logger="siddhi_tpu.log.S"):
+            ins = run_app(ql, [("S", ("WSO2",), 1)])
+        assert ins == [("WSO2",)]
+
+
+class TestScriptFunction:
+    def test_python_function(self):
+        ql = """
+        define function half[python] return double {
+            return data[0] / 2.0
+        };
+        define stream S (v double);
+        @info(name='q')
+        from S select half(v) as h insert into Out;
+        """
+        ins = run_app(ql, [("S", (10.0,), 1), ("S", (3.0,), 2)])
+        assert ins == [(5.0,), (1.5,)]
+
+    def test_python_expression_body(self):
+        ql = """
+        define function addUp[python] return long { data[0] + data[1] };
+        define stream S (a long, b long);
+        @info(name='q')
+        from S select addUp(a, b) as s insert into Out;
+        """
+        ins = run_app(ql, [("S", (3, 4), 1)])
+        assert ins == [(7,)]
+
+
+class TestCustomExtensions:
+    def test_custom_scalar_function(self):
+        from siddhi_tpu.core.executor import CompiledExpr
+        from siddhi_tpu.core.types import AttrType
+        import jax.numpy as jnp
+
+        @extension("function", "doubled", namespace="custom")
+        def _doubled(params, scope):
+            (arg,) = params
+            return CompiledExpr(arg.type, lambda env: arg(env) * 2)
+
+        ql = """
+        define stream S (v long);
+        @info(name='q')
+        from S select custom:doubled(v) as d insert into Out;
+        """
+        ins = run_app(ql, [("S", (21,), 1)])
+        assert ins == [(42,)]
+
+    def test_custom_stream_function(self):
+        from siddhi_tpu.core.stream_function import StreamFunctionStage
+        from siddhi_tpu.core.types import AttrType
+
+        @extension("stream_function", "custom:tag")
+        def _tag(params, schema_attrs, ref, scope):
+            return StreamFunctionStage(
+                ref, [("tagged", AttrType.LONG)],
+                lambda env, _p=params: {"tagged": _p[0](env) + 1000},
+            )
+
+        ql = """
+        define stream S (v long);
+        @info(name='q')
+        from S#custom:tag(v) select v, tagged insert into Out;
+        """
+        ins = run_app(ql, [("S", (1,), 1)])
+        assert ins == [(1, 1001)]
