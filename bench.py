@@ -89,6 +89,8 @@ WORKLOADS = {
         insert into Out;
         """,
         "StockStream",
+        1.0,   # events multiplier
+        None,  # batch override
     ),
     # BASELINE.json config 2: tumbling window group-by aggregation
     "tumbling_groupby": (
@@ -101,6 +103,52 @@ WORKLOADS = {
         insert into Out;
         """,
         "StockStream",
+        1.0,
+        None,
+    ),
+    # BASELINE.json config 3: two-sided sliding-window join (self-join form)
+    "sliding_join": (
+        """
+        @app:joinCapacity(size='8192')
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q')
+        from StockStream#window.length(100) as a join StockStream#window.length(100) as b
+        on a.volume == b.volume
+        select a.symbol as s1, b.symbol as s2
+        insert into Out;
+        """,
+        "StockStream",
+        0.25,
+        8192,
+    ),
+    # BASELINE.json config 4: pattern `every A -> B within` (2-state NFA)
+    "pattern_2state": (
+        """
+        @app:patternCapacity(size='128')
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q')
+        from every a1=StockStream[price > 95] -> a2=StockStream[price < 5]
+        within 1 sec
+        select a1.symbol as s1, a2.symbol as s2
+        insert into Out;
+        """,
+        "StockStream",
+        0.02,
+        1024,
+    ),
+    # BASELINE.json config 5: DEBS-style count sequence with a kleene bound
+    "count_sequence": (
+        """
+        @app:patternCapacity(size='128')
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q')
+        from every a1=StockStream[price > 90]<2:4> -> a2=StockStream[price < 10]
+        select a2.symbol as s2
+        insert into Out;
+        """,
+        "StockStream",
+        0.02,
+        1024,
     ),
 }
 
@@ -108,16 +156,23 @@ WORKLOADS = {
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=1_000_000)
-    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=32768)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
     n = args.events
-    data = _make_stock_data(max(n, args.batch * 8))
+    # size the data for the largest per-workload run (events + warmup)
+    needed = n
+    for _ql, _s, mult, batch_override in WORKLOADS.values():
+        batch = batch_override or args.batch
+        needed = max(needed, max(int(n * mult), batch * 4) + batch * 3)
+    data = _make_stock_data(needed)
     per = {}
-    for name, (ql, stream) in WORKLOADS.items():
-        ql = f"@app:batch(size='{args.batch}')\n" + ql
-        per[name] = _run_workload(ql, stream, data, n, args.batch)
+    for name, (ql, stream, mult, batch_override) in WORKLOADS.items():
+        batch = batch_override or args.batch
+        events = max(int(n * mult), batch * 4)
+        ql = f"@app:batch(size='{batch}')\n" + ql
+        per[name] = _run_workload(ql, stream, data, events, batch)
         if args.verbose:
             print(f"# {name}: {per[name]:,.0f} events/s")
 

@@ -312,6 +312,12 @@ class SiddhiAppRuntime:
         rename = _make_rename(inferred, existing)
 
         def publish(out_batch: EventBatch, now: int, _t=target_junction) -> None:
+            if (
+                not _t.subscribers
+                and not _t.stream_callbacks
+                and _t.on_publish_stats is None
+            ):
+                return  # nobody downstream: skip the transform dispatch
             _t.publish_batch(rename(transform(out_batch)), now)
 
         qr.publish_fn = publish
@@ -671,6 +677,8 @@ class SiddhiAppRuntime:
             self.statistics_manager.stop_reporting()
         if self._playback_clock is not None:
             self._playback_clock.stop()
+        for qr in self.queries.values():
+            qr.flush_aux_warnings()
         self._scheduler.shutdown()
 
     # ---- snapshot / persistence (reference: SiddhiAppRuntime.persist/

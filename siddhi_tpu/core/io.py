@@ -91,14 +91,14 @@ def _connect_with_retry(transport) -> None:
         if transport._stopped or transport._reconnecting:
             return
         transport._reconnecting = True
+    retry_scheduled = False
     try:
         transport.connect()
         transport.connected = True
         transport._retry.reset()
-        with transport._conn_lock:
-            transport._reconnecting = False
     except ConnectionUnavailableError:
         iv = transport._retry.next_interval_ms()
+        retry_scheduled = True
 
         def retry():
             time.sleep(iv / 1000.0)
@@ -108,6 +108,11 @@ def _connect_with_retry(transport) -> None:
                 _connect_with_retry(transport)
 
         threading.Thread(target=retry, daemon=True).start()
+    finally:
+        if not retry_scheduled:
+            # any other connect() failure must not wedge future reconnects
+            with transport._conn_lock:
+                transport._reconnecting = False
 
 
 # ---------------------------------------------------------------------------

@@ -450,8 +450,14 @@ class JoinQueryRuntime(BaseQueryRuntime):
         self.side_schemas = {"l": left_schema, "r": right_schema}
         self.timer_targets: dict[str, object] = {}
         self._steps = {
-            "l": jax.jit(lambda st, ts, b, now: self._step_impl(st, ts, b, now, "l")),
-            "r": jax.jit(lambda st, ts, b, now: self._step_impl(st, ts, b, now, "r")),
+            "l": jax.jit(
+                lambda st, ts, b, now: self._step_impl(st, ts, b, now, "l"),
+                donate_argnums=(0,),
+            ),
+            "r": jax.jit(
+                lambda st, ts, b, now: self._step_impl(st, ts, b, now, "r"),
+                donate_argnums=(0,),
+            ),
         }
 
     def init_state(self):
@@ -468,7 +474,7 @@ class JoinQueryRuntime(BaseQueryRuntime):
     def receive(self, batch: EventBatch, now: int, side: str):
         with self._receive_lock:
             if self.state is None:
-                self.state = self.init_state()
+                self.state = self._fresh(self.init_state())
             tstates = self._collect_table_states()
             self.state, tstates, out, aux = self._steps[side](
                 self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)

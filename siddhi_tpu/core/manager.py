@@ -36,6 +36,15 @@ class SiddhiManager:
     def get_siddhi_app_runtime(self, name: str):
         return self._runtimes.get(name)
 
+    def shutdown_siddhi_app_runtime(self, name: str) -> bool:
+        """Shut down and deregister one app; False when it does not exist
+        (idempotent under concurrent callers)."""
+        rt = self._runtimes.pop(name, None)
+        if rt is None:
+            return False
+        rt.shutdown()
+        return True
+
     def validate_siddhi_app(self, app: Union[str, SiddhiApp]) -> None:
         """Parse + compile, then dispose (reference: SiddhiManager.validateSiddhiApp)."""
         runtime = self.create_siddhi_app_runtime(app)

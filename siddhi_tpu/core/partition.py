@@ -74,8 +74,8 @@ class PartitionedQueryRuntime(QueryRuntime):
         self.p = int(p_capacity)
         self.key_of = key_of
         self.inner_publish = None  # set when inserting into an #inner stream
-        self._pstep_outer = jax.jit(self._pstep_outer_impl)
-        self._pstep_inner = jax.jit(self._pstep_inner_impl)
+        self._pstep_outer = jax.jit(self._pstep_outer_impl, donate_argnums=(1,))
+        self._pstep_inner = jax.jit(self._pstep_inner_impl, donate_argnums=(0,))
 
     def init_state(self):
         one = super().init_state()
@@ -134,7 +134,7 @@ class PartitionedQueryRuntime(QueryRuntime):
         """Outer-stream arrival. Returns (ptable', flat_out, p_out, aux)."""
         with self._receive_lock:
             if self.state is None:
-                self.state = self.init_state()
+                self.state = self._fresh(self.init_state())
             ptable, self.state, outs, aux = self._pstep_outer(
                 ptable, self.state, batch, jnp.asarray(now, jnp.int64)
             )
@@ -144,7 +144,7 @@ class PartitionedQueryRuntime(QueryRuntime):
     def receive_inner(self, pbatch, now: int):
         with self._receive_lock:
             if self.state is None:
-                self.state = self.init_state()
+                self.state = self._fresh(self.init_state())
             self.state, outs, aux = self._pstep_inner(
                 self.state, pbatch, jnp.asarray(now, jnp.int64)
             )

@@ -88,9 +88,10 @@ class PatternQueryRuntime(BaseQueryRuntime):
         self.needs_scheduler = self.prog.needs_scheduler
         self.timer_target = None
         self._steps = {
-            sid: jax.jit(self._make_step(sid)) for sid in self.prog.stream_ids
+            sid: jax.jit(self._make_step(sid), donate_argnums=(0,))
+            for sid in self.prog.stream_ids
         }
-        self._timer_step = jax.jit(self._make_step(None))
+        self._timer_step = jax.jit(self._make_step(None), donate_argnums=(0,))
 
     # ---- device program --------------------------------------------------
 
@@ -171,7 +172,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
     def receive(self, batch: EventBatch, now: int, stream_id: str):
         with self._receive_lock:
             if self.state is None:
-                self.state = self.init_state(now)
+                self.state = self._fresh(self.init_state(now))
             step = self._steps[stream_id]
             tstates = self._collect_table_states()
             self.state, tstates, out, aux = step(
@@ -184,7 +185,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
     def receive_timer(self, schema_batch: EventBatch, t_ms: int):
         with self._receive_lock:
             if self.state is None:
-                self.state = self.init_state(t_ms)
+                self.state = self._fresh(self.init_state(t_ms))
             tstates = self._collect_table_states()
             self.state, tstates, out, aux = self._timer_step(
                 self.state, tstates, schema_batch, jnp.asarray(t_ms, dtype=jnp.int64)
@@ -199,6 +200,6 @@ class PatternQueryRuntime(BaseQueryRuntime):
         AbsentStreamPreStateProcessor.start scheduling)."""
         with self._receive_lock:
             if self.state is None:
-                self.state = self.init_state(now)
+                self.state = self._fresh(self.init_state(now))
             t = self.prog.next_timer(self.state["tok"])
         return {"next_timer": t}

@@ -195,7 +195,39 @@ class BaseQueryRuntime:
     def init_state(self):
         raise NotImplementedError
 
+    @staticmethod
+    def _fresh(state):
+        """Deep-copy an initial state pytree: jnp constant caching can alias
+        identical zero leaves, which breaks buffer donation (the same buffer
+        must not be donated twice in one call)."""
+        import jax.numpy as _jnp
+
+        return jax.tree_util.tree_map(lambda x: _jnp.array(x, copy=True), state)
+
     def _warn_aux(self, aux: dict) -> None:
+        """Surface overflow flags WITHOUT stalling the dispatch pipeline:
+        reading a device scalar blocks until its step finishes, so checks are
+        deferred until the values have materialized (`Array.is_ready`), with a
+        bounded backlog. `flush_aux_warnings` forces the remainder."""
+        pending = self.__dict__.setdefault("_pending_aux", [])
+        pending.append(aux)
+        force = len(pending) > 64
+        keep = []
+        for a in pending:
+            ready = all(
+                v.is_ready() for v in a.values() if hasattr(v, "is_ready")
+            )
+            if force or ready:
+                self._check_aux_flags(a)
+            else:
+                keep.append(a)
+        self._pending_aux = keep
+
+    def flush_aux_warnings(self) -> None:
+        for a in self.__dict__.pop("_pending_aux", []):
+            self._check_aux_flags(a)
+
+    def _check_aux_flags(self, aux: dict) -> None:
         if (
             not self._warned_overflow
             and "groupby_overflow" in aux
@@ -400,7 +432,9 @@ class QueryRuntime(BaseQueryRuntime):
         # cron-driven windows compute their next fire host-side
         cron = getattr(self.chain.window, "cron_schedule", None)
         self.host_next_timer = cron.next_fire_ms if cron is not None else None
-        self._step = jax.jit(self._step_impl)
+        # the state pytree is exclusively this query's: donate it so XLA
+        # reuses the buffers in place instead of allocating fresh ones
+        self._step = jax.jit(self._step_impl, donate_argnums=(0,))
 
     # ---- device program --------------------------------------------------
 
@@ -420,7 +454,7 @@ class QueryRuntime(BaseQueryRuntime):
     def receive(self, batch: EventBatch, now: int) -> tuple[EventBatch, dict]:
         with self._receive_lock:
             if self.state is None:
-                self.state = self.init_state()
+                self.state = self._fresh(self.init_state())
             tstates = self._collect_table_states()
             self.state, tstates, out, aux = self._step(
                 self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)

@@ -70,8 +70,9 @@ class StreamJunction:
             try:
                 from siddhi_tpu.native import NativeIngressRing
 
+                # +1 payload lane carries the per-row `now` clock value
                 self._ring = NativeIngressRing(
-                    int(buffer_size), len(self.schema.attrs)
+                    int(buffer_size), len(self.schema.attrs) + 1
                 )
             except Exception:
                 self._ring = None  # no toolchain: python queue fallback
@@ -111,7 +112,10 @@ class StreamJunction:
         names = self.schema.attr_names
         while not self._async_stop.is_set():
             try:
-                ts, rows = self._ring.pop_batch(self._batch_max)
+                ring = self._ring
+                if ring is None:
+                    return
+                ts, rows = ring.pop_batch(self._batch_max)
                 if ts.shape[0] == 0:
                     self._async_stop.wait(0.001)
                     continue
@@ -122,7 +126,8 @@ class StreamJunction:
                 batch = self.schema.to_batch_cols(
                     ts, cols, self.interner, capacity=self.batch_size
                 )
-                self.publish_batch(batch, int(ts[-1]))
+                # the trailing payload lane carries the send-time clock
+                self.publish_batch(batch, int(rows[-1, -1]))
             except Exception:
                 import logging
                 import traceback
@@ -195,15 +200,19 @@ class StreamJunction:
         # leave the async path BEFORE tearing the ring down so late sends fall
         # through to the synchronous publish path instead of crashing
         self.is_async = False
+        ring = getattr(self, "_ring", None)
+        self._ring = None  # detach first: queued()/producers now see None
         ev.set()
+        joined = True
         for t in self._workers:
             if t is not threading.current_thread():
                 t.join(timeout=2.0)
+                joined = joined and not t.is_alive()
         self._workers = []
-        ring = getattr(self, "_ring", None)
-        if ring is not None:
+        if ring is not None and joined:
+            # only free the native arena once no thread can still touch it;
+            # an unjoined worker leaks the ring to the GC instead of UAF-ing
             ring.close()
-            self._ring = None
 
     # ---- publishing ------------------------------------------------------
 
@@ -240,6 +249,7 @@ class StreamJunction:
                 stop = self._async_stop
                 for ts, row in zip(timestamps, rows):
                     enc = self._encode_row(row)
+                    enc.append(float(now if now is not None else ts))
                     while not ring.push(ts, enc):
                         if stop.is_set():
                             return  # shutting down: drop instead of hanging
@@ -287,11 +297,30 @@ class InputHandler:
     ) -> None:
         """High-throughput columnar ingest: one device batch per junction
         batch-size chunk, no per-row Python work (the analog of the reference's
-        @async batched Disruptor path, StreamJunction.java:262-298)."""
+        @async batched Disruptor path, StreamJunction.java:262-298).
+
+        All-numeric chunks (pre-interned string ids included) ride the packed
+        codec: ONE contiguous host->device transfer per batch, bitcast-split
+        on device — the dominant win when the chip is behind a network tunnel.
+        """
         j = self.junction
         n = len(timestamps)
         if now is None:
             now = self.clock()  # same wall-clock default as send/send_many
+        numeric = all(np.asarray(v).dtype.kind not in "OUS" for v in cols.values())
+        if numeric:
+            encode, decode = j.schema.packed_codec(j.batch_size)
+            for ofs in range(0, n, j.batch_size):
+                end = min(ofs + j.batch_size, n)
+                m = end - ofs
+                buf = encode(
+                    timestamps[ofs:end],
+                    {k: v[ofs:end] for k, v in cols.items()},
+                    m,
+                )
+                batch = decode(buf, np.int32(m))
+                j.publish_batch(batch, now)
+            return
         for ofs in range(0, n, j.batch_size):
             ts_chunk = timestamps[ofs : ofs + j.batch_size]
             chunk = {k: v[ofs : ofs + j.batch_size] for k, v in cols.items()}

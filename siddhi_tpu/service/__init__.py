@@ -56,12 +56,9 @@ class SiddhiService:
                     self._reply(404, {"error": "not found"})
                     return
                 app_name = self.path[len(prefix):].strip("/")
-                rt = service.manager.get_siddhi_app_runtime(app_name)
-                if rt is None:
+                if not service.manager.shutdown_siddhi_app_runtime(app_name):
                     self._reply(404, {"error": f"no app '{app_name}'"})
                     return
-                rt.shutdown()
-                del service.manager._runtimes[app_name]
                 self._reply(200, {"status": "undeployed", "appName": app_name})
 
         self._server = ThreadingHTTPServer((host, port), Handler)

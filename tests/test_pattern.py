@@ -207,10 +207,13 @@ class TestPattern:
         ha.send(("warm", 1.0, 0))   # filtered out — compiles the A step
         hb.send(("warm", 1.0, 0))   # no armed token — compiles the B step
         qr = rt.queries["query1"]
-        qr._timer_step(qr.state, qr._collect_table_states(),
-                       __import__("siddhi_tpu.core.app_runtime",
+        # compile the timer step (t=0: fires nothing); the step donates the
+        # state buffers, so the returned state must replace the old one
+        qr.state, _ts, _out, _aux = qr._timer_step(
+            qr.state, qr._collect_table_states(),
+            __import__("siddhi_tpu.core.app_runtime",
                        fromlist=["_pattern_timer_batch"])._pattern_timer_batch(0),
-                       0)  # compile the timer step (t=0: fires nothing)
+            0)
         return rt, ha, hb, got
 
     @staticmethod
