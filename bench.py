@@ -121,10 +121,11 @@ WORKLOADS = {
         0.25,
         8192,
     ),
-    # BASELINE.json config 4: pattern `every A -> B within` (2-state NFA)
+    # BASELINE.json config 4: pattern `every A -> B within` (2-state NFA,
+    # vectorized token-matrix fast path)
     "pattern_2state": (
         """
-        @app:patternCapacity(size='128')
+        @app:patternCapacity(size='4096')
         define stream StockStream (symbol string, price float, volume long);
         @info(name='q')
         from every a1=StockStream[price > 95] -> a2=StockStream[price < 5]
@@ -133,8 +134,8 @@ WORKLOADS = {
         insert into Out;
         """,
         "StockStream",
-        0.02,
-        1024,
+        1.0,
+        None,
     ),
     # BASELINE.json config 5: DEBS-style count sequence with a kleene bound
     "count_sequence": (

@@ -588,6 +588,200 @@ class PatternProgram:
     def out_capacity(self, batch_capacity: int) -> int:
         return max(batch_capacity, 64)
 
+    # ---- vectorized batch fast path --------------------------------------
+    #
+    # Simple chains (single-atom slots, no counts/absent/logical, `every`
+    # only as the arming slot) admit a fully vectorized batch kernel: per NFA
+    # state one [T, B] match matrix (tokens x rows), tokens advancing to
+    # their FIRST matching row — the dense "token-matrix x batch" form of
+    # SURVEY §3.3's north star. One device program per batch instead of a
+    # B-step scan; multi-hop within a batch falls out of the ascending state
+    # loop (a token advancing at state p on row j can only use rows > j at
+    # state p+1).
+
+    @property
+    def fast_path_ok(self) -> bool:
+        for i, s in enumerate(self.slots):
+            if len(s.atoms) != 1 or s.is_count or s.is_absent or s.logical:
+                return False
+            if s.persistent and i != 0:
+                return False
+            if s.atoms[0].cap != 1:
+                return False
+        if self.sequence and len({a.stream_id for a in self.refs}) > 1:
+            # multi-stream sequence strictness (an unconsumed event of ANY
+            # participating stream kills waiting tokens) needs the scan path
+            return False
+        return True
+
+    def _matrix_env(self, tok, row_cols: dict, row_ts, now, override_ref: int) -> Env:
+        """[T, 1] token columns vs [1, B] event columns -> [T, B] broadcasts."""
+        T = self.T
+        cols = {}
+        for a in self.refs:
+            c = tok["caps"][a.ref_idx]
+            cols[(a.ref, None, TS_ATTR)] = c["ts"][:, 0][:, None]
+            cols[(a.ref, 0, TS_ATTR)] = c["ts"][:, 0][:, None]
+            for name in c["cols"]:
+                cols[(a.ref, None, name)] = c["cols"][name][:, 0][:, None]
+                cols[(a.ref, 0, name)] = c["cols"][name][:, 0][:, None]
+            cols[(a.ref, None, "__arrived__")] = (c["n"] > 0)[:, None]
+        a = self.refs[override_ref]
+        for name, v in row_cols.items():
+            cols[(a.ref, None, name)] = v[None, :]
+            cols[(a.ref, 0, name)] = v[None, :]
+        cols[(a.ref, None, TS_ATTR)] = row_ts[None, :]
+        cols[(a.ref, 0, TS_ATTR)] = row_ts[None, :]
+        cols[(a.ref, None, "__arrived__")] = jnp.ones((1, 1), dtype=jnp.bool_)
+        return Env(cols, now=now)
+
+    def apply_batch_fast(
+        self, tok, batch_ts, batch_kind, batch_valid, stream_cols: dict,
+        out, out_n, overflow, now,
+    ):
+        """One vectorized pass over a whole batch of one stream's rows."""
+        T = self.T
+        B = batch_ts.shape[0]
+        S = len(self.slots)
+        rows = jnp.arange(B, dtype=jnp.int32)
+        toks = jnp.arange(T, dtype=jnp.int32)
+        v = batch_valid & (batch_kind == KIND_CURRENT)
+        entry_row = jnp.full((T,), -1, jnp.int32)  # batch-local hop cursor
+
+        for p, slot in enumerate(self.slots):
+            atom = slot.atoms[0]
+            if atom.stream_id not in stream_cols:
+                continue
+            ev = stream_cols[atom.stream_id]
+            elig = tok["active"] & (tok["slot"] == p)
+            env = self._matrix_env(tok, ev, batch_ts, now, atom.ref_idx)
+            cond = jnp.ones((T, B), dtype=jnp.bool_)
+            for c in self._conds[(p, atom.ref_idx)]:
+                cond = cond & jnp.broadcast_to(c(env), (T, B))
+            M = elig[:, None] & v[None, :] & (rows[None, :] > entry_row[:, None]) & cond
+            win = slot.within_ms if slot.within_ms is not None else self.within_ms
+            if win is not None:
+                started = tok["start_ts"] >= 0
+                M = M & ~(
+                    started[:, None]
+                    & (batch_ts[None, :] - tok["start_ts"][:, None] > win)
+                )
+            if self.sequence and not slot.persistent and p > 0:
+                # strict continuity: the match must be the FIRST valid row
+                # after the token's entry; a non-matching next row kills it
+                nxt_ok = v[None, :] & (rows[None, :] > entry_row[:, None])
+                has_next = nxt_ok.any(axis=1)
+                jnext = jnp.argmax(nxt_ok, axis=1).astype(jnp.int32)
+                M = M & (rows[None, :] == jnext[:, None])
+                die = elig & has_next & ~M.any(axis=1)
+                tok = {**tok, "active": tok["active"] & ~die}
+
+            if p == 0 and slot.persistent:
+                # `every`: each matching row forks a fresh token one state on
+                fork = M.any(axis=0) & v  # [B]
+                frank = (jnp.cumsum(fork) - fork).astype(jnp.int32)
+                free = ~tok["active"]
+                free_idx = jnp.nonzero(free, size=B, fill_value=-1)[0]
+                dest = jnp.where(fork, free_idx[jnp.clip(frank, 0, B - 1)], -1)
+                okf = fork & (dest >= 0)
+                overflow = overflow | (fork & (dest < 0)).any()
+                dstc = jnp.where(okf, dest, T)  # T = dropped lane
+                active2 = tok["active"].at[dstc].set(True, mode="drop")
+                slot2 = tok["slot"].at[dstc].set(1, mode="drop")
+                start2 = tok["start_ts"].at[dstc].set(batch_ts, mode="drop")
+                entry2 = tok["entry_ts"].at[dstc].set(batch_ts, mode="drop")
+                entry_row = entry_row.at[dstc].set(rows, mode="drop")
+                caps = [dict(c) for c in tok["caps"]]
+                cr = dict(caps[atom.ref_idx])
+                cr["n"] = cr["n"].at[dstc].set(1, mode="drop")
+                cr["ts"] = cr["ts"].at[dstc, 0].set(batch_ts, mode="drop")
+                cr["cols"] = {
+                    name: arr.at[dstc, 0].set(
+                        ev[name].astype(arr.dtype), mode="drop"
+                    )
+                    for name, arr in cr["cols"].items()
+                }
+                caps[atom.ref_idx] = cr
+                tok = {
+                    "active": active2, "slot": slot2, "start_ts": start2,
+                    "entry_ts": entry2, "caps": caps,
+                }
+            else:
+                has = M.any(axis=1)
+                j = jnp.argmax(M, axis=1).astype(jnp.int32)  # first match row
+                jc = jnp.clip(j, 0, B - 1)
+                adv = has
+                mts = batch_ts[jc]
+                caps = [dict(c) for c in tok["caps"]]
+                cr = dict(caps[atom.ref_idx])
+                cr["n"] = jnp.where(adv, 1, cr["n"])
+                cr["ts"] = jnp.where(
+                    adv[:, None], cr["ts"].at[toks, 0].set(mts), cr["ts"]
+                )
+                cr["cols"] = {
+                    name: jnp.where(
+                        adv[:, None],
+                        arr.at[toks, 0].set(ev[name][jc].astype(arr.dtype)),
+                        arr,
+                    )
+                    for name, arr in cr["cols"].items()
+                }
+                caps[atom.ref_idx] = cr
+                tok = {
+                    "active": tok["active"],
+                    "slot": jnp.where(adv, p + 1, tok["slot"]),
+                    "start_ts": jnp.where(
+                        adv & (tok["start_ts"] < 0), mts, tok["start_ts"]
+                    ),
+                    "entry_ts": jnp.where(adv, mts, tok["entry_ts"]),
+                    "caps": caps,
+                }
+                entry_row = jnp.where(adv, j, entry_row)
+
+        # completions: tokens past the last slot emit, ordered by their
+        # completion row (then token index for same-row ties)
+        done = tok["active"] & (tok["slot"] == S)
+        cap = out["valid"].shape[0]
+        key = jnp.where(done, entry_row.astype(jnp.int64) * T + toks, jnp.int64(1) << 60)
+        order = jnp.argsort(key).astype(jnp.int32)  # done tokens first, row order
+        d_sorted = done[order]
+        rank = (jnp.cumsum(d_sorted) - d_sorted).astype(jnp.int32)
+        dest = jnp.where(d_sorted & (out_n + rank < cap), out_n + rank, cap)
+        overflow = overflow | (d_sorted & (out_n + rank >= cap)).any()
+        src = order  # token index per sorted position
+        out = dict(out)
+        emit_ts = jnp.where(
+            entry_row[src] >= 0, batch_ts[jnp.clip(entry_row[src], 0, B - 1)], now
+        )
+        out["ts"] = out["ts"].at[dest].set(emit_ts, mode="drop")
+        out["valid"] = out["valid"].at[dest].set(True, mode="drop")
+        for a in self.refs:
+            c = tok["caps"][a.ref_idx]
+            out[f"n{a.ref_idx}"] = out[f"n{a.ref_idx}"].at[dest].set(c["n"][src], mode="drop")
+            out[f"ts{a.ref_idx}"] = out[f"ts{a.ref_idx}"].at[dest].set(c["ts"][src], mode="drop")
+            for name in c["cols"]:
+                out[f"c{a.ref_idx}.{name}"] = (
+                    out[f"c{a.ref_idx}.{name}"].at[dest].set(c["cols"][name][src], mode="drop")
+                )
+        out_n = jnp.minimum(out_n + done.sum(dtype=jnp.int32), cap).astype(jnp.int32)
+        tok = {**tok, "active": tok["active"] & ~done}
+
+        # purge tokens whose within expired by the end of the batch (the scan
+        # path kills them on the next arrival; purging bounds table growth)
+        last_ts = jnp.max(jnp.where(v, batch_ts, jnp.int64(0)))
+        win_by_slot = np.full((S + 1,), np.iinfo(np.int64).max, dtype=np.int64)
+        for p, slot in enumerate(self.slots):
+            w = slot.within_ms if slot.within_ms is not None else self.within_ms
+            if w is not None:
+                win_by_slot[p] = w
+        win_t = jnp.asarray(win_by_slot)[jnp.clip(tok["slot"], 0, S)]
+        started = tok["start_ts"] >= 0
+        expired = started & (last_ts - tok["start_ts"] > win_t)
+        keep0 = jnp.arange(T) == 0  # the arming token never dies
+        is_armer = keep0 & jnp.asarray(self.slots[0].persistent)
+        tok = {**tok, "active": tok["active"] & ~(expired & ~is_armer)}
+        return tok, out, out_n, overflow
+
     def init_out(self, cap: int):
         out = {
             "ts": jnp.zeros((cap,), dtype=jnp.int64),

@@ -104,6 +104,46 @@ class PatternQueryRuntime(BaseQueryRuntime):
     def _make_step(self, stream_id: Optional[str]):
         prog = self.prog
 
+        if stream_id is not None and prog.fast_path_ok:
+            def fast_step(state, tstates, batch: EventBatch, now):
+                out0 = prog.init_out(self.out_cap)
+                B = batch.capacity
+                # chunk so completed tokens free their lanes BETWEEN chunks:
+                # per-chunk fork pressure is bounded by the chunk size, which
+                # approximates the scan path's per-event lane recycling
+                # chunks no larger than half the token table, so a chunk's
+                # fork demand can always be met by lanes freed previously
+                C = min(B, max(1, prog.T // 2))
+                while B % C != 0:  # keep chunks uniform for the scan reshape
+                    C -= 1
+
+                def chunk_body(carry, xs):
+                    tok, out, out_n, ovf = carry
+                    tok, out, out_n, ovf = prog.apply_batch_fast(
+                        tok, xs["ts"], xs["kind"], xs["valid"],
+                        {stream_id: {n: xs[f"c.{n}"] for n in batch.cols}},
+                        out, out_n, ovf, now,
+                    )
+                    return (tok, out, out_n, ovf), None
+
+                xs = {
+                    "ts": batch.ts.reshape(B // C, C),
+                    "kind": batch.kind.reshape(B // C, C),
+                    "valid": batch.valid.reshape(B // C, C),
+                    **{
+                        f"c.{n}": c.reshape(B // C, C)
+                        for n, c in batch.cols.items()
+                    },
+                }
+                (tok, out, _n, ovf), _ = lax.scan(
+                    chunk_body,
+                    (state["tok"], out0, jnp.asarray(0, jnp.int32), jnp.asarray(False)),
+                    xs,
+                )
+                return self._finish_step(state, tok, out, ovf, tstates, now)
+
+            return fast_step
+
         def step(state, tstates, batch: EventBatch, now):
             out0 = prog.init_out(self.out_cap)
             carry0 = (
@@ -143,29 +183,33 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 return (tok, out, out_n, ovf), None
 
             (tok, out, _, ovf), _ = lax.scan(body, carry0, xs)
-
-            emit_batch = EventBatch(
-                ts=out["ts"],
-                kind=jnp.zeros_like(out["ts"], dtype=jnp.int8),
-                valid=out["valid"],
-                cols={},
-            )
-            flow = Flow(
-                batch=emit_batch,
-                ref=prog.refs[0].ref,
-                now=now,
-                extra_cols=prog.out_env_cols(out),
-                tables=tstates,
-            )
-            sel_state, out_batch = self.selector.apply(state["sel"], flow)
-            if self.table_op is not None:
-                tstates = self.table_op(tstates, out_batch, now, flow.aux)
-            aux = dict(flow.aux)
-            aux["pattern_overflow"] = ovf
-            aux["next_timer"] = prog.next_timer(tok)
-            return {"tok": tok, "sel": sel_state}, tstates, out_batch, aux
+            return self._finish_step(state, tok, out, ovf, tstates, now)
 
         return step
+
+    def _finish_step(self, state, tok, out, ovf, tstates, now):
+        """Shared step tail: emission buffer -> selector -> table op -> aux."""
+        prog = self.prog
+        emit_batch = EventBatch(
+            ts=out["ts"],
+            kind=jnp.zeros_like(out["ts"], dtype=jnp.int8),
+            valid=out["valid"],
+            cols={},
+        )
+        flow = Flow(
+            batch=emit_batch,
+            ref=prog.refs[0].ref,
+            now=now,
+            extra_cols=prog.out_env_cols(out),
+            tables=tstates,
+        )
+        sel_state, out_batch = self.selector.apply(state["sel"], flow)
+        if self.table_op is not None:
+            tstates = self.table_op(tstates, out_batch, now, flow.aux)
+        aux = dict(flow.aux)
+        aux["pattern_overflow"] = ovf
+        aux["next_timer"] = prog.next_timer(tok)
+        return {"tok": tok, "sel": sel_state}, tstates, out_batch, aux
 
     # ---- host side -------------------------------------------------------
 
