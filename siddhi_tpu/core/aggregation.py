@@ -634,6 +634,47 @@ class AggregationRuntime:
         )
 
 
+class AggFindable:
+    """Findable adapter exposing an aggregation's merged view (closed buckets
+    + in-flight) as a passive join side (reference: AggregationRuntime
+    participating in joins via compileExpression/find,
+    AggregationRuntime.java:176-300)."""
+
+    is_named_window = False  # passive probe target, like a table
+
+    def __init__(self, agg: "AggregationRuntime", per: Duration, within):
+        if per not in agg.tables:
+            raise SiddhiAppCreationError(
+                f"aggregation '{agg.agg_id}' has no '{per.name}' duration"
+            )
+        self.agg = agg
+        self.per = per
+        self.within = within  # (start_ms, end_ms) or None (static bounds)
+        self.table_id = f"__aggview_{agg.agg_id}_{per.name}"
+        self.schema = agg.out_schema
+
+    @property
+    def state(self):
+        return {
+            "agg": self.agg.state,
+            "table": self.agg.tables[self.per].state,
+        }
+
+    @state.setter
+    def state(self, value):  # joins never write through; writeback is a no-op
+        pass
+
+    def view(self, packed):
+        out = self.agg._find_impl(
+            self.per, packed["agg"], packed["table"], jnp.int64(0)
+        )
+        valid = out.valid
+        if self.within is not None:
+            lo, hi = self.within
+            valid = valid & (out.ts >= lo) & (out.ts < hi)
+        return out.cols, out.ts, valid
+
+
 # ---------------------------------------------------------------------------
 # within / per parsing (host)
 # ---------------------------------------------------------------------------

@@ -458,6 +458,48 @@ class SiddhiAppRuntime:
         from siddhi_tpu.core.join import DEFAULT_JOIN_CAPACITY, JoinQueryRuntime
 
         join = query.input_stream
+        # aggregation join sides expose the merged buckets view filtered by
+        # the join's within/per clause (reference: AggregationRuntime joins)
+        agg_findables = {}
+        for s in (join.left, join.right):
+            if s.stream_id in self.aggregations:
+                from siddhi_tpu.core.aggregation import (
+                    AggFindable,
+                    parse_per,
+                    parse_within_value,
+                )
+                from siddhi_tpu.query_api.expression import (
+                    AttributeFunction,
+                    Constant,
+                )
+
+                if join.per is None or not isinstance(join.per, Constant):
+                    raise SiddhiAppCreationError(
+                        "joining an aggregation needs per '<duration>'"
+                    )
+                within = None
+                w = join.within
+                if isinstance(w, AttributeFunction) and w.name == "__within_range__":
+                    lo, hi = w.parameters
+                    if not (isinstance(lo, Constant) and isinstance(hi, Constant)):
+                        raise SiddhiAppCreationError(
+                            "'within' operands must be constants"
+                        )
+                    within = (
+                        parse_within_value(lo.value)[0],
+                        parse_within_value(hi.value)[0],
+                    )
+                elif isinstance(w, Constant):
+                    within = parse_within_value(w.value)
+                elif w is not None:
+                    raise SiddhiAppCreationError(
+                        "'within' operands must be constants"
+                    )
+                agg_findables[s.stream_id] = AggFindable(
+                    self.aggregations[s.stream_id],
+                    parse_per(join.per.value),
+                    within,
+                )
         schemas = []
         for s in (join.left, join.right):
             sch = self.stream_schemas.get(s.stream_id)
@@ -465,6 +507,8 @@ class SiddhiAppRuntime:
                 sch = self.tables[s.stream_id].schema
             if sch is None and s.stream_id in self.named_windows:
                 sch = self.named_windows[s.stream_id].schema
+            if sch is None and s.stream_id in agg_findables:
+                sch = agg_findables[s.stream_id].schema
             if sch is None:
                 raise DefinitionNotExistError(f"stream '{s.stream_id}' is not defined")
             schemas.append(sch)
@@ -475,7 +519,7 @@ class SiddhiAppRuntime:
             query, qid, schemas[0], schemas[1], self.interner,
             group_capacity=self.group_capacity, join_capacity=join_capacity,
             tables=self.tables,
-            findables={**self.tables, **self.named_windows},
+            findables={**self.tables, **self.named_windows, **agg_findables},
         )
         self.queries[qid] = qr
         self._wire_insert(qr)

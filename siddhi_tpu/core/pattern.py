@@ -71,6 +71,16 @@ DEFAULT_TOKEN_CAPACITY = 128
 DEFAULT_COUNT_CAPACITY = 8
 
 
+def _min_within(slot_ms, global_ms):
+    """Effective within bound: a token dies when EITHER the slot's or the
+    pattern-global within is exceeded (matching the scan path's kill list)."""
+    if slot_ms is None:
+        return global_ms
+    if global_ms is None:
+        return slot_ms
+    return min(slot_ms, global_ms)
+
+
 @dataclasses.dataclass
 class Atom:
     """One stream obligation inside a slot (reference: a single
@@ -659,7 +669,7 @@ class PatternProgram:
             for c in self._conds[(p, atom.ref_idx)]:
                 cond = cond & jnp.broadcast_to(c(env), (T, B))
             M = elig[:, None] & v[None, :] & (rows[None, :] > entry_row[:, None]) & cond
-            win = slot.within_ms if slot.within_ms is not None else self.within_ms
+            win = _min_within(slot.within_ms, self.within_ms)
             if win is not None:
                 started = tok["start_ts"] >= 0
                 M = M & ~(
@@ -771,7 +781,7 @@ class PatternProgram:
         last_ts = jnp.max(jnp.where(v, batch_ts, jnp.int64(0)))
         win_by_slot = np.full((S + 1,), np.iinfo(np.int64).max, dtype=np.int64)
         for p, slot in enumerate(self.slots):
-            w = slot.within_ms if slot.within_ms is not None else self.within_ms
+            w = _min_within(slot.within_ms, self.within_ms)
             if w is not None:
                 win_by_slot[p] = w
         win_t = jnp.asarray(win_by_slot)[jnp.clip(tok["slot"], 0, S)]

@@ -112,10 +112,24 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 # per-chunk fork pressure is bounded by the chunk size, which
                 # approximates the scan path's per-event lane recycling
                 # chunks no larger than half the token table, so a chunk's
-                # fork demand can always be met by lanes freed previously
+                # fork demand can always be met by lanes freed previously;
+                # pad (valid=False) rather than shrink chunks so odd batch
+                # sizes keep the wide vectorized shape
                 C = min(B, max(1, prog.T // 2))
-                while B % C != 0:  # keep chunks uniform for the scan reshape
-                    C -= 1
+                pad = (-B) % C
+                if pad:
+                    def padded(x, fill=0):
+                        return jnp.concatenate(
+                            [x, jnp.full((pad,) + x.shape[1:], fill, x.dtype)]
+                        )
+
+                    batch = EventBatch(
+                        ts=padded(batch.ts),
+                        kind=padded(batch.kind),
+                        valid=padded(batch.valid, False),
+                        cols={n: padded(c) for n, c in batch.cols.items()},
+                    )
+                    B = B + pad
 
                 def chunk_body(carry, xs):
                     tok, out, out_n, ovf = carry

@@ -91,3 +91,28 @@ class TestIncrementalAggregation:
         assert sorted(e.data for e in rows) == [("IBM", 5), ("WSO2", 30)]
         rt.shutdown()
         mgr.shutdown()
+
+
+class TestAggregationJoin:
+    def test_stream_join_aggregation(self):
+        mgr, rt = build(APP + """
+        define stream Query (symbol string);
+        @info(name='j')
+        from Query join TradeAgg
+        on Query.symbol == TradeAgg.symbol
+        within 1496289720000L, 1496289730000L
+        per 'sec'
+        select Query.symbol as s, TradeAgg.total as total
+        insert into JOut;
+        """)
+        h = rt.get_input_handler("TradeStream")
+        h.send(("WSO2", 50.0, 10, BASE_TS), timestamp=1)
+        h.send(("WSO2", 70.0, 20, BASE_TS + 100), timestamp=2)
+        h.send(("IBM", 30.0, 5, BASE_TS + 200), timestamp=3)
+        got = []
+        rt.add_callback("j", lambda ts, i, r: got.extend(e.data for e in i or []))
+        rt.get_input_handler("Query").send(("WSO2",), timestamp=4)
+        # the in-flight second bucket for WSO2 joins: total 30
+        assert got == [("WSO2", 30)]
+        rt.shutdown()
+        mgr.shutdown()
