@@ -723,6 +723,8 @@ class SiddhiAppRuntime:
             self._playback_clock.stop()
         for qr in self.queries.values():
             qr.flush_aux_warnings()
+        for t in self.tables.values():
+            t.flush_record_store()
         self._scheduler.shutdown()
 
     # ---- snapshot / persistence (reference: SiddhiAppRuntime.persist/
@@ -755,6 +757,8 @@ class SiddhiAppRuntime:
     def persist(self) -> str:
         import time as _time
 
+        for t in self.tables.values():
+            t.flush_record_store()
         store = self._store()
         svc = self.snapshot_service
         if getattr(store, "incremental", False):

@@ -48,6 +48,7 @@ from siddhi_tpu.query_api.expression import (
 # scope-canonicalized alias; TS_ATTR keys the timestamp lane.
 VarKey = tuple[str, Optional[int], str]
 TS_ATTR = "__ts__"
+VALID_ATTR = "__valid__"
 
 
 class Env:
@@ -491,6 +492,51 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
 
     if name == "currentTimeMillis":
         return CompiledExpr(AttrType.LONG, lambda env: env.now())
+
+    if name == "UUID":
+        # string generation cannot happen on device: a host callback mints
+        # one UUID per VALID row and interns it (reference:
+        # executor/function/UUIDFunctionExecutor). io_callback (not
+        # pure_callback): minting is impure — it must never be CSE'd or
+        # replayed, or duplicate/unrecorded ids would appear.
+        import jax as _jax
+
+        if _jax.default_backend() not in ("cpu", "gpu", "tpu"):
+            raise NotImplementedError(
+                f"UUID() needs host-callback support, which the "
+                f"'{_jax.default_backend()}' backend does not provide"
+            )
+        interner = scope.interner
+        valid_key = (scope.default_ref, None, VALID_ATTR)
+
+        def fn(env: Env) -> jnp.ndarray:
+            ts = env.read(scope.ts_key())
+            try:
+                valid = env.read(valid_key)
+            except KeyError:
+                valid = jnp.ones(jnp.shape(ts), dtype=jnp.bool_)
+
+            def mint(v):
+                import uuid as _uuid
+
+                import numpy as _np
+
+                flat = _np.asarray(v).reshape(-1)
+                out = _np.zeros(flat.shape, dtype=_np.int32)  # padding: null id
+                for i in _np.nonzero(flat)[0]:
+                    out[i] = interner.intern(str(_uuid.uuid4()))
+                return out.reshape(_np.shape(v))
+
+            import jax
+            from jax.experimental import io_callback
+
+            return io_callback(
+                mint,
+                jax.ShapeDtypeStruct(jnp.shape(valid), jnp.int32),
+                valid,
+            )
+
+        return CompiledExpr(AttrType.STRING, fn)
 
     if name == "default":
         src = compile_expression(params[0], scope)

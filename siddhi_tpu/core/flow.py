@@ -13,7 +13,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from siddhi_tpu.core.event import EventBatch, KIND_CURRENT, KIND_EXPIRED, KIND_RESET
-from siddhi_tpu.core.executor import Env, TS_ATTR, VarKey
+from siddhi_tpu.core.executor import Env, TS_ATTR, VALID_ATTR, VarKey
 
 
 @dataclasses.dataclass
@@ -41,6 +41,7 @@ class Flow:
             (self.ref, None, name): arr for name, arr in self.batch.cols.items()
         }
         cols[(self.ref, None, TS_ATTR)] = self.batch.ts
+        cols[(self.ref, None, VALID_ATTR)] = self.batch.valid
         cols.update(self.extra_cols)
         return Env(cols, now=self.now, tables=self.tables)
 

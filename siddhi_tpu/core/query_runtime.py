@@ -180,6 +180,8 @@ class BaseQueryRuntime:
             )
         used = collect_used_tables(self.query, tables)
         self.tables = {tid: tables[tid] for tid in sorted(used)}
+        target = getattr(self.query.output_stream, "target", None)
+        self._mutates_table = target if self.table_op is not None else None
 
     def _collect_table_states(self) -> dict:
         st = {tid: t.state for tid, t in self.tables.items()}
@@ -189,8 +191,11 @@ class BaseQueryRuntime:
         return st
 
     def _writeback_table_states(self, tstates: dict) -> None:
+        mutated = getattr(self, "_mutates_table", None)
         for tid, t in self.tables.items():
             t.state = tstates[tid]
+            if tid == mutated:
+                t.notify_change()  # record-store write-through
 
     def init_state(self):
         raise NotImplementedError

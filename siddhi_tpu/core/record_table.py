@@ -1,0 +1,83 @@
+"""External record-table SPI: tables backed by a pluggable store.
+
+Reference: table/record/AbstractRecordTable.java + AbstractQueryableRecordTable
+— the SPI external stores (RDBMS etc.) implement, with
+`ExpressionBuilder`->`CompiledExpression` condition pushdown.
+
+TPU-native shape: the device columnar arena IS the working copy (every query
+keeps probing it with fused kernels); a `@store(type='...')` table loads its
+initial contents from the record store at app creation and writes a row
+snapshot through after every mutating step. Condition pushdown is unnecessary
+— the dense on-device scan is the fast path, the external store is durability.
+Stores register via @extension("store", name).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+
+class RecordStore:
+    """SPI: durable backing for one table."""
+
+    def init(self, table_id: str, schema, options: dict) -> None:
+        self.table_id = table_id
+        self.schema = schema
+        self.options = options
+
+    def load(self) -> list[tuple]:
+        """Initial table contents (rows of python values, schema order)."""
+        return []
+
+    def on_change(self, rows: list[tuple]) -> None:
+        """Write-through: the table's full row snapshot after a mutation."""
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+
+class InMemoryRecordStore(RecordStore):
+    """Process-wide store keyed by `store.id` (or the table id) — survives app
+    restarts within the process; the reference's test analog of an external
+    store."""
+
+    _lock = threading.Lock()
+    _data: dict[str, list[tuple]] = {}
+
+    def _key(self) -> str:
+        return self.options.get("store.id", self.table_id)
+
+    def load(self) -> list[tuple]:
+        with self._lock:
+            return list(self._data.get(self._key(), []))
+
+    def on_change(self, rows: list[tuple]) -> None:
+        with self._lock:
+            self._data[self._key()] = list(rows)
+
+    @classmethod
+    def clear_all(cls) -> None:
+        with cls._lock:
+            cls._data.clear()
+
+
+RECORD_STORES = {"memory": InMemoryRecordStore}
+
+
+def build_record_store(ann, table_id: str, schema) -> Optional[RecordStore]:
+    """From a table definition's @store(type='...', ...) annotation."""
+    from siddhi_tpu.core.extension import lookup
+
+    stype = ann.element("type")
+    if stype is None:
+        raise SiddhiAppCreationError("@store needs a type")
+    cls = RECORD_STORES.get(stype.lower()) or lookup("store", stype)
+    if cls is None:
+        raise SiddhiAppCreationError(f"unknown store type '{stype}'")
+    store = cls()
+    store.init(table_id, schema, {k: v for k, v in ann.elements if k is not None})
+    return store

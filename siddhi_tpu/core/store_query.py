@@ -127,6 +127,7 @@ class StoreQueryRuntime:
             if sq.output_stream is not None
             else None
         )
+        self._write_target = getattr(sq.output_stream, "target", None)
         self._step = jax.jit(self._step_impl)
 
     # ---- device program --------------------------------------------------
@@ -188,5 +189,7 @@ class StoreQueryRuntime:
             tstates, out = self._step(tstates, jnp.asarray(now, dtype=jnp.int64))
         for tid, t in self.tables.items():
             t.state = tstates[tid]  # windows are read-only: not written back
+        if self.table_op is not None and self._write_target in self.tables:
+            self.tables[self._write_target].notify_change()
         rows = self.out_schema.from_batch(out, self.interner)
         return [Event(ts, data) for ts, _kind, data in rows]

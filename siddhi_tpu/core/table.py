@@ -85,7 +85,55 @@ class InMemoryTable:
 
         self.lock = threading.RLock()
         self.state = self.init_state()
-        self._change_listeners: list[Callable] = []
+
+        # @store(type='...'): external record store — load initial contents,
+        # write a snapshot through after each mutation (reference:
+        # AbstractRecordTable SPI; see core/record_table.py)
+        self.record_store = None
+        store_ann = find_annotation(definition.annotations, "store")
+        if store_ann is not None:
+            from siddhi_tpu.core.record_table import build_record_store
+
+            self.record_store = build_record_store(
+                store_ann, self.table_id, self.schema
+            )
+            rows = self.record_store.load()
+            if len(rows) > self.capacity:
+                raise SiddhiAppCreationError(
+                    f"table '{self.table_id}': record store holds "
+                    f"{len(rows)} rows but capacity is {self.capacity}; "
+                    "raise it with @capacity(size='N') before restarting"
+                )
+            if rows:
+                batch = self.schema.to_batch(
+                    [0] * len(rows), rows, interner, capacity=len(rows)
+                )
+                aux: dict = {}
+                self.state = self.insert(self.state, batch, aux)
+        self._dirty = False
+        self._last_flush = 0.0
+
+    def notify_change(self) -> None:
+        """Mark dirty; snapshots coalesce to at most one per second (the
+        full-table host decode would otherwise stall the dispatch pipeline on
+        every mutating step). flush_record_store() forces the write."""
+        if self.record_store is None:
+            return
+        import time as _time
+
+        self._dirty = True
+        now = _time.monotonic()
+        if now - self._last_flush >= 1.0:
+            self.flush_record_store()
+
+    def flush_record_store(self) -> None:
+        if self.record_store is None or not self._dirty:
+            return
+        import time as _time
+
+        self.record_store.on_change(self.rows())
+        self._dirty = False
+        self._last_flush = _time.monotonic()
 
     # ---- state ------------------------------------------------------------
 

@@ -274,3 +274,29 @@ class TestStoreQueryCrud:
         assert rows == []
         rt.shutdown()
         mgr.shutdown()
+
+
+class TestRecordStore:
+    def test_store_backed_table_survives_restart(self):
+        from siddhi_tpu.core.record_table import InMemoryRecordStore
+
+        InMemoryRecordStore.clear_all()
+        app = """
+        define stream S (symbol string, volume long);
+        @store(type='memory', store.id='t1')
+        define table T (symbol string, volume long);
+        from S insert into T;
+        """
+        mgr, rt = build(app)
+        rt.get_input_handler("S").send(("WSO2", 100), timestamp=1)
+        rt.get_input_handler("S").send(("IBM", 10), timestamp=2)
+        rt.shutdown()
+        mgr.shutdown()
+
+        # a NEW runtime loads the durable contents back
+        mgr2, rt2 = build(app)
+        rows = rt2.query("from T select symbol, volume")
+        assert sorted(e.data for e in rows) == [("IBM", 10), ("WSO2", 100)]
+        rt2.shutdown()
+        mgr2.shutdown()
+        InMemoryRecordStore.clear_all()
