@@ -723,9 +723,12 @@ class SiddhiAppRuntime:
             self._playback_clock.stop()
         for qr in self.queries.values():
             qr.flush_aux_warnings()
+        self._scheduler.shutdown()
+        # flush AFTER the scheduler stops so no timer can re-dirty a table
         for t in self.tables.values():
             t.flush_record_store()
-        self._scheduler.shutdown()
+            if t.record_store is not None:
+                t.record_store.disconnect()
 
     # ---- snapshot / persistence (reference: SiddhiAppRuntime.persist/
     # restore/restoreRevision/restoreLastRevision :560-600) -----------------

@@ -499,12 +499,12 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
         # executor/function/UUIDFunctionExecutor). io_callback (not
         # pure_callback): minting is impure — it must never be CSE'd or
         # replayed, or duplicate/unrecorded ids would appear.
-        import jax as _jax
+        from siddhi_tpu.utils.backend import host_callbacks_supported
 
-        if _jax.default_backend() not in ("cpu", "gpu", "tpu"):
+        if not host_callbacks_supported():
             raise NotImplementedError(
-                f"UUID() needs host-callback support, which the "
-                f"'{_jax.default_backend()}' backend does not provide"
+                "UUID() needs host-callback support, which this backend "
+                "does not provide"
             )
         interner = scope.interner
         valid_key = (scope.default_ref, None, VALID_ATTR)

@@ -29,6 +29,7 @@ from siddhi_tpu.core.event import (
     StreamSchema,
 )
 from siddhi_tpu.core.executor import Env, Scope, TS_ATTR, compile_expression
+from siddhi_tpu.core.join import JoinQueryRuntime
 from siddhi_tpu.core.query_runtime import QueryRuntime
 from siddhi_tpu.core.types import AttrType
 from siddhi_tpu.ops.group import assign_slots
@@ -47,6 +48,19 @@ NO_TIMER = jnp.iinfo(jnp.int64).max
 
 def _tile(x, p):
     return jnp.repeat(x[None], p, axis=0)
+
+
+def _reduce_paux(auxs: dict, povf=None) -> dict:
+    """Collapse vmapped per-partition aux values: timers take the earliest,
+    boolean flags OR together; the key-table overflow folds in."""
+    aux = {
+        k: (v.min() if k == "next_timer" else v.any()) for k, v in auxs.items()
+    }
+    if povf is not None:
+        aux["partition_overflow"] = aux.get(
+            "partition_overflow", jnp.bool_(False)
+        ) | povf
+    return aux
 
 
 class PartitionedQueryRuntime(QueryRuntime):
@@ -90,13 +104,7 @@ class PartitionedQueryRuntime(QueryRuntime):
             return st, out, aux
 
         states2, outs, auxs = jax.vmap(one)(states, jnp.arange(self.p))
-        aux = {}
-        for k, v in auxs.items():
-            if k == "next_timer":
-                aux[k] = v.min()
-            else:
-                aux[k] = v.any()
-        return states2, outs, aux
+        return states2, outs, _reduce_paux(auxs)
 
     def _pstep_outer_impl(self, ptable, states, batch: EventBatch, now):
         cols = {(self.ref, None, n): c for n, c in batch.cols.items()}
@@ -113,7 +121,9 @@ class PartitionedQueryRuntime(QueryRuntime):
             return (active & (slot == p)) | is_timer
 
         states2, outs, aux = self._vmapped(states, make_valid, batch, now)
-        aux["partition_overflow"] = aux.get("partition_overflow", jnp.bool_(False)) | povf
+        aux["partition_overflow"] = aux.get(
+            "partition_overflow", jnp.bool_(False)
+        ) | povf
         return {"keys": pk, "used": pu, "n": pn}, states2, outs, aux
 
     def _pstep_inner_impl(self, states, pbatch, now):
@@ -123,10 +133,7 @@ class PartitionedQueryRuntime(QueryRuntime):
             return st, out, aux
 
         states2, outs, auxs = jax.vmap(one)(states, pbatch)
-        aux = {}
-        for k, v in auxs.items():
-            aux[k] = v.min() if k == "next_timer" else v.any()
-        return states2, outs, aux
+        return states2, outs, _reduce_paux(auxs)
 
     # ---- host ----------------------------------------------------------------
 
@@ -150,6 +157,195 @@ class PartitionedQueryRuntime(QueryRuntime):
             )
         self._warn_aux(aux)
         return _flatten(outs), outs, aux
+
+
+class PartitionedJoinQueryRuntime(JoinQueryRuntime):
+    """A join whose per-side state carries a leading [P] partition axis —
+    both sides' events route to their key's partition and probe only that
+    partition's windows (reference: per-key cloned JoinStreamRuntimes,
+    PartitionTestCase join coverage)."""
+
+    def __init__(
+        self,
+        query: Query,
+        query_id: str,
+        left_schema: StreamSchema,
+        right_schema: StreamSchema,
+        interner,
+        p_capacity: int,
+        key_of_by_side: dict,  # side -> key fn
+        group_capacity=None,
+        join_capacity: int = 512,
+    ):
+        super().__init__(
+            query, query_id, left_schema, right_schema, interner,
+            group_capacity=group_capacity, join_capacity=join_capacity,
+            tables={},
+        )
+        if self.needs_scheduler["l"] or self.needs_scheduler["r"]:
+            raise SiddhiAppCreationError(
+                "time windows on join sides inside partitions are not "
+                "supported yet"
+            )
+        self.p = int(p_capacity)
+        self.key_of_by_side = key_of_by_side
+        self._psteps = {
+            side: jax.jit(
+                lambda pt, st, b, now, _s=side: self._pstep_impl(pt, st, b, now, _s),
+                donate_argnums=(1,),
+            )
+            for side in ("l", "r")
+        }
+
+    def init_state(self):
+        one = super().init_state()
+        return jax.tree_util.tree_map(lambda x: _tile(x, self.p), one)
+
+    def _pstep_impl(self, ptable, states, batch: EventBatch, now, side: str):
+        sid = (self.join.left if side == "l" else self.join.right).stream_id
+        cols = {(sid, None, n): c for n, c in batch.cols.items()}
+        cols[(sid, None, TS_ATTR)] = batch.ts
+        keys, matched = self.key_of_by_side[side](Env(cols, now=now))
+        active = batch.valid & (batch.kind == KIND_CURRENT) & matched
+        pk, pu, pn, slot, _same, povf = assign_slots(
+            ptable["keys"], ptable["used"], ptable["n"], keys, active
+        )
+        is_timer = batch.valid & (batch.kind == KIND_TIMER)
+
+        def one(state, p):
+            sub_valid = (active & (slot == p)) | is_timer
+            b2 = EventBatch(batch.ts, batch.kind, sub_valid, batch.cols)
+            st, _ts, out, aux = self._step_impl(state, {}, b2, now, side)
+            return st, out, aux
+
+        states2, outs, auxs = jax.vmap(one)(states, jnp.arange(self.p))
+        aux = _reduce_paux(auxs, povf)
+        return {"keys": pk, "used": pu, "n": pn}, states2, outs, aux
+
+    def receive_partitioned(self, ptable, batch: EventBatch, now: int, side: str):
+        with self._receive_lock:
+            if self.state is None:
+                self.state = self._fresh(self.init_state())
+            ptable, self.state, outs, aux = self._psteps[side](
+                ptable, self.state, batch, jnp.asarray(now, jnp.int64)
+            )
+        self._warn_aux(aux)
+        return ptable, _flatten(outs), outs, aux
+
+
+class PartitionedPatternQueryRuntime:
+    """A pattern/sequence whose token table carries a leading [P] axis —
+    each key value runs an independent NFA (reference: per-key cloned
+    state runtimes, PartitionTestCase pattern/sequence coverage)."""
+
+    def __init__(
+        self,
+        query: Query,
+        query_id: str,
+        schemas: dict,
+        interner,
+        p_capacity: int,
+        key_fns: dict,  # stream_id -> key fn
+        group_capacity=None,
+        token_capacity: int = 128,
+        count_capacity: int = 8,
+        batch_size: int = 64,
+    ):
+        from siddhi_tpu.core.pattern_runtime import PatternQueryRuntime
+
+        self._inner = PatternQueryRuntime(
+            query, query_id, schemas, interner,
+            group_capacity=group_capacity, token_capacity=token_capacity,
+            count_capacity=count_capacity, batch_size=batch_size, tables={},
+        )
+        if self._inner.needs_scheduler:
+            raise SiddhiAppCreationError(
+                "absent states inside partitions are not supported yet"
+            )
+        inner = self._inner
+        self.query = query
+        self.query_id = query_id
+        self.prog = inner.prog
+        self.out_schema = inner.out_schema
+        self.output_events = inner.output_events
+        self.query_callbacks = inner.query_callbacks
+        self.rate_limiter = inner.rate_limiter
+        self.table_op = None
+        self.tables = {}
+        self.needs_scheduler = False
+        self.timer_target = None
+        self.inner_publish = None
+        self.p = int(p_capacity)
+        self.state = None
+        self._receive_lock = inner._receive_lock
+        for sid in self.prog.stream_ids:
+            if sid not in key_fns:
+                raise SiddhiAppCreationError(
+                    f"partition has no key for pattern stream '{sid}'"
+                )
+        self.key_fns = key_fns
+        self.schemas = schemas
+        self._psteps = {
+            sid: jax.jit(
+                lambda pt, st, b, now, _sid=sid: self._pstep_impl(pt, st, b, now, _sid),
+                donate_argnums=(1,),
+            )
+            for sid in self.prog.stream_ids
+        }
+
+    # routing shared with BaseQueryRuntime via delegation
+    @property
+    def publish_fn(self):
+        return self._inner.publish_fn
+
+    @publish_fn.setter
+    def publish_fn(self, fn):
+        self._inner.publish_fn = fn
+
+    def route_output(self, out, now, decode):
+        self._inner.route_output(out, now, decode)
+
+    def _warn_aux(self, aux):
+        self._inner._warn_aux(aux)
+
+    def flush_aux_warnings(self):
+        self._inner.flush_aux_warnings()
+
+    def init_state(self):
+        one = self._inner.init_state()
+        return jax.tree_util.tree_map(lambda x: _tile(x, self.p), one)
+
+    def _pstep_impl(self, ptable, states, batch: EventBatch, now, stream_id: str):
+        cols = {(stream_id, None, n): c for n, c in batch.cols.items()}
+        cols[(stream_id, None, TS_ATTR)] = batch.ts
+        keys, matched = self.key_fns[stream_id](Env(cols, now=now))
+        active = batch.valid & (batch.kind == KIND_CURRENT) & matched
+        pk, pu, pn, slot, _same, povf = assign_slots(
+            ptable["keys"], ptable["used"], ptable["n"], keys, active
+        )
+        step = self._inner._make_step(stream_id)
+
+        def one(state, p):
+            sub_valid = active & (slot == p)
+            b2 = EventBatch(batch.ts, batch.kind, sub_valid, batch.cols)
+            st, _ts, out, aux = step(state, {}, b2, now)
+            return st, out, aux
+
+        states2, outs, auxs = jax.vmap(one)(states, jnp.arange(self.p))
+        aux = _reduce_paux(auxs, povf)
+        return {"keys": pk, "used": pu, "n": pn}, states2, outs, aux
+
+    def receive_partitioned(self, ptable, batch: EventBatch, now: int, stream_id: str):
+        with self._receive_lock:
+            if self.state is None:
+                from siddhi_tpu.core.query_runtime import BaseQueryRuntime
+
+                self.state = BaseQueryRuntime._fresh(self.init_state())
+            ptable, self.state, outs, aux = self._psteps[stream_id](
+                ptable, self.state, batch, jnp.asarray(now, jnp.int64)
+            )
+        self._warn_aux(aux)
+        return ptable, _flatten(outs), outs, aux
 
 
 def _flatten(outs: EventBatch) -> EventBatch:
@@ -251,9 +447,21 @@ class PartitionRuntime:
     def _add_query(self, qid: str, query: Query) -> None:
         app = self.app
         stream = query.input_stream
+        from siddhi_tpu.query_api.execution import (
+            JoinInputStream,
+            StateInputStream,
+        )
+
+        if isinstance(stream, JoinInputStream):
+            self._add_join_query(qid, query)
+            return
+        if isinstance(stream, StateInputStream):
+            self._add_pattern_query(qid, query)
+            return
         if not isinstance(stream, SingleInputStream):
             raise SiddhiAppCreationError(
-                "joins/patterns inside partitions are not supported yet"
+                f"{type(stream).__name__} queries inside partitions are not "
+                "supported yet"
             )
         is_inner = stream.is_inner
         if is_inner:
@@ -359,6 +567,102 @@ class PartitionRuntime:
                     app._maybe_schedule(_qr, aux)
 
                 qr.timer_target = fire
+
+    def _check_output_target(self, query: Query) -> None:
+        out = query.output_stream
+        target = getattr(out, "target", None)
+        if getattr(out, "is_inner", False):
+            raise SiddhiAppCreationError(
+                "#inner outputs from joins/patterns inside partitions are "
+                "not supported yet"
+            )
+        if target is not None and target in self.app.tables:
+            raise SiddhiAppCreationError(
+                "writing to a table from inside a partition is not supported yet"
+            )
+
+    def _add_join_query(self, qid: str, query: Query) -> None:
+        app = self.app
+        join = query.input_stream
+        schemas = []
+        key_by_side = {}
+        for side, s in (("l", join.left), ("r", join.right)):
+            if s.is_inner:
+                raise SiddhiAppCreationError(
+                    "#inner streams on join sides inside partitions are not "
+                    "supported yet"
+                )
+            kf = self.key_fns.get(s.stream_id)
+            if kf is None:
+                raise SiddhiAppCreationError(
+                    f"partition has no key for stream '{s.stream_id}'"
+                )
+            key_by_side[side] = kf
+            sch = app.stream_schemas.get(s.stream_id)
+            if sch is None:
+                raise SiddhiAppCreationError(
+                    "only plain streams can join inside partitions"
+                )
+            schemas.append(sch)
+        self._check_output_target(query)
+        qr = PartitionedJoinQueryRuntime(
+            query, qid, schemas[0], schemas[1], app.interner,
+            p_capacity=self.p, key_of_by_side=key_by_side,
+            group_capacity=app.group_capacity,
+            join_capacity=app._capacity_annotation("app:joinCapacity", 512),
+        )
+        self.queries.append(qr)
+        app.queries[qid] = qr
+        app._wire_insert(qr)
+        decode = app._decode
+
+        def receive_side(batch: EventBatch, now: int, side: str, _qr=qr) -> None:
+            with app._process_lock:
+                self.ptable, flat, _p_out, aux = _qr.receive_partitioned(
+                    self.ptable, batch, now, side
+                )
+                _qr.route_output(flat, now, decode)
+
+        if join.left.stream_id == join.right.stream_id:
+            j = app._junction(join.left.stream_id)
+            j.subscribe(
+                lambda b, now: (receive_side(b, now, "l"), receive_side(b, now, "r"))
+            )
+        else:
+            app._junction(join.left.stream_id).subscribe(
+                lambda b, now: receive_side(b, now, "l")
+            )
+            app._junction(join.right.stream_id).subscribe(
+                lambda b, now: receive_side(b, now, "r")
+            )
+
+    def _add_pattern_query(self, qid: str, query: Query) -> None:
+        app = self.app
+        self._check_output_target(query)
+        qr = PartitionedPatternQueryRuntime(
+            query, qid, app.stream_schemas, app.interner,
+            p_capacity=self.p, key_fns=self.key_fns,
+            group_capacity=app.group_capacity,
+            token_capacity=app._capacity_annotation("app:patternCapacity", 128),
+            count_capacity=app._capacity_annotation("app:countCapacity", 8),
+            batch_size=app.batch_size,
+        )
+        self.queries.append(qr)
+        app.queries[qid] = qr
+        app._wire_insert(qr)
+        decode = app._decode
+
+        def receive(batch: EventBatch, now: int, sid: str, _qr=qr) -> None:
+            with app._process_lock:
+                self.ptable, flat, _p_out, aux = _qr.receive_partitioned(
+                    self.ptable, batch, now, sid
+                )
+                _qr.route_output(flat, now, decode)
+
+        for sid in qr.prog.stream_ids:
+            app._junction(sid).subscribe(
+                lambda b, now, _sid=sid: receive(b, now, _sid)
+            )
 
     def _route(self, qr, flat: EventBatch, p_out, now: int, decode) -> None:
         if qr.inner_publish is not None:

@@ -64,14 +64,15 @@ class LogStage:
     def apply(self, flow: Flow) -> Flow:
         import logging
 
-        if jax.default_backend() not in ("cpu", "gpu", "tpu"):
+        from siddhi_tpu.utils.backend import host_callbacks_supported
+
+        if not host_callbacks_supported():
             # backends without host callbacks (e.g. tunneled chips): #log
             # degrades to a pass-through with a one-time notice
             if not getattr(self, "_warned", False):
                 self._warned = True
                 logging.getLogger(f"siddhi_tpu.log.{self.stream_id}").warning(
-                    "#log disabled: the '%s' backend has no host callbacks",
-                    jax.default_backend(),
+                    "#log disabled: this backend has no host callbacks"
                 )
             return flow
 

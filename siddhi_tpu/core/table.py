@@ -119,18 +119,34 @@ class InMemoryTable:
         every mutating step). flush_record_store() forces the write."""
         if self.record_store is None:
             return
+        import threading as _threading
         import time as _time
 
         self._dirty = True
         now = _time.monotonic()
         if now - self._last_flush >= 1.0:
             self.flush_record_store()
+        elif getattr(self, "_flush_timer", None) is None:
+            # coalesced: schedule a deferred flush so a final mutation in a
+            # quiet period still reaches the store without a clean shutdown
+            t = _threading.Timer(1.0, self._deferred_flush)
+            t.daemon = True
+            self._flush_timer = t
+            t.start()
+
+    def _deferred_flush(self) -> None:
+        self._flush_timer = None
+        self.flush_record_store()
 
     def flush_record_store(self) -> None:
         if self.record_store is None or not self._dirty:
             return
         import time as _time
 
+        timer = getattr(self, "_flush_timer", None)
+        if timer is not None:
+            timer.cancel()
+            self._flush_timer = None
         self.record_store.on_change(self.rows())
         self._dirty = False
         self._last_flush = _time.monotonic()

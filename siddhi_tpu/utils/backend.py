@@ -1,0 +1,34 @@
+"""Backend capability probes.
+
+Some PJRT plugins (e.g. tunneled accelerators) report a standard platform
+name but reject host send/recv callbacks at execution time — a name check
+cannot detect that, so capabilities are probed once by actually running a
+trivial callback.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_CB_SUPPORT: Optional[bool] = None
+
+
+def host_callbacks_supported() -> bool:
+    """True when jax io/debug callbacks execute on the default backend."""
+    global _CB_SUPPORT
+    if _CB_SUPPORT is None:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import io_callback
+
+        def probe(x):
+            return io_callback(
+                lambda v: v, jax.ShapeDtypeStruct((), jnp.int32), x
+            )
+
+        try:
+            jax.jit(probe)(jnp.int32(0)).block_until_ready()
+            _CB_SUPPORT = True
+        except Exception:
+            _CB_SUPPORT = False
+    return _CB_SUPPORT

@@ -112,3 +112,49 @@ class TestInnerStream:
             ("S", ("A", 5), 3),   # A total 13 -> passes
         ])
         assert ins == [("B", 20), ("A", 13)]
+
+
+class TestJoinInPartition:
+    def test_per_key_join_windows(self):
+        ql = """
+        define stream A (symbol string, av long);
+        define stream B (symbol string, bv long);
+        partition with (symbol of A, symbol of B)
+        begin
+            @info(name='q')
+            from A#window.length(2) join B#window.length(2)
+            on A.av == B.bv
+            select A.symbol as s, A.av as av
+            insert into Out;
+        end;
+        """
+        ins = run_app(ql, [
+            ("A", ("K1", 7), 1),
+            ("B", ("K2", 7), 2),   # same value, DIFFERENT key: must NOT join
+            ("B", ("K1", 7), 3),   # same key: joins
+            ("A", ("K2", 9), 4),
+            ("B", ("K2", 9), 5),   # K2 joins within its own partition
+        ])
+        assert ins == [("K1", 7), ("K2", 9)]
+
+
+class TestPatternInPartition:
+    def test_per_key_pattern(self):
+        ql = """
+        define stream S (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            @info(name='q')
+            from every e1=S[price > 90] -> e2=S[price < 10]
+            select e1.symbol as s, e1.price as p1, e2.price as p2
+            insert into Out;
+        end;
+        """
+        ins = run_app(ql, [
+            ("S", ("K1", 95.0), 1),
+            ("S", ("K2", 5.0), 2),    # different key: must NOT complete K1's token
+            ("S", ("K2", 96.0), 3),
+            ("S", ("K1", 4.0), 4),    # completes K1
+            ("S", ("K2", 3.0), 5),    # completes K2
+        ])
+        assert ins == [("K1", 95.0, 4.0), ("K2", 96.0, 3.0)]
