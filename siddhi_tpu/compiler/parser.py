@@ -824,7 +824,7 @@ class Parser:
                 sq.selector = self._query_section()
             else:
                 sq.selector = Selector(select_all=True)
-            if self.at_kw("update", "delete"):
+            if self.at_kw("update", "delete", "insert"):
                 sq.output_stream = self._query_output()
         else:
             sq.selector = self._query_section()

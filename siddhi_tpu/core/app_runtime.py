@@ -283,6 +283,7 @@ class SiddhiAppRuntime:
             if schema is None:
                 raise DefinitionNotExistError(f"stream '{stream_id}' is not defined")
             j = StreamJunction(schema, self.interner, self.batch_size)
+            j.exception_handler = getattr(self, "_exception_handler", None)
             self.junctions[stream_id] = j
         return j
 
@@ -609,6 +610,14 @@ class SiddhiAppRuntime:
 
     input_handler = get_input_handler
 
+    def set_exception_handler(self, handler) -> None:
+        """Route subscriber-dispatch failures to `handler(exc)` instead of
+        propagating to the sender (reference: SiddhiAppRuntime.handleExceptionWith
+        for the Disruptor ExceptionHandler)."""
+        for j in self.junctions.values():
+            j.exception_handler = handler
+        self._exception_handler = handler
+
     def debug(self):
         """Step-mode debugger (reference: SiddhiAppRuntime.debug:509)."""
         from siddhi_tpu.core.debugger import SiddhiDebugger
@@ -726,9 +735,7 @@ class SiddhiAppRuntime:
         self._scheduler.shutdown()
         # flush AFTER the scheduler stops so no timer can re-dirty a table
         for t in self.tables.values():
-            t.flush_record_store()
-            if t.record_store is not None:
-                t.record_store.disconnect()
+            t.close_record_store()
 
     # ---- snapshot / persistence (reference: SiddhiAppRuntime.persist/
     # restore/restoreRevision/restoreLastRevision :560-600) -----------------

@@ -267,10 +267,13 @@ class StreamSchema:
         self, batch: EventBatch, interner: InternTable
     ) -> list[tuple[int, int, tuple]]:
         """Unpack valid rows to host `(timestamp, kind, data_tuple)` triples."""
-        valid = np.asarray(batch.valid)
-        ts = np.asarray(batch.ts)
-        kind = np.asarray(batch.kind)
-        host_cols = {n: np.asarray(c) for n, c in batch.cols.items()}
+        # one bulk device_get for all lanes: per-column np.asarray would pay
+        # one device round trip per column (painful behind a network tunnel)
+        host = jax.device_get(batch)
+        valid = np.asarray(host.valid)
+        ts = np.asarray(host.ts)
+        kind = np.asarray(host.kind)
+        host_cols = {n: np.asarray(c) for n, c in host.cols.items()}
         out: list[tuple[int, int, tuple]] = []
         for i in np.nonzero(valid)[0]:
             row = []

@@ -331,7 +331,15 @@ class CompiledJoin:
         aux["join_overflow"] = n_matches > cap
 
         flat = pair.reshape(-1)
-        (idx,) = jnp.nonzero(flat, size=cap, fill_value=-1)
+        # compact match indices WITHOUT a device sort (nonzero lowers to one):
+        # rank matched cells by prefix count and scatter their indices
+        rank = jnp.cumsum(flat) - flat
+        pos = jnp.where(flat & (rank < cap), rank, cap)
+        idx = (
+            jnp.full((cap,), -1, jnp.int32)
+            .at[pos]
+            .set(jnp.arange(flat.shape[0], dtype=jnp.int32), mode="drop")
+        )
         valid_out = idx >= 0
         pi = jnp.clip(idx // wj, 0, row_mask.shape[0] - 1)
         pj_raw = jnp.where(idx >= 0, idx % wj, w)

@@ -493,13 +493,7 @@ class PartitionRuntime:
         app.queries[qid] = qr
 
         out = query.output_stream
-        target = getattr(out, "target", None)
-        if target is not None and not getattr(out, "is_inner", False) and (
-            target in app.tables
-        ):
-            raise SiddhiAppCreationError(
-                "writing to a table from inside a partition is not supported yet"
-            )
+        self._check_output_target(query, allow_inner=True)
         inner_target = isinstance(out, InsertIntoStream) and out.is_inner
         if inner_target:
             self.inner_schemas[out.target] = StreamSchema(
@@ -568,10 +562,10 @@ class PartitionRuntime:
 
                 qr.timer_target = fire
 
-    def _check_output_target(self, query: Query) -> None:
+    def _check_output_target(self, query: Query, allow_inner: bool = False) -> None:
         out = query.output_stream
         target = getattr(out, "target", None)
-        if getattr(out, "is_inner", False):
+        if not allow_inner and getattr(out, "is_inner", False):
             raise SiddhiAppCreationError(
                 "#inner outputs from joins/patterns inside partitions are "
                 "not supported yet"
@@ -592,17 +586,17 @@ class PartitionRuntime:
                     "#inner streams on join sides inside partitions are not "
                     "supported yet"
                 )
+            sch = app.stream_schemas.get(s.stream_id)
+            if sch is None:
+                raise SiddhiAppCreationError(
+                    "only plain streams can join inside partitions"
+                )
             kf = self.key_fns.get(s.stream_id)
             if kf is None:
                 raise SiddhiAppCreationError(
                     f"partition has no key for stream '{s.stream_id}'"
                 )
             key_by_side[side] = kf
-            sch = app.stream_schemas.get(s.stream_id)
-            if sch is None:
-                raise SiddhiAppCreationError(
-                    "only plain streams can join inside partitions"
-                )
             schemas.append(sch)
         self._check_output_target(query)
         qr = PartitionedJoinQueryRuntime(

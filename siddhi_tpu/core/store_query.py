@@ -43,14 +43,18 @@ class StoreQueryRuntime:
         aggregations: dict | None = None,
     ):
         store = sq.input_store
-        if store is None:
+        self.no_from = store is None
+        if self.no_from and sq.output_stream is None:
             raise SiddhiAppCreationError(
-                "store queries without a 'from <store>' clause are not supported"
+                "a store query needs a 'from <store>' clause or an "
+                "insert/update/delete output"
             )
         windows = windows or {}
         aggregations = aggregations or {}
 
-        self.aggregation = aggregations.get(store.store_id)
+        self.aggregation = (
+            aggregations.get(store.store_id) if store is not None else None
+        )
         self.is_agg = self.aggregation is not None
         self.per = None
         self.within = None
@@ -80,6 +84,11 @@ class StoreQueryRuntime:
                     )
             source_schema = self.aggregation.out_schema
             table = self.aggregation
+        elif self.no_from:
+            # `select <constants> insert into T;` — one synthetic row
+            # (reference: InsertStoreQueryRuntime)
+            table = None
+            source_schema = StreamSchema("__const__", [])
         else:
             table = tables.get(store.store_id) or windows.get(store.store_id)
             if table is None:
@@ -93,9 +102,9 @@ class StoreQueryRuntime:
                 )
             source_schema = table.schema
         self.table = table  # findable source: table, window, or aggregation
-        self.is_window = store.store_id in windows
+        self.is_window = store is not None and store.store_id in windows
         self.tables = dict(tables)
-        self.ref = store.alias or store.store_id
+        self.ref = (store.alias or store.store_id) if store is not None else "__const__"
 
         scope = Scope(interner)
         scope.add_stream(self.ref, source_schema.attr_types)
@@ -104,7 +113,7 @@ class StoreQueryRuntime:
             scope.add_table(t)
 
         self.on = None
-        if store.on is not None:
+        if store is not None and store.on is not None:
             self.on = compile_expression(store.on, scope)
             if self.on.type is not AttrType.BOOL:
                 raise SiddhiAppCreationError("'on' must be a boolean expression")
@@ -135,6 +144,13 @@ class StoreQueryRuntime:
     def _step_impl(self, tstates, now, agg_batch: EventBatch | None = None):
         if agg_batch is not None:
             batch = agg_batch
+        elif self.no_from:
+            batch = EventBatch(
+                ts=jnp.full((1,), now, jnp.int64),
+                kind=jnp.zeros((1,), jnp.int8),
+                valid=jnp.ones((1,), jnp.bool_),
+                cols={},
+            )
         else:
             st = tstates[self.table.table_id]
             if self.is_window:

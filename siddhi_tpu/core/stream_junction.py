@@ -39,6 +39,9 @@ class StreamJunction:
         # (reference allows self-feeding junctions); recursion stays on-thread
         self.lock = threading.RLock()
         self.on_publish_stats: Callable[[int], None] | None = None
+        # user hook for subscriber failures (reference: the pluggable
+        # Disruptor ExceptionHandler, SiddhiAppRuntime.java:664)
+        self.exception_handler: Callable[[Exception], None] | None = None
 
     def subscribe(self, fn: Subscriber) -> None:
         self.subscribers.append(fn)
@@ -222,13 +225,25 @@ class StreamJunction:
             if self.on_publish_stats is not None:
                 self.on_publish_stats(int(np.asarray(batch.valid).sum()))
             for fn in self.subscribers:
-                fn(batch, now)
+                if self.exception_handler is None:
+                    fn(batch, now)
+                else:
+                    try:
+                        fn(batch, now)
+                    except Exception as e:  # user-owned failure policy
+                        self.exception_handler(e)
             if self.stream_callbacks:
                 events = self.schema.from_batch(batch, self.interner)
                 if events:
                     rows = [(ts, data) for ts, kind, data in events]
                     for cb in self.stream_callbacks:
-                        cb(rows)
+                        if self.exception_handler is None:
+                            cb(rows)
+                        else:
+                            try:
+                                cb(rows)
+                            except Exception as e:
+                                self.exception_handler(e)
 
     is_async = False
 

@@ -112,6 +112,8 @@ class InMemoryTable:
                 self.state = self.insert(self.state, batch, aux)
         self._dirty = False
         self._last_flush = 0.0
+        self._flush_lock = threading.Lock()
+        self._flush_timer = None
 
     def notify_change(self) -> None:
         """Mark dirty; snapshots coalesce to at most one per second (the
@@ -122,34 +124,51 @@ class InMemoryTable:
         import threading as _threading
         import time as _time
 
-        self._dirty = True
-        now = _time.monotonic()
-        if now - self._last_flush >= 1.0:
+        with self._flush_lock:
+            self._dirty = True
+            due = _time.monotonic() - self._last_flush >= 1.0
+            arm = not due and self._flush_timer is None
+            if arm:
+                # coalesced: schedule a deferred flush so a final mutation in
+                # a quiet period still reaches the store without a clean
+                # shutdown
+                t = _threading.Timer(1.0, self._deferred_flush)
+                t.daemon = True
+                self._flush_timer = t
+                t.start()
+        if due:
             self.flush_record_store()
-        elif getattr(self, "_flush_timer", None) is None:
-            # coalesced: schedule a deferred flush so a final mutation in a
-            # quiet period still reaches the store without a clean shutdown
-            t = _threading.Timer(1.0, self._deferred_flush)
-            t.daemon = True
-            self._flush_timer = t
-            t.start()
 
     def _deferred_flush(self) -> None:
-        self._flush_timer = None
+        with self._flush_lock:
+            self._flush_timer = None
         self.flush_record_store()
 
     def flush_record_store(self) -> None:
-        if self.record_store is None or not self._dirty:
-            return
         import time as _time
 
-        timer = getattr(self, "_flush_timer", None)
-        if timer is not None:
-            timer.cancel()
-            self._flush_timer = None
-        self.record_store.on_change(self.rows())
-        self._dirty = False
-        self._last_flush = _time.monotonic()
+        with self._flush_lock:
+            store = self.record_store
+            if store is None or not self._dirty:
+                return
+            if self._flush_timer is not None:
+                self._flush_timer.cancel()
+                self._flush_timer = None
+            rows = self.rows()
+            store.on_change(rows)
+            self._dirty = False
+            self._last_flush = _time.monotonic()
+
+    def close_record_store(self) -> None:
+        """Final flush + disconnect; later flush attempts become no-ops."""
+        self.flush_record_store()
+        with self._flush_lock:
+            store, self.record_store = self.record_store, None
+            if self._flush_timer is not None:
+                self._flush_timer.cancel()
+                self._flush_timer = None
+        if store is not None:
+            store.disconnect()
 
     # ---- state ------------------------------------------------------------
 

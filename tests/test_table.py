@@ -300,3 +300,26 @@ class TestRecordStore:
         rt2.shutdown()
         mgr2.shutdown()
         InMemoryRecordStore.clear_all()
+
+
+class TestStoreQueryInsert:
+    def test_constant_insert(self):
+        mgr, rt = build(BASE)
+        rt.query("select 'WSO2' as symbol, 55.5f as price, 100L as volume "
+                 "insert into StockTable")
+        rows = rt.query("from StockTable select *")
+        assert [e.data for e in rows] == [("WSO2", 55.5, 100)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_copy_between_tables(self):
+        mgr, rt = build(BASE + """
+        define table Backup (symbol string, price float, volume long);
+        from StockStream insert into StockTable;
+        """)
+        rt.get_input_handler("StockStream").send(("IBM", 75.5, 10), timestamp=1)
+        rt.query("from StockTable select symbol, price, volume insert into Backup")
+        rows = rt.query("from Backup select *")
+        assert [e.data for e in rows] == [("IBM", 75.5, 10)]
+        rt.shutdown()
+        mgr.shutdown()
