@@ -194,7 +194,7 @@ def _p99_detect_latency_ms(data, batch=256, batches=60):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--events", type=int, default=1_000_000)
+    ap.add_argument("--events", type=int, default=500_000)
     ap.add_argument("--batch", type=int, default=32768)
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
