@@ -422,6 +422,69 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
         ):
             if src.type == target:
                 return src
+            if target is AttrType.STRING and src.type in NUMERIC_TYPES:
+                # numeric -> string: host callback formats + interns the
+                # distinct values per batch (reference:
+                # ConvertFunctionExecutor string conversion)
+                from siddhi_tpu.utils.backend import host_callbacks_supported
+
+                if not host_callbacks_supported():
+                    raise NotImplementedError(
+                        f"{name} to 'string' needs host-callback support, "
+                        "which this backend does not provide"
+                    )
+                interner = scope.interner
+                valid_key = (scope.default_ref, None, VALID_ATTR)
+                is_int = src.type in (AttrType.INT, AttrType.LONG)
+                src_null = _is_null_fn(src)
+
+                def fn(env: Env, _src=src) -> jnp.ndarray:
+                    v = _src(env)
+                    try:
+                        valid = jnp.broadcast_to(env.read(valid_key), jnp.shape(v))
+                    except KeyError:
+                        valid = jnp.ones(jnp.shape(v), dtype=jnp.bool_)
+                    # null inputs convert to null, not to a sentinel's digits
+                    # (reference: ConvertFunctionExecutor null propagation)
+                    valid = valid & ~src_null(env)
+
+                    def fmt(vals, mask):
+                        import numpy as _np
+
+                        flat = _np.asarray(vals).reshape(-1)
+                        m = _np.asarray(mask).reshape(-1)
+                        out = _np.zeros(flat.shape, dtype=_np.int32)
+                        uniq = _np.unique(flat[m])
+                        if is_int:
+                            strings = [str(int(u)) for u in uniq.tolist()]
+                        else:
+                            # shortest round-trip form of the DEVICE precision
+                            # (f32): widening through float64 repr would print
+                            # garbage digits
+                            strings = [
+                                _np.format_float_positional(
+                                    u, unique=True, trim="0"
+                                )
+                                for u in uniq
+                            ]
+                        id_arr = _np.array(
+                            [interner.intern(s) for s in strings], dtype=_np.int32
+                        )
+                        if uniq.size:
+                            idx = _np.searchsorted(uniq, flat[m])
+                            out[m] = id_arr[idx]
+                        return out.reshape(_np.shape(vals))
+
+                    import jax
+                    from jax.experimental import io_callback
+
+                    return io_callback(
+                        fmt,
+                        jax.ShapeDtypeStruct(jnp.shape(v), jnp.int32),
+                        v, valid,
+                    )
+
+                return CompiledExpr(AttrType.STRING, fn)
             raise NotImplementedError(
                 f"{name} between {src.type!r} and {target!r} requires host egress"
             )

@@ -156,3 +156,16 @@ class TestAggregatorsCorpus:
         got = run(ql, [("A", 5.0, 1, 1), ("A", 2.0, 1, 1), ("A", 9.0, 1, 1)])
         # window holds {5},{5,2},{2,9}: the min recovers after 5 expires
         assert [g[0] for g in got] == [5.0, 2.0, 2.0]
+
+
+class TestStringConversion:
+    def test_convert_numeric_to_string(self):
+        from siddhi_tpu.utils.backend import host_callbacks_supported
+
+        if not host_callbacks_supported():
+            pytest.skip("backend lacks host callbacks")
+        ql = """define stream S (v long, f double);
+        @info(name='q')
+        from S select convert(v, 'string') as sv, convert(f, 'string') as sf
+        insert into Out;"""
+        assert run(ql, [(42, 2.5)]) == [("42", "2.5")]
