@@ -99,6 +99,10 @@ class Scope:
     def __init__(self, interner: InternTable, default_ref: str | None = None):
         self.interner = interner
         self.default_ref = default_ref
+        # every VarKey any expression compiled against this scope (or a child)
+        # resolved — env builders consult this to materialize indexed-capture
+        # columns (e1[3], e2[last]) including out-of-range/-negative indices
+        self.used_keys: set[VarKey] = set()
         # pattern-node filters resolve unqualified attrs to the CURRENT event's
         # stream even when earlier state refs carry the same attribute
         # (reference: MatchingMetaInfoHolder default stream-event index)
@@ -138,7 +142,27 @@ class Scope:
     def refs(self) -> list[str]:
         return list(self._streams)
 
+    def record_key(self, key: VarKey) -> None:
+        # record at every level so a compile site can read exactly the keys
+        # ITS expressions resolved from its own child scope, while the root
+        # accumulates the full set for env builders
+        scope: Scope | None = self
+        while scope is not None:
+            scope.used_keys.add(key)
+            scope = scope._parent
+
+    def root_used_keys(self) -> set[VarKey]:
+        scope: Scope = self
+        while scope._parent is not None:
+            scope = scope._parent
+        return scope.used_keys
+
     def resolve(self, var: Variable) -> tuple[VarKey, AttrType]:
+        key, t = self._resolve(var)
+        self.record_key(key)
+        return key, t
+
+    def _resolve(self, var: Variable) -> tuple[VarKey, AttrType]:
         if var.stream_id is not None:
             scope: Scope | None = self
             while scope is not None:
@@ -315,6 +339,7 @@ def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
         # stream-null form (`S1 is null` in patterns): the pattern engine
         # provides a per-state arrival flag column.
         key = (expr.stream_id, expr.stream_index, "__arrived__")
+        scope.record_key(key)
         return CompiledExpr(AttrType.BOOL, lambda env, k=key: ~env.read(k))
 
     if isinstance(expr, In):

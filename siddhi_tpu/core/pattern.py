@@ -15,13 +15,27 @@ scatter. `every` is modelled as *persistent* slots whose tokens fork into free
 rows instead of being consumed (reference semantics: `every` re-arms via
 nextEveryStatePreProcessor, StreamPostStateProcessor.java:100-120).
 
+Count states `<m:n>` follow the reference's shared-token model exactly
+(CountPatternTestCase 1-15 are golden tests): one token is simultaneously
+absorbing at the count slot and pending at the next slot once min is reached
+(`_eligible` count-skip), the next state is checked before absorption for the
+same event (descending slot order, matching
+PatternMultiProcessStreamReceiver's reversed eventSequence), a trailing count
+emits at exactly min and is consumed, and min-0 counts forward/emit at arrival.
+
 Deliberate deviations from the reference interpreter (documented, test-covered):
 - token/capture capacity is static (`@app:patternCapacity`, `@app:countCapacity`)
   with overflow surfaced via aux flags, where the reference grows lists unboundedly;
-- count states `<m:n>` are greedy without forking: a token that has absorbed >= min
-  occurrences is eligible for the next slot while still absorbing, but one event
-  commits to exactly one alternative (later slot preferred), where the reference
-  explores both;
+- `every` over a count state arms a fresh virgin token when a token's count
+  reaches min. The reference's addEveryState clone at that point shares its
+  capture chains with the parent (StateEventCloner.copyStateEvent is shallow)
+  and is never re-forwarded — a structural dead end no reference test covers —
+  so the clean generation-chain semantics is used instead;
+- emission order among tokens completing on the SAME event is lane order, not
+  pending-list age order;
+- unbounded counts `<m:>` absorb past the capture capacity on the scan path
+  (occurrence counter keeps counting, writes drop) but cap at the capture
+  capacity in the batch count kernel;
 - absent states with a waiting time are supported standalone (`A -> not B for 5
   sec`); inside logical elements only the kill/`and`-completion semantics are
   implemented.
@@ -69,6 +83,10 @@ NO_TIMER = jnp.asarray(np.iinfo(np.int64).max, dtype=jnp.int64)
 
 DEFAULT_TOKEN_CAPACITY = 128
 DEFAULT_COUNT_CAPACITY = 8
+
+# Test hook: force every pattern onto the per-event scan path (the batch
+# kernels' differential oracle). Read at step-build time.
+FORCE_SCAN = False
 
 
 def _min_within(slot_ms, global_ms):
@@ -246,11 +264,16 @@ class PatternProgram:
         self.scope.default_ref = self.refs[0].ref
 
         # compiled per-atom condition: AND of the atom's filters, evaluated over
-        # the token table with the current event broadcast as the atom's own ref
+        # the token table with the current event broadcast as the atom's own ref.
+        # _cond_keys records which VarKeys each slot's conditions read — the
+        # count fast path gates on conditions being row-only (no token-table
+        # dependence) for slots 0 and 1.
         self._conds = {}
+        self._cond_keys: dict[tuple, set] = {}
         for slot in self.slots:
             for atom in slot.atoms:
                 conds = []
+                keys: set = set()
                 for f in atom.filters:
                     s = self.scope.child()
                     s.default_ref = atom.ref
@@ -259,7 +282,9 @@ class PatternProgram:
                     if c.type is not AttrType.BOOL:
                         raise SiddhiAppCreationError("pattern filter must be boolean")
                     conds.append(c)
+                    keys |= s.used_keys
                 self._conds[(slot.index, atom.ref_idx)] = conds
+                self._cond_keys[(slot.index, atom.ref_idx)] = keys
 
         self.stream_ids = sorted({a.stream_id for a in self.refs})
         self.needs_scheduler = any(
@@ -298,6 +323,44 @@ class PatternProgram:
 
     # ---- environments ----------------------------------------------------
 
+    def _synth_capture_cols(self, cols, col_of, ts_of, n_of, expand=None):
+        """Synthesize columns for used capture keys outside the stored range:
+        e1[k] with k >= cap reads null, e1[last]/e1[last-i] gather by the live
+        occurrence count (reference: StateEvent.getStreamEvent(position) walks
+        the chain and returns null past the end; `last` indexes the tail).
+
+        col_of(a, attr) -> [N, cap], ts_of(a) -> [N, cap], n_of(a) -> [N].
+        """
+        by_ref = {a.ref: a for a in self.refs}
+        for key in self.scope.root_used_keys():
+            ref, k, attr = key
+            a = by_ref.get(ref)
+            if a is None or k is None or key in cols:
+                continue
+            n = n_of(a)
+            if attr == "__arrived__":
+                col = (n > k) if k >= 0 else (n >= -k)
+            else:
+                if attr == TS_ATTR:
+                    arr = ts_of(a)
+                    nv = jnp.asarray(null_value(AttrType.LONG), dtype=arr.dtype)
+                else:
+                    t = self.schemas[a.stream_id].attr_types.get(attr)
+                    if t is None:
+                        continue
+                    arr = col_of(a, attr)
+                    nv = jnp.asarray(null_value(t), dtype=arr.dtype)
+                if k >= a.cap:
+                    col = jnp.full(arr.shape[:1], nv, dtype=arr.dtype)
+                elif k >= 0:
+                    col = arr[:, k]
+                else:
+                    idx = n + k  # last == -1 -> n-1, last-i -> n-1-i
+                    col = jnp.full(arr.shape[:1], nv, dtype=arr.dtype)
+                    for i in range(a.cap):
+                        col = jnp.where(idx == i, arr[:, i], col)
+            cols[key] = expand(col) if expand else col
+
     def _token_env(self, tok, now, override_ref: Optional[int] = None,
                    event_cols: Optional[dict] = None, event_ts=None) -> Env:
         """Column view of the token table; `override_ref` substitutes the
@@ -314,6 +377,12 @@ class PatternProgram:
             for k in range(a.cap):
                 cols[(a.ref, k, TS_ATTR)] = c["ts"][:, k]
             cols[(a.ref, None, "__arrived__")] = c["n"] > 0
+        self._synth_capture_cols(
+            cols,
+            lambda a, attr: tok["caps"][a.ref_idx]["cols"][attr],
+            lambda a: tok["caps"][a.ref_idx]["ts"],
+            lambda a: tok["caps"][a.ref_idx]["n"],
+        )
         if override_ref is not None:
             a = self.refs[override_ref]
             for name, v in event_cols.items():
@@ -470,7 +539,19 @@ class PatternProgram:
                         complete = match & allv
                     advance = complete
                 elif slot.is_count:
-                    advance = jnp.zeros_like(match)  # absorb in place
+                    # absorb in place; a trailing count emits (and dies) at
+                    # exactly min occurrences (reference:
+                    # CountPostStateProcessor.process -> processMinCountReached
+                    # when streamEvents == minCount, stateChanged consumes)
+                    n_after = new_caps[atom.ref_idx]["n"]
+                    if slot.min_count >= 1:
+                        count_armed = match & (n_after == slot.min_count)
+                    else:
+                        count_armed = jnp.zeros_like(match)
+                    if p == last and slot.min_count >= 1:
+                        advance = count_armed
+                    else:
+                        advance = jnp.zeros_like(match)
                 else:
                     advance = match
 
@@ -480,9 +561,11 @@ class PatternProgram:
                         out, out_n, overflow, advance, adv_tok, ts
                     )
                     new_tok = self._merge(tok, adv_tok, stay)
-                    new_tok = self._consume(new_tok, advance, slot)
+                    new_tok = self._consume(
+                        new_tok, advance, slot, force=slot.is_count
+                    )
                     tok = new_tok
-                elif slot.persistent:
+                elif slot.persistent and not slot.is_count:
                     # fork: advanced copy goes to a free row; the source
                     # (virgin/generator) stays armed
                     tok, overflow, dest_mask = self._fork(
@@ -490,6 +573,9 @@ class PatternProgram:
                     )
                     tok = self._merge(tok, adv_tok, stay)
                     touched = touched | dest_mask
+                    tok, out, out_n, overflow = self._arrival_effects(
+                        tok, dest_mask, p + 1, ts, out, out_n, overflow
+                    )
                 else:
                     moved = self._merge(tok, adv_tok, match)
                     moved = {
@@ -498,7 +584,25 @@ class PatternProgram:
                         "entry_ts": jnp.where(advance, ts, moved["entry_ts"]),
                     }
                     tok = moved
+                    tok, out, out_n, overflow = self._arrival_effects(
+                        tok, advance, p + 1, ts, out, out_n, overflow
+                    )
                 touched = touched | match
+
+                if (
+                    slot.persistent and slot.is_count
+                    and slot.min_count >= 1 and not self.sequence
+                ):
+                    # (sequences never call processMinCountReached — the token
+                    # is shared via the SEQUENCE re-add branch instead)
+                    # `every` over a count: a fresh virgin is armed exactly
+                    # when a token's occurrence count reaches min (reference:
+                    # CountPostStateProcessor.processMinCountReached ->
+                    # nextEveryStatePreProcessor.addEveryState; the reference's
+                    # shallow clone is replaced by a clean virgin — PARITY.md)
+                    tok, overflow = self._arm_virgins(
+                        tok, count_armed, p, ts, overflow
+                    )
 
         # ---- sequence strictness: any unconsumed CURRENT event kills
         # non-virgin, non-generator tokens (reference: sequence
@@ -541,12 +645,63 @@ class PatternProgram:
             "caps": caps,
         }
 
-    def _consume(self, tok, mask, slot: Slot):
+    def _consume(self, tok, mask, slot: Slot, force: bool = False):
         """Tokens that emitted: die, unless at a persistent slot (the `every`
-        generator stays armed)."""
-        if slot.persistent:
+        generator stays armed). Trailing count slots force-consume: their
+        re-arm is the virgin forked at min, not the emitting token."""
+        if slot.persistent and not force:
             return tok
         return {**tok, "active": tok["active"] & ~mask}
+
+    def _arrival_effects(self, tok, arrived, q: int, ts, out, out_n, overflow):
+        """Effects of tokens arriving AT slot q: a trailing min-0 count emits
+        immediately with empty captures and is consumed (reference:
+        CountPreStateProcessor.addState minCount==0 ->
+        processMinCountReached at add time)."""
+        if q >= len(self.slots):
+            return tok, out, out_n, overflow
+        nxt = self.slots[q]
+        if not (nxt.is_count and nxt.min_count == 0 and q == len(self.slots) - 1):
+            return tok, out, out_n, overflow
+        out, out_n, overflow = self._write_emits(
+            out, out_n, overflow, arrived, tok, ts
+        )
+        return (
+            {**tok, "active": tok["active"] & ~arrived},
+            out, out_n, overflow,
+        )
+
+    def _arm_virgins(self, tok, mask, p: int, ts, overflow):
+        """Scatter fresh virgin tokens (slot p, no captures) into free rows."""
+        T = self.T
+        dest, overflow = self._alloc_lanes(tok, mask, overflow)
+        caps = []
+        for a in self.refs:
+            c = tok["caps"][a.ref_idx]
+            schema = self.schemas[a.stream_id]
+            cols = {
+                name: arr.at[dest].set(
+                    jnp.asarray(null_value(schema.attr_types[name]), arr.dtype),
+                    mode="drop",
+                )
+                for name, arr in c["cols"].items()
+            }
+            caps.append(
+                {
+                    "n": c["n"].at[dest].set(0, mode="drop"),
+                    "ts": c["ts"].at[dest].set(jnp.int64(0), mode="drop"),
+                    "cols": cols,
+                }
+            )
+        return {
+            "active": tok["active"].at[dest].set(True, mode="drop"),
+            "slot": tok["slot"].at[dest].set(p, mode="drop"),
+            "start_ts": tok["start_ts"].at[dest].set(jnp.int64(-1), mode="drop"),
+            "entry_ts": tok["entry_ts"].at[dest].set(
+                jnp.broadcast_to(ts, (T,)).astype(jnp.int64), mode="drop"
+            ),
+            "caps": caps,
+        }, overflow
 
     def _advance_rows(self, tok, mask, slot: Slot, ts):
         p = slot.index
@@ -556,10 +711,9 @@ class PatternProgram:
             "entry_ts": jnp.where(mask, ts, tok["entry_ts"]),
         }
 
-    def _fork(self, tok, adv_tok, mask, next_slot: int, ts, overflow):
-        """Scatter advanced copies of `mask` rows into free rows
-        (reference: every re-arm keeps the pre-state armed while the matched
-        StateEvent moves on)."""
+    def _alloc_lanes(self, tok, mask, overflow):
+        """Allocate one free token lane per set row of `mask`; rows that don't
+        fit scatter to index T (dropped by mode='drop') and raise overflow."""
         T = self.T
         free = ~tok["active"]
         order = jnp.argsort(~free)  # free row indices first (stable)
@@ -567,7 +721,14 @@ class PatternProgram:
         rank = jnp.cumsum(mask) - 1
         ok = mask & (rank < nfree)
         dest = jnp.where(ok, order[jnp.clip(rank, 0, T - 1)], T)
-        overflow = overflow | jnp.any(mask & ~ok)
+        return dest, overflow | jnp.any(mask & ~ok)
+
+    def _fork(self, tok, adv_tok, mask, next_slot: int, ts, overflow):
+        """Scatter advanced copies of `mask` rows into free rows
+        (reference: every re-arm keeps the pre-state armed while the matched
+        StateEvent moves on)."""
+        T = self.T
+        dest, overflow = self._alloc_lanes(tok, mask, overflow)
 
         def scat(lane, adv_lane, fill=None):
             return lane.at[dest].set(adv_lane, mode="drop")
@@ -624,6 +785,345 @@ class PatternProgram:
             return False
         return True
 
+    @property
+    def count_fast_ok(self) -> bool:
+        """Closed-form count kernel applies to: PATTERN type, slot 0 a count
+        state (min >= 1, optionally `every`), simple single-atom tail slots,
+        no within bounds, and row-only conditions for slots 0 and 1 (slot-1
+        matching is folded into slot-0's closed form, so neither may read the
+        token table). The key insight making this O(1) device passes instead
+        of a per-event scan: all absorbing tokens absorb every matching event
+        (reference: CountPreStateProcessor.processAndReturn iterates every
+        pending state), so capture sets are pure rank arithmetic over the
+        batch's match sequence."""
+        if self.sequence or len(self.slots) < 2 or self.within_ms is not None:
+            return False
+        s0 = self.slots[0]
+        if not s0.is_count or s0.min_count < 1 or s0.is_absent or s0.logical:
+            return False
+        for s in self.slots:
+            if s.within_ms is not None:
+                return False
+        for s in self.slots[1:]:
+            if (
+                len(s.atoms) != 1 or s.is_count or s.is_absent
+                or s.logical or s.persistent or s.atoms[0].cap != 1
+            ):
+                return False
+        for p in (0, 1):
+            ref = self.slots[p].atoms[0].ref
+            keys = self._cond_keys[(p, self.slots[p].atoms[0].ref_idx)]
+            if any(k[0] != ref or k[1] is not None for k in keys):
+                return False
+        return True
+
+    def _row_env(self, ev: dict, batch_ts, now, atom: Atom) -> Env:
+        """[B]-shaped env exposing only the current event as the atom's ref."""
+        cols = {(atom.ref, None, name): v for name, v in ev.items()}
+        cols[(atom.ref, None, TS_ATTR)] = batch_ts
+        cols[(atom.ref, None, "__arrived__")] = jnp.ones(
+            batch_ts.shape, dtype=jnp.bool_
+        )
+        return Env(cols, now=now)
+
+    def apply_batch_count(
+        self, tok, batch_ts, batch_kind, batch_valid, stream_cols: dict,
+        out, out_n, overflow, now,
+    ):
+        """Whole-batch count-pattern kernel (see count_fast_ok).
+
+        Per chunk: enumerate slot-0's condition matches as a rank sequence
+        (midx), derive every token's absorption span and slot-1 advance row in
+        closed form, materialize the `every` generation chain armed at each
+        min-count crossing, then run the remaining simple slots with the
+        ordinary [T, B] token-matrix passes.
+        """
+        T = self.T
+        B = batch_ts.shape[0]
+        S = len(self.slots)
+        slot0, slot1 = self.slots[0], self.slots[1]
+        atom0, atom1 = slot0.atoms[0], slot1.atoms[0]
+        K = atom0.cap
+        m = slot0.min_count
+        # occurrence COUNTING runs to the true max (unbounded -> huge), while
+        # capture WRITES stop at the capture capacity K — matching the scan
+        # path, whose n keeps counting as writes drop (module docstring)
+        M = slot0.max_count if slot0.max_count > 0 else (1 << 30)
+
+        rows = jnp.arange(B, dtype=jnp.int32)
+        toks = jnp.arange(T, dtype=jnp.int32)
+        qpos = jnp.arange(K, dtype=jnp.int32)
+        v = batch_valid & (batch_kind == KIND_CURRENT)
+        at0 = tok["active"] & (tok["slot"] == 0)
+        n0 = tok["caps"][atom0.ref_idx]["n"]
+
+        # ---- slot-0 match sequence over the batch ----
+        ev0 = stream_cols.get(atom0.stream_id)
+        if ev0 is not None:
+            env0 = self._row_env(ev0, batch_ts, now, atom0)
+            Mc = v
+            for c in self._conds[(0, atom0.ref_idx)]:
+                Mc = Mc & jnp.broadcast_to(c(env0), (B,))
+        else:
+            Mc = jnp.zeros((B,), dtype=jnp.bool_)
+        midx_excl = jnp.cumsum(Mc.astype(jnp.int32)) - Mc.astype(jnp.int32)
+        k_total = midx_excl[-1] + Mc[-1].astype(jnp.int32)
+        mrow = jnp.nonzero(Mc, size=B, fill_value=B)[0].astype(jnp.int32)
+        mrow_c = jnp.clip(mrow, 0, B - 1)
+        mts = batch_ts[mrow_c]
+
+        # ---- slot-1 advance row per row (row-only by gate) ----
+        ev1 = stream_cols.get(atom1.stream_id)
+        if ev1 is not None:
+            env1 = self._row_env(ev1, batch_ts, now, atom1)
+            Madv = v
+            for c in self._conds[(1, atom1.ref_idx)]:
+                Madv = Madv & jnp.broadcast_to(c(env1), (B,))
+        else:
+            Madv = jnp.zeros((B,), dtype=jnp.bool_)
+
+        # cnt_nostop[t, b]: occurrences the token would hold entering row b
+        # (midx_excl: the reference forwards at min via newAndEvery, pending
+        # only from the NEXT event, and checks the next state first — so the
+        # row that reaches min is itself not advance-eligible)
+        room = (M - jnp.clip(n0, 0, M)).astype(jnp.int32)
+        cnt_nostop = n0[:, None] + jnp.minimum(
+            jnp.maximum(midx_excl[None, :], 0), room[:, None]
+        )
+        adv_ok = at0[:, None] & Madv[None, :] & (cnt_nostop >= m)
+        has_adv = adv_ok.any(axis=1)
+        j = jnp.argmax(adv_ok, axis=1).astype(jnp.int32)
+        jc = jnp.clip(j, 0, B - 1)
+
+        # absorption span: stops at the advance row (reference:
+        # removeIfNextStateProcessed drops the token from the count pending
+        # once the next state captured)
+        A = jnp.clip(jnp.where(has_adv, midx_excl[jc], k_total), 0, room)
+        A = jnp.where(at0, A, 0)
+
+        # ---- capture writes for existing slot-0 tokens ----
+        caps = [dict(c) for c in tok["caps"]]
+        src = qpos[None, :] - n0[:, None]
+        wmask = at0[:, None] & (src >= 0) & (src < A[:, None])
+        srcc = jnp.clip(src, 0, B - 1)
+        cr = dict(caps[atom0.ref_idx])
+        cr["n"] = jnp.where(at0, n0 + A, n0).astype(cr["n"].dtype)
+        cr["ts"] = jnp.where(wmask, mts[srcc], cr["ts"])
+        if ev0 is not None:
+            cr["cols"] = {
+                name: jnp.where(wmask, ev0[name][mrow_c].astype(arr.dtype)[srcc], arr)
+                for name, arr in cr["cols"].items()
+            }
+        caps[atom0.ref_idx] = cr
+        start_ts = jnp.where(
+            at0 & (tok["start_ts"] < 0) & (A > 0), mts[0], tok["start_ts"]
+        )
+
+        # ---- slot-1 capture + transition for advancing tokens ----
+        advD = at0 & has_adv
+        if ev1 is not None:
+            c1 = dict(caps[atom1.ref_idx])
+            c1["n"] = jnp.where(advD, 1, c1["n"]).astype(c1["n"].dtype)
+            c1["ts"] = jnp.where(
+                advD[:, None], c1["ts"].at[toks, 0].set(batch_ts[jc]), c1["ts"]
+            )
+            c1["cols"] = {
+                name: jnp.where(
+                    advD[:, None],
+                    arr.at[toks, 0].set(ev1[name][jc].astype(arr.dtype)),
+                    arr,
+                )
+                for name, arr in c1["cols"].items()
+            }
+            caps[atom1.ref_idx] = c1
+        entry_row = jnp.where(advD, j, -1)
+        tok = {
+            "active": tok["active"],
+            "slot": jnp.where(advD, 2, tok["slot"]),
+            "start_ts": start_ts,
+            "entry_ts": jnp.where(advD, batch_ts[jc], tok["entry_ts"]),
+            "caps": caps,
+        }
+
+        # ---- `every` generation chain (armed at each min crossing) ----
+        if slot0.persistent:
+            tail = at0 & (n0 < m)
+            tail_exists = tail.any()
+            ny = jnp.min(jnp.where(tail, n0, m)).astype(jnp.int32)
+            Gmax = B // max(m, 1) + 1
+            g = jnp.arange(Gmax, dtype=jnp.int32)
+            s_g = (m - ny) + g * m
+            valid_g = tail_exists & (s_g <= k_total)
+            cnt_g = jnp.clip(midx_excl[None, :] - s_g[:, None], 0, M)
+            advg_ok = valid_g[:, None] & Madv[None, :] & (cnt_g >= m)
+            has_advg = advg_ok.any(axis=1)
+            jg = jnp.argmax(advg_ok, axis=1).astype(jnp.int32)
+            jgc = jnp.clip(jg, 0, B - 1)
+            Ag = jnp.clip(
+                jnp.where(has_advg, midx_excl[jgc], k_total) - s_g, 0, M
+            )
+            Ag = jnp.where(valid_g, Ag, 0)
+
+            # scatter generations into free lanes
+            free = ~tok["active"]
+            nfree = jnp.sum(free)
+            free_idx = jnp.nonzero(free, size=Gmax, fill_value=-1)[0]
+            grank = (jnp.cumsum(valid_g) - 1).astype(jnp.int32)
+            okg = valid_g & (grank < nfree) & (free_idx[jnp.clip(grank, 0, Gmax - 1)] >= 0)
+            overflow = overflow | jnp.any(valid_g & ~okg)
+            dst = jnp.where(okg, free_idx[jnp.clip(grank, 0, Gmax - 1)], T)
+
+            src_g = s_g[:, None] + qpos[None, :]
+            wm_g = (qpos[None, :] < Ag[:, None])
+            src_gc = jnp.clip(src_g, 0, B - 1)
+            caps = [dict(c) for c in tok["caps"]]
+            cr = dict(caps[atom0.ref_idx])
+            cr["n"] = cr["n"].at[dst].set(Ag, mode="drop")
+            cr["ts"] = cr["ts"].at[dst].set(
+                jnp.where(wm_g, mts[src_gc], jnp.int64(0)), mode="drop"
+            )
+            if ev0 is not None:
+                new_cols = {}
+                for name, arr in cr["cols"].items():
+                    t = self.schemas[atom0.stream_id].attr_types[name]
+                    nv = jnp.asarray(null_value(t), dtype=arr.dtype)
+                    genv = jnp.where(wm_g, ev0[name][mrow_c][src_gc].astype(arr.dtype), nv)
+                    new_cols[name] = arr.at[dst].set(genv, mode="drop")
+                cr["cols"] = new_cols
+            caps[atom0.ref_idx] = cr
+            if ev1 is not None:
+                c1 = dict(caps[atom1.ref_idx])
+                c1["n"] = c1["n"].at[dst].set(
+                    has_advg.astype(c1["n"].dtype), mode="drop"
+                )
+                c1["ts"] = c1["ts"].at[dst, 0].set(
+                    jnp.where(has_advg, batch_ts[jgc], jnp.int64(0)), mode="drop"
+                )
+                new_cols = {}
+                for name, arr in c1["cols"].items():
+                    t = self.schemas[atom1.stream_id].attr_types[name]
+                    nv = jnp.asarray(null_value(t), dtype=arr.dtype)
+                    gv = jnp.where(has_advg, ev1[name][jgc].astype(arr.dtype), nv)
+                    new_cols[name] = arr.at[dst, 0].set(gv, mode="drop")
+                c1["cols"] = new_cols
+                caps[atom1.ref_idx] = c1
+            # untouched refs: clear stale lane contents
+            written = {atom0.ref_idx} | (
+                {atom1.ref_idx} if ev1 is not None else set()
+            )
+            for ridx, a in enumerate(self.refs):
+                if ridx in written:
+                    continue
+                c = dict(caps[ridx])
+                c["n"] = c["n"].at[dst].set(0, mode="drop")
+                c["ts"] = c["ts"].at[dst].set(jnp.int64(0), mode="drop")
+                c["cols"] = {
+                    name: arr.at[dst].set(
+                        jnp.asarray(
+                            null_value(self.schemas[a.stream_id].attr_types[name]),
+                            arr.dtype,
+                        ),
+                        mode="drop",
+                    )
+                    for name, arr in c["cols"].items()
+                }
+                caps[ridx] = c
+            g_start = jnp.where(Ag > 0, mts[jnp.clip(s_g, 0, B - 1)], jnp.int64(-1))
+            tok = {
+                "active": tok["active"].at[dst].set(True, mode="drop"),
+                "slot": tok["slot"].at[dst].set(
+                    jnp.where(has_advg, 2, 0), mode="drop"
+                ),
+                "start_ts": tok["start_ts"].at[dst].set(g_start, mode="drop"),
+                "entry_ts": tok["entry_ts"].at[dst].set(
+                    mts[jnp.clip(s_g - 1, 0, B - 1)], mode="drop"
+                ),
+                "caps": caps,
+            }
+            entry_row = entry_row.at[dst].set(
+                jnp.where(has_advg, jg, -1), mode="drop"
+            )
+
+        # ---- remaining simple slots (ordinary token-matrix passes) ----
+        for p in range(2, S):
+            slot = self.slots[p]
+            atom = slot.atoms[0]
+            if atom.stream_id not in stream_cols:
+                continue
+            ev = stream_cols[atom.stream_id]
+            elig = tok["active"] & (tok["slot"] == p)
+            env = self._matrix_env(tok, ev, batch_ts, now, atom.ref_idx)
+            cond = jnp.ones((T, B), dtype=jnp.bool_)
+            for c in self._conds[(p, atom.ref_idx)]:
+                cond = cond & jnp.broadcast_to(c(env), (T, B))
+            Mm = elig[:, None] & v[None, :] & (rows[None, :] > entry_row[:, None]) & cond
+            has = Mm.any(axis=1)
+            jj = jnp.argmax(Mm, axis=1).astype(jnp.int32)
+            jjc = jnp.clip(jj, 0, B - 1)
+            caps = [dict(c) for c in tok["caps"]]
+            crp = dict(caps[atom.ref_idx])
+            crp["n"] = jnp.where(has, 1, crp["n"]).astype(crp["n"].dtype)
+            crp["ts"] = jnp.where(
+                has[:, None], crp["ts"].at[toks, 0].set(batch_ts[jjc]), crp["ts"]
+            )
+            crp["cols"] = {
+                name: jnp.where(
+                    has[:, None],
+                    arr.at[toks, 0].set(ev[name][jjc].astype(arr.dtype)),
+                    arr,
+                )
+                for name, arr in crp["cols"].items()
+            }
+            caps[atom.ref_idx] = crp
+            tok = {
+                "active": tok["active"],
+                "slot": jnp.where(has, p + 1, tok["slot"]),
+                "start_ts": tok["start_ts"],
+                "entry_ts": jnp.where(has, batch_ts[jjc], tok["entry_ts"]),
+                "caps": caps,
+            }
+            entry_row = jnp.where(has, jj, entry_row)
+
+        # ---- completions (ordered by completion row, then lane) ----
+        done = tok["active"] & (tok["slot"] == S)
+        cap = out["valid"].shape[0]
+        key = jnp.where(
+            done, entry_row.astype(jnp.int64) * T + toks, jnp.int64(1) << 60
+        )
+        order = jnp.argsort(key).astype(jnp.int32)
+        d_sorted = done[order]
+        rank = (jnp.cumsum(d_sorted) - d_sorted).astype(jnp.int32)
+        dest = jnp.where(d_sorted & (out_n + rank < cap), out_n + rank, cap)
+        overflow = overflow | (d_sorted & (out_n + rank >= cap)).any()
+        src_t = order
+        out = dict(out)
+        emit_ts = jnp.where(
+            entry_row[src_t] >= 0,
+            batch_ts[jnp.clip(entry_row[src_t], 0, B - 1)],
+            now,
+        )
+        out["ts"] = out["ts"].at[dest].set(emit_ts, mode="drop")
+        out["valid"] = out["valid"].at[dest].set(True, mode="drop")
+        for a in self.refs:
+            c = tok["caps"][a.ref_idx]
+            out[f"n{a.ref_idx}"] = out[f"n{a.ref_idx}"].at[dest].set(
+                c["n"][src_t], mode="drop"
+            )
+            out[f"ts{a.ref_idx}"] = out[f"ts{a.ref_idx}"].at[dest].set(
+                c["ts"][src_t], mode="drop"
+            )
+            for name in c["cols"]:
+                out[f"c{a.ref_idx}.{name}"] = (
+                    out[f"c{a.ref_idx}.{name}"].at[dest].set(
+                        c["cols"][name][src_t], mode="drop"
+                    )
+                )
+        out_n = jnp.minimum(
+            out_n + done.sum(dtype=jnp.int32), cap
+        ).astype(jnp.int32)
+        tok = {**tok, "active": tok["active"] & ~done}
+        return tok, out, out_n, overflow
+
     def _matrix_env(self, tok, row_cols: dict, row_ts, now, override_ref: int) -> Env:
         """[T, 1] token columns vs [1, B] event columns -> [T, B] broadcasts."""
         T = self.T
@@ -636,6 +1136,13 @@ class PatternProgram:
                 cols[(a.ref, None, name)] = c["cols"][name][:, 0][:, None]
                 cols[(a.ref, 0, name)] = c["cols"][name][:, 0][:, None]
             cols[(a.ref, None, "__arrived__")] = (c["n"] > 0)[:, None]
+        self._synth_capture_cols(
+            cols,
+            lambda a, attr: tok["caps"][a.ref_idx]["cols"][attr],
+            lambda a: tok["caps"][a.ref_idx]["ts"],
+            lambda a: tok["caps"][a.ref_idx]["n"],
+            expand=lambda col: col[:, None],
+        )
         a = self.refs[override_ref]
         for name, v in row_cols.items():
             cols[(a.ref, None, name)] = v[None, :]
@@ -844,6 +1351,12 @@ class PatternProgram:
             for k in range(a.cap):
                 cols[(a.ref, k, TS_ATTR)] = tsr[:, k]
             cols[(a.ref, None, "__arrived__")] = out[f"n{a.ref_idx}"] > 0
+        self._synth_capture_cols(
+            cols,
+            lambda a, attr: out[f"c{a.ref_idx}.{attr}"],
+            lambda a: out[f"ts{a.ref_idx}"],
+            lambda a: out[f"n{a.ref_idx}"],
+        )
         return cols
 
     def next_timer(self, tok) -> jnp.ndarray:

@@ -103,19 +103,33 @@ class PatternQueryRuntime(BaseQueryRuntime):
 
     def _make_step(self, stream_id: Optional[str]):
         prog = self.prog
+        from siddhi_tpu.core import pattern as pattern_mod
 
-        if stream_id is not None and prog.fast_path_ok:
+        kernel = None
+        chunk = None
+        if stream_id is not None and not pattern_mod.FORCE_SCAN:
+            if prog.fast_path_ok:
+                # chunks no larger than half the token table, so a chunk's
+                # fork demand can always be met by lanes freed previously
+                kernel, chunk = prog.apply_batch_fast, max(1, prog.T // 2)
+            elif prog.count_fast_ok:
+                # generation-arming demand per chunk is ~matches/min; a full
+                # token-table chunk keeps that bounded while amortizing the
+                # per-chunk fixed cost over many rows
+                kernel, chunk = prog.apply_batch_count, max(1, prog.T)
+
+        if kernel is not None:
+            ker, C0 = kernel, chunk
+
             def fast_step(state, tstates, batch: EventBatch, now):
                 out0 = prog.init_out(self.out_cap)
                 B = batch.capacity
                 # chunk so completed tokens free their lanes BETWEEN chunks:
                 # per-chunk fork pressure is bounded by the chunk size, which
-                # approximates the scan path's per-event lane recycling
-                # chunks no larger than half the token table, so a chunk's
-                # fork demand can always be met by lanes freed previously;
+                # approximates the scan path's per-event lane recycling;
                 # pad (valid=False) rather than shrink chunks so odd batch
                 # sizes keep the wide vectorized shape
-                C = min(B, max(1, prog.T // 2))
+                C = min(B, C0)
                 pad = (-B) % C
                 if pad:
                     def padded(x, fill=0):
@@ -133,7 +147,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
 
                 def chunk_body(carry, xs):
                     tok, out, out_n, ovf = carry
-                    tok, out, out_n, ovf = prog.apply_batch_fast(
+                    tok, out, out_n, ovf = ker(
                         tok, xs["ts"], xs["kind"], xs["valid"],
                         {stream_id: {n: xs[f"c.{n}"] for n in batch.cols}},
                         out, out_n, ovf, now,

@@ -1,0 +1,305 @@
+"""Golden corpus: count patterns, translated from the reference test data
+(reference: siddhi-core/src/test/java/org/wso2/siddhi/core/query/pattern/
+CountPatternTestCase.java — query strings, input events, and expected outputs
+are the reference's observable contract; the assertions here are data-level
+translations, not code translations)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def run_app(ql, sends, query_name="query1"):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+
+    def cb(ts, ins, removed):
+        for e in ins or []:
+            got.append(tuple(e.data))
+
+    rt.add_callback(query_name, cb)
+    rt.start()
+    handlers = {}
+    for stream, row in sends:
+        h = handlers.setdefault(stream, rt.get_input_handler(stream))
+        h.send(row)
+    rt.shutdown()
+    return got
+
+
+def assert_rows(got, expected):
+    assert len(got) == len(expected), f"got {got}, expected {expected}"
+    for g, e in zip(got, expected):
+        assert len(g) == len(e), f"row {g} vs {e}"
+        for gv, ev in zip(g, e):
+            if ev is None:
+                assert gv is None, f"row {g} vs {e}"
+            elif isinstance(ev, float):
+                assert gv == pytest.approx(ev, rel=1e-6), f"row {g} vs {e}"
+            else:
+                assert gv == ev, f"row {g} vs {e}"
+
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+SE = """
+define stream EventStream (symbol string, price float, volume int);
+"""
+
+Q_2_5 = S12 + """
+@info(name = 'query1')
+from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>20]
+select e1[0].price as price1_0, e1[1].price as price1_1, e1[2].price as price1_2,
+   e1[3].price as price1_3, e2.price as price2
+insert into OutputStream ;
+"""
+
+
+class TestCountPatternGolden:
+    def test_query1(self):
+        # CountPatternTestCase.testQuery1: a non-matching event between count
+        # absorptions does not reset a pattern-type count state
+        got = run_app(Q_2_5, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream1", ("GOOG", 47.6, 100)),
+            ("Stream1", ("GOOG", 13.7, 100)),
+            ("Stream1", ("GOOG", 47.8, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [(25.6, 47.6, 47.8, None, 45.7)])
+
+    def test_query2(self):
+        # testQuery2: the e2 match freezes the captures; later Stream1 events
+        # are not retroactively absorbed, and the token is consumed
+        got = run_app(Q_2_5, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream1", ("GOOG", 47.6, 100)),
+            ("Stream1", ("GOOG", 13.7, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+            ("Stream1", ("GOOG", 47.8, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [(25.6, 47.6, None, None, 45.7)])
+
+    def test_query3(self):
+        # testQuery3: an e2 event before min is reached does not match; the
+        # count keeps absorbing across it
+        got = run_app(Q_2_5, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+            ("Stream1", ("GOOG", 47.8, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [(25.6, 47.8, None, None, 55.7)])
+
+    def test_query4(self):
+        # testQuery4: min not reached -> no output
+        got = run_app(Q_2_5, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+        ])
+        assert_rows(got, [])
+
+    def test_query5(self):
+        # testQuery5: absorption stops at max (5); the sixth matching event
+        # is not captured; emission uses the first five
+        got = run_app(Q_2_5, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream1", ("GOOG", 47.6, 100)),
+            ("Stream1", ("GOOG", 23.7, 100)),
+            ("Stream1", ("GOOG", 24.7, 100)),
+            ("Stream1", ("GOOG", 25.7, 100)),
+            ("Stream1", ("WSO2", 27.6, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+            ("Stream1", ("GOOG", 47.8, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [(25.6, 47.6, 23.7, 24.7, 45.7)])
+
+    def test_query6(self):
+        # testQuery6: next-state condition referencing a count capture
+        # (e2[price > e1[1].price])
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] <2:5> -> e2=Stream2[price>e1[1].price]
+        select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream1", ("GOOG", 47.6, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [(25.6, 47.6, 55.7)])
+
+    def test_query7(self):
+        # testQuery7: min=0 count at the start — the very first e2 event
+        # emits with empty captures
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>20]
+        select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream2", ("IBM", 45.7, 100)),
+        ])
+        assert_rows(got, [(None, None, 45.7)])
+
+    def test_query8(self):
+        # testQuery8: min=0 with a condition on e1[0] — null-tolerant compare
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>e1[0].price]
+        select e1[0].price as price1_0, e1[1].price as price1_1, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream1", ("GOOG", 7.6, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+        ])
+        assert_rows(got, [(25.6, None, 45.7)])
+
+    def test_query9(self):
+        # testQuery9: count in the middle of a single-stream chain
+        ql = SE + """
+        @info(name = 'query1')
+        from e1 = EventStream [price >= 50 and volume > 100] -> e2 = EventStream [price <= 40] <0:5>
+           -> e3 = EventStream [volume <= 70]
+        select e1.symbol as symbol1, e2[0].symbol as symbol2, e3.symbol as symbol3
+        insert into StockQuote;
+        """
+        got = run_app(ql, [
+            ("EventStream", ("IBM", 75.6, 105)),
+            ("EventStream", ("GOOG", 21.0, 81)),
+            ("EventStream", ("WSO2", 176.6, 65)),
+        ])
+        assert_rows(got, [("IBM", "GOOG", "WSO2")])
+
+    def test_query10(self):
+        # testQuery10: <:5> == <0:5>; e2 and e3 both match the second event —
+        # descending state order lets e3 win and e2 stays empty
+        ql = SE + """
+        @info(name = 'query1')
+        from e1 = EventStream [price >= 50 and volume > 100] -> e2 = EventStream [price <= 40] <:5>
+           -> e3 = EventStream [volume <= 70]
+        select e1.symbol as symbol1, e2[0].symbol as symbol2, e3.symbol as symbol3
+        insert into StockQuote;
+        """
+        got = run_app(ql, [
+            ("EventStream", ("IBM", 75.6, 105)),
+            ("EventStream", ("GOOG", 21.0, 61)),
+            ("EventStream", ("WSO2", 21.0, 61)),
+        ])
+        assert_rows(got, [("IBM", None, "GOOG")])
+
+    def test_query11(self):
+        # testQuery11: e2[last] on an empty capture set is null
+        ql = SE + """
+        @info(name = 'query1')
+        from e1 = EventStream [price >= 50 and volume > 100] -> e2 = EventStream [price <= 40] <:5>
+           -> e3 = EventStream [volume <= 70]
+        select e1.symbol as symbol1, e2[last].symbol as symbol2, e3.symbol as symbol3
+        insert into StockQuote;
+        """
+        got = run_app(ql, [
+            ("EventStream", ("IBM", 75.6, 105)),
+            ("EventStream", ("GOOG", 21.0, 61)),
+            ("EventStream", ("WSO2", 21.0, 61)),
+        ])
+        assert_rows(got, [("IBM", None, "GOOG")])
+
+    def test_query12(self):
+        # testQuery12: e2[last] picks the final absorbed event
+        ql = SE + """
+        @info(name = 'query1')
+        from e1 = EventStream [price >= 50 and volume > 100] -> e2 = EventStream [price <= 40] <:5>
+           -> e3 = EventStream [volume <= 70]
+        select e1.symbol as symbol1, e2[last].symbol as symbol2, e3.symbol as symbol3
+        insert into StockQuote;
+        """
+        got = run_app(ql, [
+            ("EventStream", ("IBM", 75.6, 105)),
+            ("EventStream", ("GOOG", 21.0, 91)),
+            ("EventStream", ("FB", 21.0, 81)),
+            ("EventStream", ("WSO2", 21.0, 61)),
+        ])
+        assert_rows(got, [("IBM", "FB", "WSO2")])
+
+    def test_query13(self):
+        # testQuery13: every + trailing count state — each token emits at
+        # exactly min occurrences and is consumed
+        ql = SE + """
+        @info(name = 'query1')
+        from every e1 = EventStream ->
+             e2 = EventStream [e1.symbol==e2.symbol]<4:6>
+        select e1.volume as volume1, e2[0].volume as volume2, e2[1].volume as volume3,
+          e2[2].volume as volume4, e2[3].volume as volume5, e2[4].volume as volume6,
+          e2[5].volume as volume7
+        insert into StockQuote;
+        """
+        got = run_app(ql, [
+            ("EventStream", ("IBM", 75.6, 100)),
+            ("EventStream", ("IBM", 75.6, 200)),
+            ("EventStream", ("IBM", 75.6, 300)),
+            ("EventStream", ("GOOG", 21.0, 91)),
+            ("EventStream", ("IBM", 75.6, 400)),
+            ("EventStream", ("IBM", 75.6, 500)),
+            ("EventStream", ("GOOG", 21.0, 91)),
+            ("EventStream", ("IBM", 75.6, 600)),
+            ("EventStream", ("IBM", 75.6, 700)),
+            ("EventStream", ("IBM", 75.6, 800)),
+            ("EventStream", ("GOOG", 21.0, 91)),
+            ("EventStream", ("IBM", 75.6, 900)),
+        ])
+        assert_rows(got, [
+            (100, 200, 300, 400, 500, None, None),
+            (200, 300, 400, 500, 600, None, None),
+            (300, 400, 500, 600, 700, None, None),
+            (400, 500, 600, 700, 800, None, None),
+            (500, 600, 700, 800, 900, None, None),
+        ])
+
+    def test_query14(self):
+        # testQuery14: instanceOf guards over absent captures in having
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] <0:5> -> e2=Stream2[price>e1[0].price]
+        select e1[0].price as price1_0, e1[1].price as price1_1, e1[2].price as price1_2, e2.price as price2
+        having instanceOfFloat(e1[1].price) and not instanceOfFloat(e1[2].price) and instanceOfFloat(price1_1) and not instanceOfFloat(price1_2)
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream1", ("WSO2", 23.6, 100)),
+            ("Stream1", ("GOOG", 7.6, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+        ])
+        assert_rows(got, [(25.6, 23.6, None, 45.7)])
+
+    def test_query15(self):
+        # testQuery15: every -> exact count <2> -> absent-and-logical tail;
+        # an arriving event on the absent side kills waiting tokens
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream1[price>20] -> e2=Stream1[price>20]<2> -> not Stream1[price>20] and e3=Stream2
+        select e1.price as price1_0, e2[0].price as price2_0, e2[1].price as price2_1,
+        e2[2].price as price2_2, e3.price as price3_0
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 25.6, 100)),
+            ("Stream1", ("WSO2", 23.6, 100)),
+            ("Stream1", ("WSO2", 23.6, 100)),
+            ("Stream1", ("GOOG", 27.6, 100)),
+            ("Stream1", ("GOOG", 28.6, 100)),
+            ("Stream2", ("IBM", 45.7, 100)),
+        ])
+        assert_rows(got, [(23.6, 27.6, 28.6, None, 45.7)])
