@@ -67,7 +67,7 @@ class CompiledAggregator:
     def _run_sum(self, state, contrib, flow: FlowInfo):
         if flow.group is not None:
             return keyed_running_sum(
-                contrib, flow.group.same, flow.reset, state, flow.group.slot
+                contrib, flow.group.sorted, flow.reset, state, flow.group.slot
             )
         run, carry = running_sum(contrib, flow.reset, state)
         return run, carry
@@ -201,7 +201,7 @@ class ExtremeAggregator(CompiledAggregator):
         x = self.arg(env).astype(self.dtype)
         if flow.group is not None:
             run, carry = keyed_running_extreme(
-                x, flow.active, flow.group.same, reset, state,
+                x, flow.active, flow.group.sorted, reset, state,
                 flow.group.slot, self.is_min,
             )
         else:

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.executor import CompiledExpr, Env, Scope, compile_expression
 from siddhi_tpu.core.types import AttrType
-from siddhi_tpu.ops.group import assign_slots, mix_keys
+from siddhi_tpu.ops.group import SortedGroups, assign_slots, mix_keys
 from siddhi_tpu.query_api.expression import Variable
 
 DEFAULT_GROUP_CAPACITY = 1024
@@ -35,9 +35,9 @@ def _as_key_col(col: jnp.ndarray, t: AttrType) -> jnp.ndarray:
 class GroupCtx:
     """Per-batch group context handed to aggregators via FlowInfo."""
 
-    slot: jnp.ndarray   # [B] int32; == capacity for non-keyed rows
-    key: jnp.ndarray    # [B] int64
-    same: jnp.ndarray   # [B,B] key equality (both rows keyed)
+    slot: jnp.ndarray    # [B] int32; == capacity for non-keyed rows
+    key: jnp.ndarray     # [B] int64
+    sorted: SortedGroups  # lexsorted (era, key) view for segmented reductions
     capacity: int
     key_of: Callable[[Env], jnp.ndarray]  # env -> int64 key column (any length)
     overflow: jnp.ndarray = None  # scalar bool
@@ -75,11 +75,11 @@ class CompiledGroupBy:
 
     def assign(self, state, env: Env, active: jnp.ndarray, reset: jnp.ndarray = None):
         bk = self.key_of(env)
-        keys, used, n, slot, same, overflow = assign_slots(
+        keys, used, n, slot, grp, overflow = assign_slots(
             state["keys"], state["used"], state["n"], bk, active, reset=reset
         )
         ctx = GroupCtx(
-            slot=slot, key=bk, same=same, capacity=self.capacity,
+            slot=slot, key=bk, sorted=grp, capacity=self.capacity,
             key_of=self.key_of, overflow=overflow,
         )
         return {"keys": keys, "used": used, "n": n}, ctx

@@ -112,7 +112,7 @@ class PartitionedQueryRuntime(QueryRuntime):
         env = Env(cols, now=now)
         keys, matched = self.key_of(env)
         active = batch.valid & (batch.kind == KIND_CURRENT) & matched
-        pk, pu, pn, slot, _same, povf = assign_slots(
+        pk, pu, pn, slot, _grp, povf = assign_slots(
             ptable["keys"], ptable["used"], ptable["n"], keys, active
         )
         is_timer = batch.valid & (batch.kind == KIND_TIMER)
@@ -207,7 +207,7 @@ class PartitionedJoinQueryRuntime(JoinQueryRuntime):
         cols[(sid, None, TS_ATTR)] = batch.ts
         keys, matched = self.key_of_by_side[side](Env(cols, now=now))
         active = batch.valid & (batch.kind == KIND_CURRENT) & matched
-        pk, pu, pn, slot, _same, povf = assign_slots(
+        pk, pu, pn, slot, _grp, povf = assign_slots(
             ptable["keys"], ptable["used"], ptable["n"], keys, active
         )
         is_timer = batch.valid & (batch.kind == KIND_TIMER)
@@ -320,7 +320,7 @@ class PartitionedPatternQueryRuntime:
         cols[(stream_id, None, TS_ATTR)] = batch.ts
         keys, matched = self.key_fns[stream_id](Env(cols, now=now))
         active = batch.valid & (batch.kind == KIND_CURRENT) & matched
-        pk, pu, pn, slot, _same, povf = assign_slots(
+        pk, pu, pn, slot, _grp, povf = assign_slots(
             ptable["keys"], ptable["used"], ptable["n"], keys, active
         )
         step = self._inner._make_step(stream_id)

@@ -33,9 +33,10 @@ Deliberate deviations from the reference interpreter (documented, test-covered):
   so the clean generation-chain semantics is used instead;
 - emission order among tokens completing on the SAME event is lane order, not
   pending-list age order;
-- unbounded counts `<m:>` absorb past the capture capacity on the scan path
-  (occurrence counter keeps counting, writes drop) but cap at the capture
-  capacity in the batch count kernel;
+- counts absorb past the capture capacity on both execution paths (the
+  occurrence counter keeps counting while capture writes drop), so `<m:>`
+  with m above `@app:countCapacity` still fires — only the first `cap`
+  occurrences are retrievable;
 - absent states with a waiting time are supported standalone (`A -> not B for 5
   sec`); inside logical elements only the kill/`and`-completion semantics are
   implemented.

@@ -28,6 +28,7 @@ from siddhi_tpu.core.executor import (
 from siddhi_tpu.core.flow import Flow
 from siddhi_tpu.core.groupby import CompiledGroupBy
 from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.ops.group import keep_last_per_group
 from siddhi_tpu.query_api.execution import OutputAttribute, Selector
 from siddhi_tpu.query_api.expression import AttributeFunction, Expression, Variable
 
@@ -214,18 +215,10 @@ class CompiledSelector:
         # diverges for `output all events` where a bucket's CURRENT would
         # shadow the previous bucket's EXPIRED of the same key)
         if self.batch_mode and ctx is not None:
-            b = valid.shape[0]
-            idx = jnp.arange(b, dtype=jnp.int32)
             seg = jnp.cumsum(flow.reset.astype(jnp.int32))
-            kind = flow.batch.kind
-            conflict = (
-                (idx[None, :] > idx[:, None])
-                & ctx.same
-                & (kind[None, :] == kind[:, None])
-                & (seg[None, :] == seg[:, None])
-                & valid[None, :]
+            valid = keep_last_per_group(
+                [ctx.key, flow.batch.kind.astype(jnp.int32), seg], valid
             )
-            valid = valid & ~conflict.any(axis=1)
 
         # per-group rate limiters need each row's group key beside it
         # (reference: GroupByKeyGenerator key threading into rate limiters)

@@ -162,18 +162,27 @@ class SlidingWindow(WindowStage):
             len_trig_valid, perm[jnp.clip(trig_rank, 0, bsz - 1)], BIG
         )
 
-        if self.t is not None:
-            trigger_ok = valid_cur | is_timer
-            due = (
-                trigger_ok[None, :]
-                & present[:, None]
-                & (bwts[None, :] - elem_wts[:, None] >= self.t)
-                & (jnp.arange(bsz, dtype=jnp.int32)[None, :] >= own_row[:, None])
+        if self.t is None:
+            # Pure length window: deaths pair 1:1 with insertions (the
+            # insertion of seq_e + W evicts seq_e), so the EXPIRED/CURRENT
+            # interleaving is pure rank arithmetic — no candidate lexsort
+            # (reference behavior: LengthWindowProcessor.java emits the
+            # displaced event then the arriving one, per event).
+            return self._apply_length(
+                state, flow, b, bsz, w, k, total, valid_cur, bwts, rank, c,
+                seq_batch, elem_ts, elem_seq, elem_cols, present,
+                trig_rank, len_trig_valid, perm,
             )
-            has_time_trig = due.any(axis=1)
-            trig_row_time = jnp.where(has_time_trig, jnp.argmax(due, axis=1).astype(jnp.int32), BIG)
-        else:
-            trig_row_time = jnp.full((k,), BIG, jnp.int32)
+
+        trigger_ok = valid_cur | is_timer
+        due = (
+            trigger_ok[None, :]
+            & present[:, None]
+            & (bwts[None, :] - elem_wts[:, None] >= self.t)
+            & (jnp.arange(bsz, dtype=jnp.int32)[None, :] >= own_row[:, None])
+        )
+        has_time_trig = due.any(axis=1)
+        trig_row_time = jnp.where(has_time_trig, jnp.argmax(due, axis=1).astype(jnp.int32), BIG)
 
         trig_row = jnp.minimum(trig_row_len, trig_row_time)
         evict = present & (trig_row < BIG)
@@ -232,24 +241,9 @@ class SlidingWindow(WindowStage):
         member_cols[(self.ref, None, TS_ATTR)] = elem_ts
         member_env = Env(member_cols, now=flow.now)
 
-        # --- new ring state ---
-        # rows already evicted within this batch (time-expired before the batch
-        # ended) must NOT be re-inserted, or they would expire a second time
-        ring_evicted = evict[:w]
-        batch_evicted = evict[w:]
-        insert = valid_cur & ~batch_evicted & (rank >= c - w)
-        slots = jnp.where(insert, (total + rank) % w, jnp.int64(w)).astype(jnp.int32)
-        new_seq = jnp.where(ring_evicted, jnp.int64(-1), state["seq"])
-        new_state = {
-            "cols": {
-                n: _place_ring(state["cols"][n], ring_evicted, slots, b.cols[n])
-                for n in b.cols
-            },
-            "ts": _place_ring(state["ts"], ring_evicted, slots, b.ts),
-            "wts": _place_ring(state["wts"], ring_evicted, slots, bwts),
-            "seq": new_seq.at[slots].set(seq_batch, mode="drop"),
-            "total": total + c,
-        }
+        new_state = self._ring_state(
+            state, evict, valid_cur, rank, c, total, b, bwts, seq_batch
+        )
 
         aux = dict(flow.aux)
         if self.needs_scheduler and self.t is not None:
@@ -267,6 +261,116 @@ class SlidingWindow(WindowStage):
             tables=flow.tables,
         )
 
+
+    def _ring_state(
+        self, state, evict, valid_cur, rank, c, total, b, bwts, seq_batch
+    ):
+        """Post-step ring buffers, shared by the sorted and length-only paths.
+        Rows already evicted within this batch (expired before the batch
+        ended) must NOT be re-inserted, or they would expire a second time."""
+        w = self.w
+        ring_evicted = evict[:w]
+        batch_evicted = evict[w:]
+        insert = valid_cur & ~batch_evicted & (rank >= c - w)
+        slots = jnp.where(insert, (total + rank) % w, jnp.int64(w)).astype(jnp.int32)
+        new_seq = jnp.where(ring_evicted, jnp.int64(-1), state["seq"])
+        return {
+            "cols": {
+                n: _place_ring(state["cols"][n], ring_evicted, slots, b.cols[n])
+                for n in b.cols
+            },
+            "ts": _place_ring(state["ts"], ring_evicted, slots, b.ts),
+            "wts": _place_ring(state["wts"], ring_evicted, slots, bwts),
+            "seq": new_seq.at[slots].set(seq_batch, mode="drop"),
+            "total": total + c,
+        }
+
+    def _apply_length(
+        self, state, flow, b, bsz, w, k, total, valid_cur, bwts, rank, c,
+        seq_batch, elem_ts, elem_seq, elem_cols, present,
+        trig_rank, len_trig_valid, perm,
+    ):
+        """Sort-free length-window step (see apply). Positions:
+        insertion i (rank order) emits EXPIRED at i + E_i - 1 when it evicts
+        (E = inclusive eviction count) and its CURRENT at i + E_i."""
+        ranks = jnp.arange(bsz, dtype=jnp.int32)
+        in_rank = ranks < c
+        # insertion i evicts iff the window is full at that point
+        e = in_rank & (total + ranks >= w)
+        E = jnp.cumsum(e.astype(jnp.int32))
+        cur_pos_rank = ranks + E
+        exp_pos_rank = jnp.where(e, cur_pos_rank - 1, BIG)
+
+        # evicted element (seq = total + i - w): a ring slot if it predates
+        # this batch, else the batch row of rank i - w
+        seq_ev = total + ranks.astype(jnp.int64) - w
+        from_ring = seq_ev < total
+        ring_slot = jnp.where(seq_ev >= 0, seq_ev % w, 0).astype(jnp.int32)
+        batch_rank = jnp.clip(ranks - w, 0, bsz - 1)
+        elem_idx = jnp.where(
+            from_ring, ring_slot, w + perm[batch_rank]
+        ).astype(jnp.int32)
+
+        n_out = 2 * bsz
+        trig_ts = b.ts[perm[jnp.clip(ranks, 0, bsz - 1)]]  # trigger row ts
+        out_ts = jnp.zeros((n_out,), jnp.int64)
+        out_kind = jnp.zeros((n_out,), jnp.int8)
+        out_valid = jnp.zeros((n_out,), jnp.bool_)
+        out_cols = {n: jnp.zeros((n_out,), a.dtype) for n, a in b.cols.items()}
+
+        # scatter EXPIREDs (rank space)
+        exp_dst = jnp.where(e, exp_pos_rank, n_out)
+        out_ts = out_ts.at[exp_dst].set(trig_ts, mode="drop")
+        out_kind = out_kind.at[exp_dst].set(jnp.int8(KIND_EXPIRED), mode="drop")
+        out_valid = out_valid.at[exp_dst].set(True, mode="drop")
+        for n in out_cols:
+            out_cols[n] = out_cols[n].at[exp_dst].set(
+                elem_cols[n][elem_idx], mode="drop"
+            )
+        # scatter CURRENTs (row space: row r has rank[r], position via gather)
+        cur_pos_row = cur_pos_rank[jnp.clip(rank, 0, bsz - 1)]
+        cur_dst = jnp.where(valid_cur, cur_pos_row, n_out)
+        out_ts = out_ts.at[cur_dst].set(b.ts, mode="drop")
+        out_valid = out_valid.at[cur_dst].set(True, mode="drop")
+        for n in out_cols:
+            out_cols[n] = out_cols[n].at[cur_dst].set(b.cols[n], mode="drop")
+        out = EventBatch(ts=out_ts, kind=out_kind, valid=out_valid, cols=out_cols)
+
+        # --- membership matrix (same contract as the sorted path) ---
+        own_row_rank = rank  # row -> rank
+        birth_pos = jnp.concatenate(
+            [
+                jnp.full((w,), -1, jnp.int32),
+                jnp.where(valid_cur, cur_pos_row, jnp.int32(-1)),
+            ]
+        )
+        E_at = E[jnp.clip(trig_rank, 0, bsz - 1)]
+        death_pos = jnp.where(
+            len_trig_valid, trig_rank + E_at - 1, BIG
+        )
+        pos_row = jnp.arange(n_out)
+        member = (
+            present[None, :]
+            & (birth_pos[None, :] <= pos_row[:, None])
+            & (pos_row[:, None] < death_pos[None, :])
+        )
+        member_cols = {(self.ref, None, n): elem_cols[n] for n in elem_cols}
+        member_cols[(self.ref, None, TS_ATTR)] = elem_ts
+        member_env = Env(member_cols, now=flow.now)
+
+        new_state = self._ring_state(
+            state, len_trig_valid, valid_cur, rank, c, total, b, bwts, seq_batch
+        )
+        return new_state, Flow(
+            batch=out,
+            ref=flow.ref,
+            now=flow.now,
+            extra_cols={},
+            member=member,
+            member_env=member_env,
+            aux=dict(flow.aux),
+            tables=flow.tables,
+        )
 
     def view(self, state):
         mask = state["seq"] >= 0
@@ -461,18 +565,20 @@ class BatchWindow(WindowStage):
         )
 
         # --- membership (bucket contents; position-based, see SlidingWindow) ---
-        # An element is a member from its CURRENT output row until its bucket's
-        # RESET row. Prev-bucket elements are never members (the reference's
-        # aggregator deque was already cleared by that bucket's RESET; its
-        # later EXPIRED events remove from an empty deque — a no-op).
+        # An element is a member from its CURRENT output row (which follows its
+        # flush's RESET) until its own EXPIRED row at the NEXT flush — the
+        # reference's one-by-one add/remove ordering: reset clears, the
+        # bucket's currents accumulate, the next flush's expireds remove.
+        # Prev-bucket elements are never members (their bucket's reset already
+        # cleared the deque; their EXPIRED events remove from empty — a no-op).
         inv = jnp.argsort(order)  # candidate index -> sorted output position
         ncand = cand_key.shape[0]
         rs_base = 3 * w + 2 * bsz
         birth_cc = jnp.where(carried_valid & any_flush, inv[cw], BIG)
-        death_cc = jnp.where(carried_valid & any_flush, inv[rs_base + 0], BIG)
+        death_cc = jnp.where(carried_valid & (n_flush > 1), inv[w + cw], BIG)
         birth_bt = jnp.where(row_emit, inv[3 * w + rows], BIG)
         death_bt = jnp.where(
-            row_emit, inv[rs_base + jnp.clip(e_row.astype(jnp.int32), 0, bsz - 1)], BIG
+            row_emit & (e_row + 1 < n_flush), inv[3 * w + bsz + rows], BIG
         )
         e_birth = jnp.concatenate([birth_cc, jnp.full((w,), BIG, jnp.int32), birth_bt])
         e_death = jnp.concatenate([death_cc, jnp.full((w,), -1, jnp.int32), death_bt])

@@ -5,16 +5,26 @@ looked up per event by a generated string key
 (reference: query/selector/GroupByKeyGenerator.java,
 query/selector/attribute/processor/executor/GroupByAggregationAttributeExecutor.java).
 TPU-shaped equivalent: group state is a fixed-capacity `[G]` array indexed by a
-slot; slot assignment is a vectorized probe of a persistent int64 key table —
-no scan, no host round-trip — and the per-event running values are masked
-O(B^2) segment reductions over the batch (one masked matmul / reduce).
+slot; slot assignment is a vectorized probe of a persistent int64 key table.
+Within a batch, keyed running values ride a SORTED view of the rows — one
+lexsort by (key, reset-era) turns every per-key reduction into a log-depth
+segmented scan (ops/prefix.py), replacing the earlier [B,B] masked-reduction
+formulation that allocated a 1G-element mask at B=32k.
 """
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax.numpy as jnp
 
-from siddhi_tpu.ops.prefix import extreme_identity, last_reset_index
+from siddhi_tpu.ops.prefix import (
+    extreme_identity,
+    last_reset_index,
+    segmented_carry,
+    segmented_cum_extreme,
+    segmented_cumsum,
+)
 
 # 64-bit mixing constants (splitmix64 finalizer) for combining composite keys.
 _MIX1 = jnp.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
@@ -37,6 +47,20 @@ def mix_keys(cols: list[jnp.ndarray]) -> jnp.ndarray:
     return h
 
 
+@dataclasses.dataclass
+class SortedGroups:
+    """Sorted per-batch view: rows permuted by (active, reset-era, key, idx).
+
+    perm:      [B] int32 — sorted position -> original row
+    inv:       [B] int32 — original row -> sorted position
+    seg_start: [B] bool  — sorted position begins a (era, key) segment
+    """
+
+    perm: jnp.ndarray
+    inv: jnp.ndarray
+    seg_start: jnp.ndarray
+
+
 def assign_slots(
     table_keys: jnp.ndarray,  # [G] int64
     used: jnp.ndarray,        # [G] bool
@@ -55,32 +79,47 @@ def assign_slots(
     against the old table, which only feeds the (pre-reset) carry gathers.
 
     Overflow: keys beyond capacity go to the dead lane G — their within-batch
-    running values are still exact (computed from the `same` mask), but their
-    carry is lost across batches; existing groups are never corrupted.
+    running values are still exact (computed over the sorted segments), but
+    their carry is lost across batches; existing groups are never corrupted.
 
     Returns (new_table_keys, new_used, new_n_used, slot [B] int32,
-    same [B, B] bool key-equality mask, overflow scalar bool).
+    SortedGroups, overflow scalar bool).
     """
     g = table_keys.shape[0]
     b = batch_keys.shape[0]
     idx = jnp.arange(b, dtype=jnp.int32)
 
-    same = (batch_keys[:, None] == batch_keys[None, :]) & active[:, None] & active[None, :]
-
-    if reset is not None and reset.shape:
-        marked = jnp.where(reset, idx, jnp.int32(-1))
-        glr = jnp.max(marked)  # last reset row, -1 if none
-    else:
-        glr = jnp.int32(-1)
+    has_reset = reset is not None and getattr(reset, "shape", None)
+    rst = reset if has_reset else jnp.zeros((b,), jnp.bool_)
+    glr = jnp.max(jnp.where(rst, idx, jnp.int32(-1)))  # last reset row, -1 if none
     any_reset = glr >= 0
     post = idx > glr  # rows whose carry lives in the (possibly fresh) new table
+    era = jnp.cumsum(rst.astype(jnp.int32))  # segments never span a reset
 
-    # --- resolution against the old table (pre-reset gathers + no-reset case)
+    # ---- sorted view: actives first, grouped by (era, key), stable by idx
+    inact = (~active).astype(jnp.int32)
+    perm = jnp.lexsort((idx, batch_keys, era, inact)).astype(jnp.int32)
+    sk = batch_keys[perm]
+    se = era[perm]
+    sa = active[perm]
+    seg_start = jnp.concatenate(
+        [
+            jnp.ones((1,), jnp.bool_),
+            (sk[1:] != sk[:-1]) | (se[1:] != se[:-1]) | (sa[1:] != sa[:-1]),
+        ]
+    )
+    inv = jnp.zeros((b,), jnp.int32).at[perm].set(idx)
+    grp = SortedGroups(perm=perm, inv=inv, seg_start=seg_start)
+
+    # first row (original index) holding each row's (era, key) — via the
+    # segment head carried across its segment, inverse-permuted
+    first = segmented_carry(perm, seg_start)[inv]
+
+    # ---- resolution against the old table (pre-reset gathers + no-reset case)
     eq_t = used[None, :] & (table_keys[None, :] == batch_keys[:, None])  # [B,G]
     in_t = eq_t.any(axis=1) & active
     t_slot = jnp.argmax(eq_t, axis=1).astype(jnp.int32)
 
-    first = jnp.argmax(same, axis=1).astype(jnp.int32)  # first row with my key
     is_alloc = active & ~in_t & (first == idx)
     alloc_rank = (jnp.cumsum(is_alloc) - is_alloc).astype(jnp.int32)
     slot_new = n_used + alloc_rank
@@ -88,22 +127,21 @@ def assign_slots(
     old_slot = jnp.where(in_t, t_slot, jnp.where(slot_new[first] < g, slot_new[first], g))
     old_slot = jnp.where(active, old_slot, jnp.int32(g)).astype(jnp.int32)
 
-    # --- fresh-table resolution for post-reset rows
+    # ---- fresh-table resolution for post-reset rows (first is era-local, so
+    # the same head works for the fresh allocation pass)
     post_active = active & post
-    same_post = same & post[:, None] & post[None, :]
-    first_post = jnp.argmax(same_post, axis=1).astype(jnp.int32)
-    is_alloc_f = post_active & (first_post == idx)
+    is_alloc_f = post_active & (first == idx)
     rank_f = (jnp.cumsum(is_alloc_f) - is_alloc_f).astype(jnp.int32)
     fresh_overflow = (jnp.where(is_alloc_f, rank_f, 0) >= g).any()
     fresh_slot = jnp.where(
-        post_active & (rank_f[first_post] < g), rank_f[first_post], g
+        post_active & (rank_f[first] < g), rank_f[first], g
     ).astype(jnp.int32)
 
     slot = jnp.where(any_reset & post, fresh_slot, old_slot)
     slot = jnp.where(active, slot, jnp.int32(g))
     overflow = jnp.where(any_reset, fresh_overflow, old_overflow)
 
-    # --- new table state
+    # ---- new table state
     # no reset: old table + this batch's allocations
     scatter_old = jnp.where(is_alloc & (slot_new < g) & ~any_reset, slot_new, g)
     keys_old = table_keys.at[scatter_old].set(batch_keys, mode="drop")
@@ -118,30 +156,23 @@ def assign_slots(
     new_keys = jnp.where(any_reset, keys_f, keys_old)
     new_used = jnp.where(any_reset, used_f, used_old)
     new_n = jnp.where(any_reset, n_f, n_old)
-    return new_keys, new_used, new_n, slot, same, overflow
-
-
-def _window_mask(same: jnp.ndarray, reset: jnp.ndarray) -> jnp.ndarray:
-    """[B,B]: j contributes to i's running value — same key, j <= i, j after
-    the last reset at or before i (RESET clears every group, matching the
-    reference's batch-window reset of all group states)."""
-    b = reset.shape[-1]
-    idx = jnp.arange(b, dtype=jnp.int32)
-    lr = last_reset_index(reset)
-    return same & (idx[None, :] <= idx[:, None]) & (idx[None, :] > lr[:, None])
+    return new_keys, new_used, new_n, slot, grp, overflow
 
 
 def keyed_running_sum(
     contrib: jnp.ndarray,  # [B], 0 on inactive rows
-    same: jnp.ndarray,     # [B,B]
+    grp: SortedGroups,
     reset: jnp.ndarray,    # [B]
     carry: jnp.ndarray,    # [G]
     slot: jnp.ndarray,     # [B] int32 (G = inactive)
 ):
-    """Per-event running sum within each group; returns ([B] run, [G] carry')."""
+    """Per-event running sum within each group; returns ([B] run, [G] carry').
+
+    The (era, key) segmentation bounds contributions to same-key rows j <= i
+    with no reset in between — exactly the reference's per-key running state
+    with RESET zeroing every group."""
     g = carry.shape[0]
-    wm = _window_mask(same, reset)
-    run = jnp.where(wm, contrib[None, :], 0).sum(axis=-1)
+    run = segmented_cumsum(contrib[grp.perm], grp.seg_start)[grp.inv]
     lr = last_reset_index(reset)
     gathered = jnp.where(slot < g, carry[jnp.clip(slot, 0, g - 1)], 0)
     run = run + jnp.where(lr < 0, gathered, jnp.zeros_like(gathered))
@@ -158,7 +189,7 @@ def keyed_running_sum(
 def keyed_running_extreme(
     values: jnp.ndarray,
     active: jnp.ndarray,
-    same: jnp.ndarray,
+    grp: SortedGroups,
     reset: jnp.ndarray,
     carry: jnp.ndarray,  # [G]
     slot: jnp.ndarray,
@@ -167,14 +198,13 @@ def keyed_running_extreme(
     """Per-event running min/max within each group (no removal)."""
     g = carry.shape[0]
     ident = extreme_identity(values.dtype, is_min)
-    wm = _window_mask(same, reset) & active[None, :]
-    masked = jnp.where(wm, values[None, :], ident)
-    red = masked.min(axis=-1) if is_min else masked.max(axis=-1)
+    masked = jnp.where(active, values, ident)
+    run = segmented_cum_extreme(masked[grp.perm], grp.seg_start, is_min)[grp.inv]
     lr = last_reset_index(reset)
     gathered = jnp.where(
         (slot < g) & (lr < 0), carry[jnp.clip(slot, 0, g - 1)], ident
     )
-    run = jnp.minimum(red, gathered) if is_min else jnp.maximum(red, gathered)
+    run = jnp.minimum(run, gathered) if is_min else jnp.maximum(run, gathered)
 
     post = jnp.arange(values.shape[0], dtype=jnp.int32) > lr[-1]
     base = jnp.where(reset.any(), jnp.full_like(carry, ident), carry)
@@ -185,3 +215,30 @@ def keyed_running_extreme(
     else:
         new_carry = base.at[scatter].max(vals_post, mode="drop")
     return run, new_carry
+
+
+def keep_last_per_group(cols: list[jnp.ndarray], valid: jnp.ndarray) -> jnp.ndarray:
+    """[B] bool: valid rows that are the LAST valid row of their group, where a
+    group is the tuple of `cols` values (reference: QuerySelector
+    processInBatchGroupBy — the map keeps one entry per key, last write wins).
+    O(B log B): sort by group, find each group's last valid row index."""
+    b = valid.shape[0]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    perm = jnp.lexsort((idx, *[c for c in cols])).astype(jnp.int32)
+    sv = valid[perm]
+    scols = [c[perm] for c in cols]
+    boundary = jnp.zeros((b,), jnp.bool_).at[0].set(True)
+    for c in scols:
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), c[1:] != c[:-1]]
+        )
+    # last valid original-row index per segment: reverse segmented cummax of
+    # where(valid, original row, -1)
+    marked = jnp.where(sv, perm, jnp.int32(-1))
+    rev = marked[::-1]
+    # a reversed segment starts where the forward segment ENDS
+    seg_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
+    rev_start = seg_end[::-1]
+    last_in_seg = segmented_cum_extreme(rev, rev_start, is_min=False)[::-1]
+    inv = jnp.zeros((b,), jnp.int32).at[perm].set(idx)
+    return valid & (last_in_seg[inv] == idx)

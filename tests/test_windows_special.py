@@ -171,3 +171,42 @@ class TestCronWindow:
         assert sorted(removed) == [("A",), ("B",)]
         rt.shutdown()
         mgr.shutdown()
+
+
+class TestBatchWindowMembership:
+    def test_min_max_over_length_batch(self):
+        # regression: bucket elements' membership interval was empty (death at
+        # the bucket's own reset, which PRECEDES its currents in flush order)
+        ql = """
+        define stream S (symbol string, price float, volume long);
+        @info(name='q')
+        from S#window.lengthBatch(2)
+        select min(price) as lo, max(price) as hi
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("S", ("A", 10.0, 1), 1), ("S", ("B", 5.0, 2), 2),
+            ("S", ("C", 30.0, 3), 3), ("S", ("D", 8.0, 4), 4),
+        ])
+        assert [tuple(r) for r in ins] == [
+            (10.0, 10.0), (5.0, 10.0), (30.0, 30.0), (8.0, 30.0)]
+
+    def test_grouped_min_max_over_length_batch(self):
+        ql = """
+        define stream S (symbol string, price float, volume long);
+        @info(name='q')
+        from S#window.lengthBatch(4)
+        select symbol, sum(volume) as total, min(price) as lo, max(price) as hi
+        group by symbol
+        insert into Out;
+        """
+        ins, _ = run_app(ql, [
+            ("S", ("A", 10.0, 1), 1), ("S", ("B", 20.0, 2), 2),
+            ("S", ("A", 30.0, 3), 3), ("S", ("B", 40.0, 4), 4),
+            ("S", ("A", 50.0, 5), 5), ("S", ("A", 60.0, 6), 6),
+            ("S", ("B", 70.0, 7), 7), ("S", ("A", 80.0, 8), 8),
+        ])
+        rows = {tuple(r) for r in ins}
+        assert ("A", 4, 10.0, 30.0) in rows and ("B", 6, 20.0, 40.0) in rows
+        assert ("A", 19, 50.0, 80.0) in rows and ("B", 7, 70.0, 70.0) in rows
+        assert len(ins) == 4
