@@ -155,9 +155,12 @@ WORKLOADS = {
 
 
 def _p99_detect_latency_ms(data, batch=256, batches=60):
-    """p99 wall latency of one small-batch pattern step end-to-end (ingest
-    pack -> NFA -> callback drain) — the BASELINE north star's latency leg
-    uses small micro-batches, trading throughput for detection delay."""
+    """p99 detection latency: wall time from the START of a micro-batch send
+    to the query callback having DELIVERED that batch's matches (ingest pack
+    -> NFA step -> device readback -> host decode -> callback). The callback
+    drain is the single device synchronization per batch — the floor is one
+    tunnel flush (~70-110 ms behind the axon relay; sub-ms on local chips),
+    which the send path never pays twice (pack and dispatch are async)."""
     from siddhi_tpu import SiddhiManager
 
     mgr = SiddhiManager()
@@ -171,21 +174,21 @@ def _p99_detect_latency_ms(data, batch=256, batches=60):
     insert into Out;
     """)
     _prime_interner(mgr, data["names"])
-    rt.add_callback("q", lambda ts, i, r: None)
+    fired = [0.0]
+    rt.add_callback("q", lambda ts, i, r: fired.__setitem__(0, time.perf_counter()))
     rt.start()
     h = rt.get_input_handler("StockStream")
     cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
-    qr = rt.queries["q"]
-    import jax
 
     lat = []
     for i in range(batches + 5):
         lo, hi = i * batch, (i + 1) * batch
+        fired[0] = 0.0
         t0 = time.perf_counter()
         h.send_columns(data["ts"][lo:hi], {k: v[lo:hi] for k, v in cols.items()})
-        jax.block_until_ready(qr.state)
+        t1 = fired[0] if fired[0] > 0.0 else time.perf_counter()
         if i >= 5:  # skip compile warmup
-            lat.append((time.perf_counter() - t0) * 1000)
+            lat.append((t1 - t0) * 1000)
     rt.shutdown()
     mgr.shutdown()
     lat.sort()

@@ -421,25 +421,38 @@ class Parser:
     def _classify_input(self) -> str:
         """Look ahead to decide standard / join / pattern / sequence
         (replaces ANTLR's unbounded-lookahead alternatives)."""
-        depth = 0
+        # brackets hide filter expressions entirely; parens only hide
+        # pattern-irrelevant commas (function args) — arrows/aliases inside a
+        # parenthesized state block (`(every e1=... -> e2=...) within ...`)
+        # still classify as a pattern
+        par = 0
+        sq = 0
         i = self.pos
         toks = self.toks
         saw_arrow = saw_comma = saw_join = saw_logical = saw_assign = False
         starts_every_or_not = toks[i].type == "ID" and toks[i].text.lower() in ("every", "not")
         while i < len(toks):
             t = toks[i]
-            if t.type in ("(", "["):
-                depth += 1
-            elif t.type in (")", "]"):
-                depth -= 1
-            elif depth == 0:
+            if t.type == "(":
+                par += 1
+            elif t.type == ")":
+                par -= 1
+                if par < 0:
+                    break
+            elif t.type == "[":
+                sq += 1
+            elif t.type == "]":
+                sq -= 1
+                if sq < 0:
+                    break
+            elif sq == 0:
                 if t.type == "->":
                     saw_arrow = True
-                elif t.type == ",":
+                elif t.type == "," and par == 0:
                     saw_comma = True
-                elif t.type == "=" :
+                elif t.type == "=":
                     saw_assign = True
-                elif t.type == "ID":
+                elif t.type == "ID" and par == 0:
                     low = t.text.lower()
                     if low in ("select", "output", "insert", "delete", "update", "return"):
                         break
@@ -451,8 +464,6 @@ class Parser:
                             saw_join = True
                     elif low in ("and", "or"):
                         saw_logical = True
-            elif depth < 0:
-                break
             i += 1
         if saw_join:
             # JOIN at depth 0 can only be a join query (filters keep and/or and

@@ -141,6 +141,7 @@ def _flatten_state(
     refs: list,
     schemas: dict,
     count_cap: int,
+    every_blocks: list,
 ) -> None:
     """Linearize the state-element tree into the slot chain (reference:
     StateInputStreamParser.parseInputStream recursive walk,
@@ -171,16 +172,24 @@ def _flatten_state(
 
     if isinstance(elem, NextStateElement):
         first = len(slots)
-        _flatten_state(elem.state, slots, refs, schemas, count_cap)
-        _flatten_state(elem.next, slots, refs, schemas, count_cap)
+        _flatten_state(elem.state, slots, refs, schemas, count_cap, every_blocks)
+        _flatten_state(elem.next, slots, refs, schemas, count_cap, every_blocks)
         if elem.within_ms is not None:
             for s in slots[first:]:
                 s.within_ms = s.within_ms or elem.within_ms
     elif isinstance(elem, EveryStateElement):
         first = len(slots)
-        _flatten_state(elem.state, slots, refs, schemas, count_cap)
-        if len(slots) > first:
+        _flatten_state(elem.state, slots, refs, schemas, count_cap, every_blocks)
+        if len(slots) == first + 1:
+            # single-slot every: persistent generator slot (forks per match)
             slots[first].persistent = True
+        elif len(slots) > first + 1:
+            # multi-slot every BLOCK: re-arms when the block COMPLETES
+            # (reference: EveryInnerStateRuntime wires the block's last post
+            # processor's nextEveryStatePreProcessor back to the block's
+            # first pre — matches are strictly serial, EveryPatternTestCase
+            # testQuery5/7)
+            every_blocks.append((first, len(slots) - 1))
         if elem.within_ms is not None:
             for s in slots[first:]:
                 s.within_ms = s.within_ms or elem.within_ms
@@ -201,11 +210,19 @@ def _flatten_state(
         atoms = []
         for side in (elem.left, elem.right):
             if isinstance(side, AbsentStreamStateElement):
-                if side.waiting_time_ms is not None:
+                if (
+                    side.waiting_time_ms is not None
+                    and elem.type is not LogicalType.AND
+                ):
                     raise SiddhiAppCreationError(
-                        "absent-with-waiting inside 'and'/'or' is not supported yet"
+                        "absent-with-waiting inside 'or' is not supported yet"
                     )
-                atoms.append(new_atom(side.stream, absent=True))
+                atoms.append(
+                    new_atom(
+                        side.stream, absent=True,
+                        waiting=side.waiting_time_ms,
+                    )
+                )
             elif isinstance(side, StreamStateElement):
                 atoms.append(new_atom(side.stream))
             else:
@@ -251,8 +268,10 @@ class PatternProgram:
 
         self.slots: list[Slot] = []
         self.refs: list[Atom] = []
+        self.every_blocks: list[tuple[int, int]] = []
         _flatten_state(
-            state_stream.state, self.slots, self.refs, schemas, count_capacity
+            state_stream.state, self.slots, self.refs, schemas, count_capacity,
+            self.every_blocks,
         )
         if not self.slots:
             raise SiddhiAppCreationError("empty pattern")
@@ -291,6 +310,11 @@ class PatternProgram:
         self.needs_scheduler = any(
             a.waiting_ms is not None for a in self.refs
         )
+        # sequences with count slots carry an explicit per-token forwarding
+        # lane (reference: SEQUENCE addState accepts ONE new state per event,
+        # so next-slot pending membership is a contended, per-event win —
+        # SequenceTestCase testQuery6/11). Patterns keep implicit count-skip.
+        self._use_fwd = self.sequence and any(s.is_count for s in self.slots)
 
     # ---- token table ----------------------------------------------------
 
@@ -320,6 +344,11 @@ class PatternProgram:
             "entry_ts": jnp.full((T,), now, dtype=jnp.int64).at[1:].set(0),
             "caps": caps,
         }
+        if self._use_fwd:
+            # a min-0 count start state forwards its virgin immediately
+            # (reference: CountPreStateProcessor.addState minCount==0 branch)
+            fwd0 = self.slots[0].is_count and self.slots[0].min_count == 0
+            tok["fwd"] = jnp.zeros((T,), dtype=jnp.bool_).at[0].set(fwd0)
         return tok
 
     # ---- environments ----------------------------------------------------
@@ -397,19 +426,30 @@ class PatternProgram:
     def _eligible(self, tok, p: int) -> jnp.ndarray:
         """Tokens that may match slot p: at p, or parked at preceding count
         slots whose min is satisfied (count-skip, reference:
-        CountPreStateProcessor min-count forwarding)."""
+        CountPreStateProcessor min-count forwarding).
+
+        SEQUENCE type keeps only the OLDEST forwarded token: the reference's
+        addState accepts a single new state per event for sequences
+        (StreamPreStateProcessor.addState SEQUENCE branch), so a contended
+        forward is won by the earlier chain — SequenceTestCase testQuery11."""
         active, slot = tok["active"], tok["slot"]
         elig = active & (slot == p)
+        skip = jnp.zeros_like(elig)
         q = p - 1
         while q >= 0 and self.slots[q].is_count:
             sat = tok["caps"][self.slots[q].atoms[0].ref_idx]["n"] >= max(
                 self.slots[q].min_count, 0
             )
-            elig = elig | (active & (slot == q) & sat)
+            skip = skip | (active & (slot == q) & sat)
             if self.slots[q].min_count > 0:
                 break
             q -= 1
-        return elig
+        if self._use_fwd:
+            # sequence forwarding is explicit: a token reaches the next
+            # slot's pending only by winning its forward event's contention
+            # (the fwd lane, updated at each event's end)
+            skip = skip & tok["fwd"]
+        return elig | skip
 
     def _capture(self, caps_r, atom: Atom, match, ts, event_cols):
         """Write the current event into ref r's next occurrence slot."""
@@ -463,29 +503,88 @@ class PatternProgram:
         touched = jnp.zeros((self.T,), dtype=jnp.bool_)
         last = len(self.slots) - 1
 
-        # ---- timer handling: absent slots whose deadline passed emit/advance
+        # ---- sequence start-state re-init: the reference clears every
+        # pending list per event and re-inits the start state when its
+        # pending empties (SequenceSingleProcessStreamReceiver.stabilizeStates
+        # -> resetAndUpdate -> StreamPreStateProcessor.init). For an
+        # every-rooted sequence that means a fresh virgin must exist whenever
+        # no slot-0 token is still pending there (virgin, or a count still
+        # absorbing below max) — SequenceTestCase testQuery6.
+        if self.sequence and self.slots[0].persistent:
+            s0 = self.slots[0]
+            n0 = tok["caps"][s0.atoms[0].ref_idx]["n"]
+            pend = tok["active"] & (tok["slot"] == 0) & (tok["start_ts"] < 0)
+            if s0.is_count:
+                mx0 = s0.max_count if s0.max_count > 0 else (1 << 30)
+                pend = pend | (
+                    tok["active"] & (tok["slot"] == 0) & (n0 < mx0)
+                )
+            need = is_cur & ~pend.any()
+            mask0 = jnp.zeros((self.T,), dtype=jnp.bool_).at[0].set(True) & need
+            tok, overflow = self._arm_virgins(tok, mask0, 0, ts, overflow)
+
+        # ---- timer handling: absent deadlines emit/advance
         for slot in self.slots:
             atom = slot.atoms[0]
-            if not (slot.is_absent and atom.waiting_ms is not None):
-                continue
             p = slot.index
-            at_p = tok["active"] & (tok["slot"] == p)
-            fire = at_p & is_timer & (ts >= tok["entry_ts"] + atom.waiting_ms)
-            if p == last:
-                # emit with this ref not arrived; output ts = deadline
-                out, out_n, overflow = self._write_emits(
-                    out, out_n, overflow, fire, tok,
-                    tok["entry_ts"] + atom.waiting_ms,
+            if slot.is_absent and atom.waiting_ms is not None:
+                at_p = tok["active"] & (tok["slot"] == p)
+                fire = at_p & is_timer & (ts >= tok["entry_ts"] + atom.waiting_ms)
+                if p == last:
+                    # emit with this ref not arrived; output ts = deadline
+                    out, out_n, overflow = self._write_emits(
+                        out, out_n, overflow, fire, tok,
+                        tok["entry_ts"] + atom.waiting_ms,
+                    )
+                    tok = self._consume(tok, fire, slot)
+                else:
+                    tok = self._advance_rows(
+                        tok, fire, slot, tok["entry_ts"] + atom.waiting_ms
+                    )
+                touched = touched | fire
+            elif slot.logical is not None:
+                # `A and not B for t`: completes at the deadline once every
+                # present side has arrived (reference:
+                # AbsentLogicalPreStateProcessor waiting-time scheduling)
+                ab = next(
+                    (
+                        a for a in slot.atoms
+                        if a.absent and a.waiting_ms is not None
+                    ),
+                    None,
                 )
-                tok = self._consume(tok, fire, slot)
-            else:
-                tok = self._advance_rows(tok, fire, slot, tok["entry_ts"] + atom.waiting_ms)
-            touched = touched | fire
+                if ab is None:
+                    continue
+                arrived = jnp.ones((self.T,), dtype=jnp.bool_)
+                for a2 in slot.atoms:
+                    if not a2.absent:
+                        arrived = arrived & (
+                            tok["caps"][a2.ref_idx]["n"] > 0
+                        )
+                at_p = tok["active"] & (tok["slot"] == p)
+                deadline = tok["entry_ts"] + ab.waiting_ms
+                fire = at_p & is_timer & arrived & (ts >= deadline)
+                if p == last:
+                    out, out_n, overflow = self._write_emits(
+                        out, out_n, overflow, fire, tok, deadline
+                    )
+                    tok = self._consume(tok, fire, slot)
+                    if slot.persistent:
+                        # surviving every-generator re-arms fresh, window
+                        # restarting at the deadline
+                        tok = self._clear_slot_caps(tok, fire, slot, ts=ts)
+                else:
+                    tok = self._advance_rows(tok, fire, slot, deadline)
+                touched = touched | fire
 
         # ---- event matching, descending slot order so one event moves a
         # token at most one hop (reference: next-event semantics)
         for slot in reversed(self.slots):
             p = slot.index
+            # touched accumulates per SLOT: both sides of a logical element
+            # may consume the same event (reference: LogicalPatternTestCase
+            # testQuery5 — one event satisfies both sides of an `and`)
+            slot_touch = jnp.zeros((self.T,), dtype=jnp.bool_)
             for atom in slot.atoms:
                 if atom.stream_id not in stream_cols:
                     continue
@@ -506,9 +605,14 @@ class PatternProgram:
                     match = match & c(env)
                 if atom.absent:
                     # arrival on an absent stream kills the token
-                    # (reference: AbsentStreamPreStateProcessor.process kill)
+                    # (reference: AbsentStreamPreStateProcessor.process kill);
+                    # with a waiting time, only arrivals INSIDE the window
+                    if atom.waiting_ms is not None:
+                        match = match & (
+                            ts <= tok["entry_ts"] + atom.waiting_ms
+                        )
                     tok = {**tok, "active": tok["active"] & ~match}
-                    touched = touched | match
+                    slot_touch = slot_touch | match
                     continue
 
                 # capture the event into the atom's ref
@@ -538,6 +642,20 @@ class PatternProgram:
                         for v in arrived[1:]:
                             allv = allv & v
                         complete = match & allv
+                        wait_ab = next(
+                            (
+                                a for a in slot.atoms
+                                if a.absent and a.waiting_ms is not None
+                            ),
+                            None,
+                        )
+                        if wait_ab is not None:
+                            # completion defers to the absent deadline; an
+                            # early present arrival stays captured and the
+                            # TIMER path completes it
+                            complete = complete & (
+                                ts >= tok["entry_ts"] + wait_ab.waiting_ms
+                            )
                     advance = complete
                 elif slot.is_count:
                     # absorb in place; a trailing count emits (and dies) at
@@ -557,6 +675,9 @@ class PatternProgram:
                     advance = match
 
                 stay = match & ~advance
+                blk = next(
+                    (b for b in self.every_blocks if b[1] == p), None
+                )
                 if p == last:
                     out, out_n, overflow = self._write_emits(
                         out, out_n, overflow, advance, adv_tok, ts
@@ -566,6 +687,11 @@ class PatternProgram:
                         new_tok, advance, slot, force=slot.is_count
                     )
                     tok = new_tok
+                    if blk is not None:
+                        tok, overflow, rearmed = self._rearm_block(
+                            tok, adv_tok, advance, blk, ts, overflow
+                        )
+                        touched = touched | rearmed
                 elif slot.persistent and not slot.is_count:
                     # fork: advanced copy goes to a free row; the source
                     # (virgin/generator) stays armed
@@ -588,7 +714,20 @@ class PatternProgram:
                     tok, out, out_n, overflow = self._arrival_effects(
                         tok, advance, p + 1, ts, out, out_n, overflow
                     )
-                touched = touched | match
+                    if blk is not None:
+                        tok, overflow, rearmed = self._rearm_block(
+                            tok, tok, advance, blk, ts, overflow
+                        )
+                        touched = touched | rearmed
+                slot_touch = slot_touch | match
+
+                if slot.persistent and slot.logical is not None:
+                    # the surviving generator re-arms FRESH: a completed
+                    # logical pair's partial captures clear and its absence
+                    # window restarts (reference: the every re-arm is a clean
+                    # addEveryState virgin — LogicalPatternTestCase
+                    # testQuery15/19)
+                    tok = self._clear_slot_caps(tok, advance, slot, ts=ts)
 
                 if (
                     slot.persistent and slot.is_count
@@ -604,18 +743,44 @@ class PatternProgram:
                     tok, overflow = self._arm_virgins(
                         tok, count_armed, p, ts, overflow
                     )
+            touched = touched | slot_touch
 
         # ---- sequence strictness: any unconsumed CURRENT event kills
         # non-virgin, non-generator tokens (reference: sequence
         # StreamPreStateProcessor resetState on mismatch)
         if self.sequence:
+            # (non-virgin tokens at persistent slots are NOT exempt: the
+            # reference drops a full count tail that fails to re-add —
+            # SequenceTestCase testQuery6)
             virgin = tok["start_ts"] < 0
-            pers = jnp.zeros((self.T,), dtype=jnp.bool_)
-            for slot in self.slots:
-                if slot.persistent:
-                    pers = pers | (tok["slot"] == slot.index)
-            kill = is_cur & tok["active"] & ~touched & ~virgin & ~pers
+            kill = is_cur & tok["active"] & ~touched & ~virgin
             tok = {**tok, "active": tok["active"] & ~kill}
+
+        if self._use_fwd:
+            # end-of-event forwarding: each count slot's absorbers with min
+            # satisfied contend for the ONE pending spot at the next slot;
+            # the oldest chain wins (reference: SEQUENCE addState drops all
+            # but the first add per event). Min-0 virgins keep their
+            # arm-time forward.
+            T = self.T
+            lanes64 = jnp.arange(T, dtype=jnp.int64)
+            new_fwd = tok["fwd"] & tok["active"] & (tok["start_ts"] < 0)
+            for q, cslot in enumerate(self.slots):
+                if not cslot.is_count:
+                    continue
+                n_q = tok["caps"][cslot.atoms[0].ref_idx]["n"]
+                cand = (
+                    tok["active"] & (tok["slot"] == q) & touched
+                    & (n_q >= max(cslot.min_count, 0))
+                    & (tok["start_ts"] >= 0)
+                )
+                key = jnp.where(
+                    cand, tok["start_ts"] * T + lanes64, jnp.int64(1) << 62
+                )
+                winner = cand & (jnp.arange(T) == jnp.argmin(key))
+                new_fwd = new_fwd | winner
+            # padding/timer rows are no-ops, not forward contests
+            tok = {**tok, "fwd": jnp.where(is_cur, new_fwd, tok["fwd"])}
 
         return tok, out, out_n, overflow
 
@@ -638,13 +803,16 @@ class PatternProgram:
             }
             for o, n_ in zip(old["caps"], new["caps"])
         ]
-        return {
+        merged = {
             "active": sel(old["active"], new["active"]),
             "slot": sel(old["slot"], new["slot"]),
             "start_ts": sel(old["start_ts"], new["start_ts"]),
             "entry_ts": sel(old["entry_ts"], new["entry_ts"]),
             "caps": caps,
         }
+        if "fwd" in old:
+            merged["fwd"] = sel(old["fwd"], new["fwd"])
+        return merged
 
     def _consume(self, tok, mask, slot: Slot, force: bool = False):
         """Tokens that emitted: die, unless at a persistent slot (the `every`
@@ -672,6 +840,104 @@ class PatternProgram:
             out, out_n, overflow,
         )
 
+    def _clear_slot_caps(self, tok, mask, slot: Slot, ts=None):
+        """Reset a slot's atom captures on `mask` rows (the re-arming
+        generator of a persistent logical slot becomes virgin again). `ts`
+        restarts the slot clock — a fresh absence window measures from the
+        re-arm, not the original arm."""
+        caps = list(tok["caps"])
+        for a in slot.atoms:
+            c = caps[a.ref_idx]
+            schema = self.schemas[a.stream_id]
+            caps[a.ref_idx] = {
+                "n": jnp.where(mask, 0, c["n"]),
+                "ts": jnp.where(mask[:, None], jnp.int64(0), c["ts"]),
+                "cols": {
+                    name: jnp.where(
+                        mask[:, None],
+                        jnp.asarray(
+                            null_value(schema.attr_types[name]), arr.dtype
+                        ),
+                        arr,
+                    )
+                    for name, arr in c["cols"].items()
+                },
+            }
+        out = {**tok, "caps": caps}
+        if ts is not None:
+            out["entry_ts"] = jnp.where(mask, ts, out["entry_ts"])
+        if slot.index == 0:
+            out["start_ts"] = jnp.where(
+                mask, jnp.int64(-1), out["start_ts"]
+            )
+        return out
+
+    def _rearm_block(self, tok, src_tok, mask, block, ts, overflow):
+        """Fork re-armed copies at a completed every block's first slot:
+        captures of slots OUTSIDE the block are retained, block captures are
+        cleared (reference: addEveryState clones the completing StateEvent
+        back into the block's first pre-state; block recaptures overwrite).
+        Matches are strictly serial — EveryPatternTestCase testQuery5/7."""
+        first, last = block
+        T = self.T
+        dest, overflow = self._alloc_lanes(tok, mask, overflow)
+        block_refs = {
+            a.ref_idx for s in self.slots[first:last + 1] for a in s.atoms
+        }
+        caps = []
+        for a in self.refs:
+            c = tok["caps"][a.ref_idx]
+            if a.ref_idx in block_refs:
+                schema = self.schemas[a.stream_id]
+                cols = {
+                    name: arr.at[dest].set(
+                        jnp.asarray(
+                            null_value(schema.attr_types[name]), arr.dtype
+                        ),
+                        mode="drop",
+                    )
+                    for name, arr in c["cols"].items()
+                }
+                caps.append(
+                    {
+                        "n": c["n"].at[dest].set(0, mode="drop"),
+                        "ts": c["ts"].at[dest].set(jnp.int64(0), mode="drop"),
+                        "cols": cols,
+                    }
+                )
+            else:
+                s = src_tok["caps"][a.ref_idx]
+                caps.append(
+                    {
+                        "n": c["n"].at[dest].set(s["n"], mode="drop"),
+                        "ts": c["ts"].at[dest].set(s["ts"], mode="drop"),
+                        "cols": {
+                            name: arr.at[dest].set(s["cols"][name], mode="drop")
+                            for name, arr in c["cols"].items()
+                        },
+                    }
+                )
+        # a re-armed whole-pattern block is virgin again; a mid-pattern block
+        # keeps the match start (within measures from the first capture)
+        start = (
+            src_tok["start_ts"]
+            if first > 0
+            else jnp.full((T,), -1, jnp.int64)
+        )
+        dest_mask = jnp.zeros((T,), jnp.bool_).at[dest].set(True, mode="drop")
+        res = {
+            "active": tok["active"].at[dest].set(True, mode="drop"),
+            "slot": tok["slot"].at[dest].set(first, mode="drop"),
+            "start_ts": tok["start_ts"].at[dest].set(start, mode="drop"),
+            "entry_ts": tok["entry_ts"].at[dest].set(
+                jnp.broadcast_to(ts, (T,)).astype(jnp.int64), mode="drop"
+            ),
+            "caps": caps,
+        }
+        if "fwd" in tok:
+            res["fwd"] = tok["fwd"].at[dest].set(False, mode="drop")
+        return res, overflow, dest_mask
+
     def _arm_virgins(self, tok, mask, p: int, ts, overflow):
         """Scatter fresh virgin tokens (slot p, no captures) into free rows."""
         T = self.T
@@ -694,7 +960,7 @@ class PatternProgram:
                     "cols": cols,
                 }
             )
-        return {
+        res = {
             "active": tok["active"].at[dest].set(True, mode="drop"),
             "slot": tok["slot"].at[dest].set(p, mode="drop"),
             "start_ts": tok["start_ts"].at[dest].set(jnp.int64(-1), mode="drop"),
@@ -702,7 +968,11 @@ class PatternProgram:
                 jnp.broadcast_to(ts, (T,)).astype(jnp.int64), mode="drop"
             ),
             "caps": caps,
-        }, overflow
+        }
+        if "fwd" in tok:
+            fwd0 = self.slots[p].is_count and self.slots[p].min_count == 0
+            res["fwd"] = tok["fwd"].at[dest].set(fwd0, mode="drop")
+        return res, overflow
 
     def _advance_rows(self, tok, mask, slot: Slot, ts):
         p = slot.index
@@ -743,7 +1013,7 @@ class PatternProgram:
             for o, a in zip(tok["caps"], adv_tok["caps"])
         ]
         dest_mask = jnp.zeros((T,), dtype=jnp.bool_).at[dest].set(True, mode="drop")
-        return {
+        res = {
             "active": tok["active"].at[dest].set(True, mode="drop"),
             "slot": tok["slot"].at[dest].set(
                 jnp.full((T,), next_slot, dtype=jnp.int32), mode="drop"
@@ -753,7 +1023,10 @@ class PatternProgram:
                 jnp.broadcast_to(ts, (T,)), mode="drop"
             ),
             "caps": caps,
-        }, overflow, dest_mask
+        }
+        if "fwd" in tok:
+            res["fwd"] = tok["fwd"].at[dest].set(False, mode="drop")
+        return res, overflow, dest_mask
 
     # ---- emission --------------------------------------------------------
 
@@ -773,6 +1046,8 @@ class PatternProgram:
 
     @property
     def fast_path_ok(self) -> bool:
+        if self.every_blocks:
+            return False
         for i, s in enumerate(self.slots):
             if len(s.atoms) != 1 or s.is_count or s.is_absent or s.logical:
                 return False
@@ -798,6 +1073,8 @@ class PatternProgram:
         pending state), so capture sets are pure rank arithmetic over the
         batch's match sequence."""
         if self.sequence or len(self.slots) < 2 or self.within_ms is not None:
+            return False
+        if self.every_blocks:
             return False
         s0 = self.slots[0]
         if not s0.is_count or s0.min_count < 1 or s0.is_absent or s0.logical:
@@ -1364,10 +1641,14 @@ class PatternProgram:
         """Earliest absent-slot deadline over active tokens, NO_TIMER if none."""
         t = NO_TIMER
         for slot in self.slots:
-            atom = slot.atoms[0]
-            if not (slot.is_absent and atom.waiting_ms is not None):
+            waits = [
+                a.waiting_ms
+                for a in slot.atoms
+                if a.absent and a.waiting_ms is not None
+            ]
+            if not waits or (len(slot.atoms) == 1 and not slot.is_absent):
                 continue
             at_p = tok["active"] & (tok["slot"] == slot.index)
-            dl = jnp.where(at_p, tok["entry_ts"] + atom.waiting_ms, NO_TIMER)
+            dl = jnp.where(at_p, tok["entry_ts"] + waits[0], NO_TIMER)
             t = jnp.minimum(t, jnp.min(dl))
         return t

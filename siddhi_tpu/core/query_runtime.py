@@ -122,6 +122,80 @@ class CompiledSingleChain:
         return dataclasses.replace(flow, batch=batch)
 
 
+class _AuxWarnWorker:
+    """Process-wide daemon draining deferred aux-flag checks.
+
+    The hot dispatch path never touches device scalars; this thread takes the
+    (query, flags) backlog, ORs each flag kind across the backlog ON DEVICE,
+    and pays exactly one blocking read per drain cycle — so overflow warnings
+    cost one tunnel flush per cycle instead of one per step."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items: list = []
+        self._thread = None
+        self._draining = False
+
+    def submit(self, qr, flags: dict) -> None:
+        with self._cv:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="siddhi-aux-warn"
+                )
+                self._thread.start()
+            self._items.append((qr, flags))
+            self._cv.notify_all()
+
+    def flush(self) -> None:
+        with self._cv:
+            while self._items or self._draining:
+                self._cv.wait(timeout=0.1)
+
+    def _run(self) -> None:
+        import numpy as np
+
+        while True:
+            with self._cv:
+                while not self._items:
+                    self._cv.wait()
+                items, self._items = self._items, []
+                self._draining = True
+            try:
+                per_qr: dict = {}
+                for qr, flags in items:
+                    d = per_qr.setdefault(id(qr), (qr, {}))[1]
+                    for k, v in flags.items():
+                        d.setdefault(k, []).append(v)
+                for qr, flags in per_qr.values():
+                    try:
+                        keys = sorted(flags)
+                        anys = jnp.stack(
+                            [
+                                jnp.stack(
+                                    [jnp.asarray(v).astype(bool) for v in flags[k]]
+                                ).any()
+                                for k in keys
+                            ]
+                        )
+                        vals = np.asarray(anys)  # the cycle's single block
+                        qr._check_aux_flags(
+                            {k: bool(vals[i]) for i, k in enumerate(keys)}
+                        )
+                    except Exception:  # never let a warning path kill the app
+                        import logging
+
+                        logging.getLogger(__name__).debug(
+                            "aux flag drain failed", exc_info=True
+                        )
+            finally:
+                with self._cv:
+                    self._draining = False
+                    self._cv.notify_all()
+
+
+_AUX_WORKER = _AuxWarnWorker()
+
+
 class BaseQueryRuntime:
     """Shared host-side half of a compiled query: output schema inference,
     callback/junction routing, state container (reference: QueryRuntime.java:45
@@ -211,26 +285,16 @@ class BaseQueryRuntime:
 
     def _warn_aux(self, aux: dict) -> None:
         """Surface overflow flags WITHOUT stalling the dispatch pipeline:
-        reading a device scalar blocks until its step finishes, so checks are
-        deferred until the values have materialized (`Array.is_ready`), with a
-        bounded backlog. `flush_aux_warnings` forces the remainder."""
-        pending = self.__dict__.setdefault("_pending_aux", [])
-        pending.append(aux)
-        force = len(pending) > 64
-        keep = []
-        for a in pending:
-            ready = all(
-                v.is_ready() for v in a.values() if hasattr(v, "is_ready")
-            )
-            if force or ready:
-                self._check_aux_flags(a)
-            else:
-                keep.append(a)
-        self._pending_aux = keep
+        even `Array.is_ready` on an in-flight device scalar forces a queue
+        flush (a full tunnel round trip behind a network-attached chip), so
+        flag checks are handed to a background drain thread that coalesces
+        any backlog into one device read. `flush_aux_warnings` joins it."""
+        flags = {k: v for k, v in aux.items() if k != "next_timer"}
+        if flags:
+            _AUX_WORKER.submit(self, flags)
 
     def flush_aux_warnings(self) -> None:
-        for a in self.__dict__.pop("_pending_aux", []):
-            self._check_aux_flags(a)
+        _AUX_WORKER.flush()
 
     def _check_aux_flags(self, aux: dict) -> None:
         if (

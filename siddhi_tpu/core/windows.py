@@ -169,8 +169,8 @@ class SlidingWindow(WindowStage):
             # (reference behavior: LengthWindowProcessor.java emits the
             # displaced event then the arriving one, per event).
             return self._apply_length(
-                state, flow, b, bsz, w, k, total, valid_cur, bwts, rank, c,
-                seq_batch, elem_ts, elem_seq, elem_cols, present,
+                state, flow, b, bsz, w, total, valid_cur, bwts, rank, c,
+                seq_batch, elem_ts, elem_cols, present,
                 trig_rank, len_trig_valid, perm,
             )
 
@@ -286,8 +286,8 @@ class SlidingWindow(WindowStage):
         }
 
     def _apply_length(
-        self, state, flow, b, bsz, w, k, total, valid_cur, bwts, rank, c,
-        seq_batch, elem_ts, elem_seq, elem_cols, present,
+        self, state, flow, b, bsz, w, total, valid_cur, bwts, rank, c,
+        seq_batch, elem_ts, elem_cols, present,
         trig_rank, len_trig_valid, perm,
     ):
         """Sort-free length-window step (see apply). Positions:

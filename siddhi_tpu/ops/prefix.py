@@ -83,28 +83,11 @@ def running_extreme(
     return run, run[-1]
 
 
-def segmented_cumsum(vals: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
-    """Inclusive segment-wise cumsum: positions with seg_start begin a fresh
-    running sum. Log-depth associative scan — the O(B) replacement for the
+def _segmented_scan(vals: jnp.ndarray, seg_start: jnp.ndarray, op) -> jnp.ndarray:
+    """Inclusive segment-wise scan: positions with seg_start restart the
+    accumulator. Log-depth associative scan — the O(B) replacement for the
     [B,B] masked-reduction form of keyed running values."""
     import jax.lax as lax
-
-    def combine(a, b):
-        av, ar = a
-        bv, br = b
-        return jnp.where(br, bv, av + bv), ar | br
-
-    out, _ = lax.associative_scan(combine, (vals, seg_start))
-    return out
-
-
-def segmented_cum_extreme(
-    vals: jnp.ndarray, seg_start: jnp.ndarray, is_min: bool
-) -> jnp.ndarray:
-    """Inclusive segment-wise running min/max."""
-    import jax.lax as lax
-
-    op = jnp.minimum if is_min else jnp.maximum
 
     def combine(a, b):
         av, ar = a
@@ -115,17 +98,23 @@ def segmented_cum_extreme(
     return out
 
 
+def segmented_cumsum(vals: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive segment-wise running sum."""
+    return _segmented_scan(vals, seg_start, lambda a, b: a + b)
+
+
+def segmented_cum_extreme(
+    vals: jnp.ndarray, seg_start: jnp.ndarray, is_min: bool
+) -> jnp.ndarray:
+    """Inclusive segment-wise running min/max."""
+    return _segmented_scan(
+        vals, seg_start, jnp.minimum if is_min else jnp.maximum
+    )
+
+
 def segmented_carry(vals: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
     """Propagate each segment's first value across the segment."""
-    import jax.lax as lax
-
-    def combine(a, b):
-        av, ar = a
-        bv, br = b
-        return jnp.where(br, bv, av), ar | br
-
-    out, _ = lax.associative_scan(combine, (vals, seg_start))
-    return out
+    return _segmented_scan(vals, seg_start, lambda a, b: a)
 
 
 def extreme_identity(dtype, is_min: bool) -> jnp.ndarray:

@@ -1,0 +1,233 @@
+"""Golden corpus: absent patterns, translated from the reference test data
+(reference: siddhi-core/src/test/java/org/wso2/siddhi/core/query/pattern/
+absent/{AbsentPatternTestCase,LogicalAbsentPatternTestCase}.java — data-level
+translation with waiting times scaled from 1 sec to 150 ms so the suite stays
+fast; the semantics under test are unchanged)."""
+
+import time
+
+from siddhi_tpu import SiddhiManager
+
+S123 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+define stream Stream3 (symbol string, price float, volume int);
+"""
+
+
+def run_timed(ql, steps, query_name="query1", settle=0.5, warm=()):
+    """steps: list of ('send', stream, row) | ('sleep', seconds).
+
+    `warm`: (stream, row) pairs sent BEFORE the timed phase to trigger each
+    per-stream step's jit compile (first compile takes seconds, which would
+    otherwise blow the wall-clock absent windows under test). Warm rows must
+    be semantically inert (not matching any pattern condition)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(
+        query_name, lambda ts, i, r: got.extend(tuple(e.data) for e in i or [])
+    )
+    rt.start()
+    handlers = {}
+    for stream, row in warm:
+        h = handlers.setdefault(stream, rt.get_input_handler(stream))
+        h.send(row)
+    for step in steps:
+        if step[0] == "sleep":
+            time.sleep(step[1])
+        else:
+            _, stream, row = step
+            h = handlers.setdefault(stream, rt.get_input_handler(stream))
+            h.send(row)
+    time.sleep(settle)
+    rt.shutdown()
+    mgr.shutdown()
+    return got
+
+
+class TestAbsentPatternGolden:
+    def test_absent1_no_arrival_emits(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 150 milliseconds
+        select e1.symbol as symbol1
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 55.6, 100)),
+            ("sleep", 0.4),
+        ])
+        assert got == [("WSO2",)], got
+
+    def test_absent2_late_arrival_still_emits(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 150 milliseconds
+        select e1.symbol as symbol1
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 55.6, 100)),
+            ("sleep", 0.4),
+            ("send", "Stream2", ("IBM", 58.7, 100)),
+        ])
+        assert got == [("WSO2",)], got
+
+    def test_absent3_arrival_inside_window_suppresses(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 150 milliseconds
+        select e1.symbol as symbol1
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 55.6, 100)),
+            ("send", "Stream2", ("IBM", 58.7, 100)),
+            ("sleep", 0.4),
+        ], warm=[("Stream1", ("W", 5.0, 1)), ("Stream2", ("W", 5.0, 1))])
+        assert got == [], got
+
+    def test_absent4_nonmatching_arrival_does_not_suppress(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] -> not Stream2[price>e1.price] for 150 milliseconds
+        select e1.symbol as symbol1
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 55.6, 100)),
+            ("send", "Stream2", ("IBM", 50.7, 100)),  # not > 55.6
+            ("sleep", 0.4),
+        ], warm=[("Stream1", ("W", 5.0, 1)), ("Stream2", ("W", 5.0, 1))])
+        assert got == [("WSO2",)], got
+
+
+class TestLogicalAbsentPatternGolden:
+    def test_absent1_and_without_waiting(self):
+        # `not B and e3`: e3 arrival with no prior B completes immediately
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>10] -> not Stream2[price>20] and e3=Stream3[price>30]
+        select e1.symbol as symbol1, e3.symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+        ], settle=0.2)
+        assert got == [("WSO2", "GOOGLE")], got
+
+    def test_absent2_and_killed_by_arrival(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>10] -> not Stream2[price>20] and e3=Stream3[price>30]
+        select e1.symbol as symbol1, e3.symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("send", "Stream2", ("IBM", 25.0, 100)),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+        ], settle=0.2)
+        assert got == [], got
+
+    def test_absent3_and_as_start_state(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from not Stream1[price>10] and e2=Stream2[price>20] -> e3=Stream3[price>30]
+        select e2.symbol as symbol2, e3.symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream2", ("IBM", 25.0, 100)),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+        ], settle=0.2)
+        assert got == [("IBM", "GOOGLE")], got
+
+    def test_absent4_and_start_killed(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from not Stream1[price>10] and e2=Stream2[price>20] -> e3=Stream3[price>30]
+        select e2.symbol as symbol2, e3.symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("send", "Stream2", ("IBM", 25.0, 100)),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+        ], settle=0.2)
+        assert got == [], got
+
+    def test_absent5_and_with_waiting_e3_after_deadline(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 150 milliseconds and e3=Stream3[price>30]
+        select e1.symbol as symbol1, e3.symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("sleep", 0.4),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+        ], settle=0.3)
+        assert got == [("WSO2", "GOOGLE")], got
+
+    def test_absent5b_and_with_waiting_e3_before_deadline(self):
+        # e3 inside the window: completion waits for the deadline
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 150 milliseconds and e3=Stream3[price>30]
+        select e1.symbol as symbol1, e3.symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+            ("sleep", 0.45),
+        ], settle=0.3, warm=[
+            ("Stream1", ("W", 5.0, 1)), ("Stream2", ("W", 5.0, 1)),
+            ("Stream3", ("W", 5.0, 1)),
+        ])
+        assert got == [("WSO2", "GOOGLE")], got
+
+    def test_absent5c_and_with_waiting_b_arrival_kills(self):
+        ql = S123 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>10] -> not Stream2[price>20] for 150 milliseconds and e3=Stream3[price>30]
+        select e1.symbol as symbol1, e3.symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("send", "Stream2", ("IBM", 25.0, 100)),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+            ("sleep", 0.45),
+        ], settle=0.3, warm=[
+            ("Stream1", ("W", 5.0, 1)), ("Stream2", ("W", 5.0, 1)),
+            ("Stream3", ("W", 5.0, 1)),
+        ])
+        assert got == [], got
+
+    def test_every_logical_absent_rearm_restarts_window(self):
+        # regression: the re-armed generator's absence window must measure
+        # from the re-arm (stale entry_ts made later cycles complete with
+        # the ORIGINAL window). After each B-free window, the next e1
+        # completes; a B arriving inside the CURRENT window kills that cycle.
+        ql = S123 + """
+        @info(name = 'query1')
+        from every (e1=Stream1[price>10] and not Stream2[price>20] for 150 milliseconds)
+        select e1.symbol as symbol1
+        insert into OutputStream ;
+        """
+        got = run_timed(ql, [
+            ("send", "Stream1", ("A1", 15.0, 100)),
+            ("sleep", 0.4),          # window B-free -> (A1,) at its deadline
+            ("send", "Stream1", ("A2", 16.0, 100)),  # window already elapsed
+            ("send", "Stream2", ("B", 25.0, 100)),   # kills the A2-cycle arm
+            ("send", "Stream1", ("A3", 17.0, 100)),  # its cycle was killed
+            ("sleep", 0.4),
+        ], settle=0.3, warm=[
+            ("Stream1", ("W", 5.0, 1)), ("Stream2", ("W", 5.0, 1)),
+        ])
+        assert got == [("A1",), ("A2",)], got

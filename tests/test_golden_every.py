@@ -1,0 +1,144 @@
+"""Golden corpus: every patterns, translated from the reference test data
+(reference: siddhi-core/src/test/java/org/wso2/siddhi/core/query/pattern/
+EveryPatternTestCase.java — data-level translation of queries, inputs, and
+expected outputs)."""
+
+from tests.test_golden_count import assert_rows, run_app
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+S12B = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price1 float, volume int);
+"""
+
+
+class TestEveryPatternGolden:
+    def test_query1(self):
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] -> e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", "IBM")])
+
+    def test_query2(self):
+        # without every: only the FIRST e1 arms the single token
+        ql = S12B + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20] -> e2=Stream2[price1>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream1", ("GOOG", 55.6, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", "IBM")])
+
+    def test_query3(self):
+        # every e1: a chain per e1 match, both fire on the same e2
+        ql = S12B + """
+        @info(name = 'query1')
+        from every e1=Stream1[price>20] -> e2=Stream2[price1>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream1", ("GOOG", 55.6, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert len(got) == 2 and set(got) == {("WSO2", "IBM"), ("GOOG", "IBM")}, got
+
+    def test_query4(self):
+        # every (e1 -> e3): serial block, one completion before e2
+        ql = S12 + """
+        @info(name = 'query1')
+        from every ( e1=Stream1[price>20] -> e3=Stream1[price>20]) -> e2=Stream2[price>e1.price]
+        select e1.price as price1, e3.price as price3, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream1", ("GOOG", 54.0, 100)),
+            ("Stream2", ("IBM", 57.7, 100)),
+        ])
+        assert_rows(got, [(55.6, 54.0, 57.7)])
+
+    def test_query5(self):
+        # every (e1 -> e3): matches are strictly serial (NOT per-event forks)
+        ql = S12 + """
+        @info(name = 'query1')
+        from every ( e1=Stream1[price>20] -> e3=Stream1[price>20]) -> e2=Stream2[price>e1.price]
+        select e1.price as price1, e3.price as price3, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream1", ("GOOG", 54.0, 100)),
+            ("Stream1", ("WSO2", 53.6, 100)),
+            ("Stream1", ("GOOG", 53.0, 100)),
+            ("Stream2", ("IBM", 57.7, 100)),
+        ])
+        assert len(got) == 2, got
+        assert_rows(sorted(got), sorted([(55.6, 54.0, 57.7), (53.6, 53.0, 57.7)]))
+
+    def test_query6(self):
+        # prefix state + every block in the middle: re-arm keeps e4's capture
+        ql = S12 + """
+        @info(name = 'query1')
+        from e4=Stream1[symbol=='MSFT'] -> every ( e1=Stream1[price>20] -> e3=Stream1[price>20]) ->
+           e2=Stream2[price>e1.price]
+        select e1.price as price1, e3.price as price3, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("MSFT", 55.6, 100)),
+            ("Stream1", ("WSO2", 55.7, 100)),
+            ("Stream1", ("GOOG", 54.0, 100)),
+            ("Stream1", ("WSO2", 53.6, 100)),
+            ("Stream1", ("GOOG", 53.0, 100)),
+            ("Stream2", ("IBM", 57.7, 100)),
+        ])
+        assert len(got) == 2, got
+        assert_rows(sorted(got), sorted([(55.7, 54.0, 57.7), (53.6, 53.0, 57.7)]))
+
+    def test_query7(self):
+        # whole pattern is one every block: serial non-overlapping pairs
+        ql = S12 + """
+        @info(name = 'query1')
+        from  every ( e1=Stream1[price>20] -> e3=Stream1[price>20])
+        select e1.price as price1, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("MSFT", 55.6, 100)),
+            ("Stream1", ("WSO2", 57.6, 100)),
+            ("Stream1", ("GOOG", 54.0, 100)),
+            ("Stream1", ("WSO2", 53.6, 100)),
+        ])
+        assert_rows(got, [(55.6, 57.6), (54.0, 53.6)])
+
+    def test_query8(self):
+        # every over a single state: every match emits
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream1[price>20]
+        select e1.price as price1
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("MSFT", 55.6, 100)),
+            ("Stream1", ("WSO2", 57.6, 100)),
+        ])
+        assert_rows(got, [(55.6,), (57.6,)])

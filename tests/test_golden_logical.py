@@ -1,0 +1,315 @@
+"""Golden corpus: logical (and/or) patterns, translated from the reference
+test data (reference: siddhi-core/src/test/java/org/wso2/siddhi/core/query/
+pattern/LogicalPatternTestCase.java — data-level translation)."""
+
+from siddhi_tpu import SiddhiManager
+
+from tests.test_golden_count import assert_rows, run_app
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+S123 = S12 + """
+define stream Stream3 (symbol string, price float, volume int);
+"""
+
+Q_OR = S12 + """
+@info(name = 'query1')
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.symbol as symbol2
+insert into OutputStream ;
+"""
+
+Q_AND = S12 + """
+@info(name = 'query1')
+from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] and e3=Stream2['IBM' == symbol]
+select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+insert into OutputStream ;
+"""
+
+
+def run_ts(ql, sends, query_name="query1"):
+    """sends: (stream, row, timestamp_ms) — event-time-exact within tests."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(
+        query_name,
+        lambda ts, i, r: got.extend(tuple(e.data) for e in i or []),
+    )
+    rt.start()
+    handlers = {}
+    for stream, row, ts in sends:
+        h = handlers.setdefault(stream, rt.get_input_handler(stream))
+        h.send(row, timestamp=ts)
+    rt.shutdown()
+    return got
+
+
+class TestLogicalPatternGolden:
+    def test_query1(self):
+        got = run_app(Q_OR, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("GOOG", 59.6, 100)),
+        ])
+        assert_rows(got, [("WSO2", "GOOG")])
+
+    def test_query2(self):
+        # the or's OTHER side fires: e2 stays null
+        got = run_app(Q_OR, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 10.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", None)])
+
+    def test_query3(self):
+        # or completes on first arrival; second event doesn't re-fire
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 72.7, 100)),
+            ("Stream2", ("IBM", 75.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", 72.7, None)])
+
+    def test_query4(self):
+        # and: waits for both sides
+        got = run_app(Q_AND, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("GOOG", 72.7, 100)),
+            ("Stream2", ("IBM", 4.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", 72.7, 4.7)])
+
+    def test_query5(self):
+        # one event can satisfy both sides of an and
+        got = run_app(Q_AND, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 72.7, 100)),
+            ("Stream2", ("IBM", 75.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", 72.7, 72.7)])
+
+    def test_query6(self):
+        # and across two different streams
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] and e3=Stream1['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 72.7, 100)),
+            ("Stream1", ("IBM", 75.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", 72.7, 75.7)])
+
+    def test_query7(self):
+        # and as the FIRST state
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] and e2=Stream2[price >30] -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("GOOG", 72.7, 100)),
+            ("Stream2", ("IBM", 4.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", 72.7, 4.7)])
+
+    def test_query8(self):
+        # or as the FIRST state — left side fires
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] or e2=Stream2[price >30] -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("GOOG", 72.7, 100)),
+            ("Stream2", ("IBM", 4.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", None, 4.7)])
+
+    def test_query9(self):
+        # or as the FIRST state — right side fires
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] or e2=Stream2[price >30] -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream2", ("GOOG", 72.7, 100)),
+            ("Stream2", ("IBM", 4.7, 100)),
+        ])
+        assert_rows(got, [(None, 72.7, 4.7)])
+
+    def test_query10(self):
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] or e2=Stream2[price >30] -> e3=Stream2['IBM' == symbol]
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 4.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", None, 4.7)])
+
+    def test_query11(self):
+        # every -> and over two other streams; two chains share completions
+        ql = S123 + """
+        @info(name = 'query1')
+        from every e1=Stream1[price >20] -> e2=Stream2['IBM' == symbol] and e3=Stream3['WSO2' == symbol]
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("IBM", 25.5, 100)),
+            ("Stream1", ("IBM", 59.65, 100)),
+            ("Stream2", ("IBM", 45.5, 100)),
+            ("Stream3", ("WSO2", 46.56, 100)),
+        ])
+        assert len(got) == 2, got
+        assert_rows(sorted(got), sorted([(25.5, 45.5, 46.56), (59.65, 45.5, 46.56)]))
+
+    def test_query12(self):
+        # every -> or: completes on the first side
+        ql = S123 + """
+        @info(name = 'query1')
+        from every e1=Stream1[price >20] -> e2=Stream2['IBM' == symbol] or e3=Stream3['WSO2' == symbol]
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("IBM", 25.5, 100)),
+            ("Stream1", ("IBM", 59.65, 100)),
+            ("Stream2", ("IBM", 45.5, 100)),
+        ])
+        assert len(got) == 2, got
+        assert_rows(sorted(got), sorted([(25.5, 45.5, None), (59.65, 45.5, None)]))
+
+    def test_query13(self):
+        # whole pattern = one and
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] and e2=Stream2[price >30]
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 25.0, 100)),
+            ("Stream2", ("IBM", 35.0, 100)),
+            ("Stream1", ("GOOGLE", 45.0, 100)),
+            ("Stream2", ("ORACLE", 55.0, 100)),
+        ])
+        assert_rows(got, [("WSO2", 35.0)])
+
+    def test_query14(self):
+        # whole pattern = one or
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] or e2=Stream2[price >30]
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 25.0, 100)),
+            ("Stream2", ("IBM", 35.0, 100)),
+            ("Stream2", ("ORACLE", 45.0, 100)),
+        ])
+        assert_rows(got, [("WSO2", None)])
+
+    def test_query15(self):
+        # every (and): re-fires per completed pair
+        ql = S12 + """
+        @info(name = 'query1')
+        from every (e1=Stream1[price > 20] and e2=Stream2[price >30])
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 25.0, 100)),
+            ("Stream2", ("IBM", 35.0, 100)),
+            ("Stream1", ("GOOGLE", 45.0, 100)),
+            ("Stream2", ("ORACLE", 55.0, 100)),
+        ])
+        assert_rows(got, [("WSO2", 35.0), ("GOOGLE", 55.0)])
+
+    def test_query16(self):
+        # every (or): each satisfying event completes and re-arms
+        ql = S12 + """
+        @info(name = 'query1')
+        from every (e1=Stream1[price > 20] or e2=Stream2[price >30])
+        select e1.symbol as symbol1, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 25.0, 100)),
+            ("Stream2", ("IBM", 35.0, 100)),
+            ("Stream2", ("ORACLE", 45.0, 100)),
+        ])
+        assert_rows(got, [("WSO2", None), (None, 35.0), (None, 45.0)])
+
+    def test_query17(self):
+        # within expires the or target
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] or e3=Stream2['IBM' == symbol]
+         within 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream ;
+        """
+        got = run_ts(ql, [
+            ("Stream1", ("WSO2", 55.6, 100), 1_000),
+            ("Stream2", ("GOOG", 59.6, 100), 2_200),
+        ])
+        assert_rows(got, [])
+
+    def test_query18(self):
+        # within expires a half-satisfied and
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price] and e3=Stream2['IBM' == symbol]
+         within 1 sec
+        select e1.symbol as symbol1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_ts(ql, [
+            ("Stream1", ("WSO2", 55.6, 100), 1_000),
+            ("Stream2", ("GOOG", 72.7, 100), 1_100),
+            ("Stream2", ("IBM", 4.7, 100), 2_300),
+        ])
+        assert_rows(got, [])
+
+    def test_query19(self):
+        # every (and) -> e3: two pending pairs both fire on e3
+        ql = S123 + """
+        @info(name = 'query1')
+        from every (e1=Stream1[price>10] and e2=Stream2[price>20]) -> e3=Stream3[price>30]
+        select e1.symbol as symbol1, e2.symbol as symbol2, e3.symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("ORACLE", 15.0, 100)),
+            ("Stream2", ("MICROSOFT", 45.0, 100)),
+            ("Stream1", ("IBM", 55.0, 100)),
+            ("Stream2", ("WSO2", 65.0, 100)),
+            ("Stream3", ("GOOGLE", 75.0, 100)),
+        ])
+        assert len(got) == 2, got
+        assert_rows(sorted(got), sorted([
+            ("ORACLE", "MICROSOFT", "GOOGLE"), ("IBM", "WSO2", "GOOGLE")]))

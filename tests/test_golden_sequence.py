@@ -1,0 +1,153 @@
+"""Golden corpus: sequences, translated from the reference test data
+(reference: siddhi-core/src/test/java/org/wso2/siddhi/core/query/sequence/
+SequenceTestCase.java — data-level translation)."""
+
+from tests.test_golden_count import assert_rows, run_app
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+class TestSequenceGolden:
+    def test_query1(self):
+        ql = S12 + """
+        @info(name = 'query1')
+        from e1=Stream1[price>20],e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", "IBM")])
+
+    def test_query2(self):
+        # strict continuity: the WSO2 chain is broken by GOOG, which itself
+        # starts the chain that completes
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream1[price>20], e2=Stream2[price>e1.price]
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream1", ("GOOG", 57.6, 100)),
+            ("Stream2", ("IBM", 65.7, 100)),
+        ])
+        assert_rows(got, [("GOOG", "IBM")])
+
+    def test_query3(self):
+        # trailing Kleene star emits immediately with zero captures
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream1[price>20], e2=Stream2[price>e1.price]*
+        select e1.symbol as symbol1, e2[0].symbol as symbol2, e2[1].symbol as symbol3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 55.6, 100)),
+            ("Stream1", ("IBM", 55.7, 100)),
+        ])
+        assert_rows(got, [("WSO2", None, None), ("IBM", None, None)])
+
+    def test_query4(self):
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2, e2.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 59.6, 100)),
+            ("Stream2", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+            ("Stream1", ("WSO2", 57.6, 100)),
+        ])
+        assert_rows(got, [(55.6, 55.7, 57.6)])
+
+    def test_query5(self):
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2, e2.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 59.6, 100)),
+            ("Stream2", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 55.0, 100)),
+            ("Stream1", ("WSO2", 57.6, 100)),
+        ])
+        assert_rows(got, [(55.6, 55.0, 57.6)])
+
+    def test_query6(self):
+        # optional (?): an overfull side kills the chain; every re-arms on the
+        # killing event
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream2[price>20]?, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e2.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 59.6, 100)),
+            ("Stream2", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+            ("Stream1", ("WSO2", 57.6, 100)),
+        ])
+        assert_rows(got, [(55.7, 57.6)])
+
+    def test_query7(self):
+        # sequence with or: chains re-arm per event
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream2[price>20], e2=Stream2[price>e1.price] or e3=Stream2[symbol=='IBM']
+        select e1.price as price1, e2.price as price2, e3.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream2", ("WSO2", 59.6, 100)),
+            ("Stream2", ("WSO2", 55.6, 100)),
+            ("Stream2", ("IBM", 55.7, 100)),
+            ("Stream2", ("WSO2", 57.6, 100)),
+        ])
+        assert len(got) == 2, got
+        assert_rows(got, [(55.6, 55.7, None), (55.7, 57.6, None)])
+
+    def test_query10(self):
+        # Kleene plus inside every with strict continuity
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream2[price>20]+, e2=Stream1[price>e1[0].price]
+        select e1[0].price as price1, e1[1].price as price2, e2.price as price3
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 59.6, 100)),
+            ("Stream2", ("WSO2", 55.6, 100)),
+            ("Stream1", ("WSO2", 57.6, 100)),
+        ])
+        assert_rows(got, [(55.6, None, 57.6)])
+
+    def test_query11(self):
+        # self-referential count condition (e2[last] inside e2's own filter):
+        # rising run then a fall
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream1[price>20],
+           e2=Stream1[(e2[last].price is null and price>=e1.price) or ((not (e2[last].price is null)) and price>=e2[last].price)]+,
+           e3=Stream1[price<e2[last].price]
+        select e1.price as price1, e2[0].price as price2, e2[1].price as price3, e3.price as price4
+        insert into OutputStream ;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("WSO2", 29.6, 100)),
+            ("Stream1", ("WSO2", 35.6, 100)),
+            ("Stream1", ("WSO2", 57.6, 100)),
+            ("Stream1", ("IBM", 47.6, 100)),
+        ])
+        assert_rows(got, [(29.6, 35.6, 57.6, 47.6)])

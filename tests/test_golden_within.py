@@ -1,0 +1,63 @@
+"""Golden corpus: within-bounded patterns, translated from the reference test
+data (reference: siddhi-core/src/test/java/org/wso2/siddhi/core/query/pattern/
+WithinPatternTestCase.java — data-level translation, Thread.sleep gaps turned
+into explicit event timestamps)."""
+
+from tests.test_golden_count import assert_rows
+from tests.test_golden_logical import run_ts
+
+S12 = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+class TestWithinPatternGolden:
+    def test_query1(self):
+        # the WSO2 chain expires at 1 sec; GOOG's chain is inside the bound
+        ql = S12 + """
+        @info(name = 'query1')
+        from every e1=Stream1[price>20] -> e2=Stream2[price>e1.price] within 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream ;
+        """
+        got = run_ts(ql, [
+            ("Stream1", ("WSO2", 55.6, 100), 1_000),
+            ("Stream1", ("GOOG", 54.0, 100), 2_500),
+            ("Stream2", ("IBM", 55.7, 100), 3_000),
+        ])
+        assert_rows(got, [("GOOG", "IBM")])
+
+    def test_query2(self):
+        # parenthesized pattern with within outside
+        ql = S12 + """
+        @info(name = 'query1')
+        from (every e1=Stream1[price>20]-> e2=Stream2[price>e1.price])
+         within 1 sec
+        select e1.symbol as symbol1, e2.symbol as symbol2
+        insert into OutputStream ;
+        """
+        got = run_ts(ql, [
+            ("Stream1", ("WSO2", 55.6, 100), 1_000),
+            ("Stream1", ("GOOG", 54.0, 100), 2_500),
+            ("Stream2", ("IBM", 55.7, 100), 3_000),
+        ])
+        assert_rows(got, [("GOOG", "IBM")])
+
+    def test_query3(self):
+        # every block + within 2 sec: only the second (fresh) block instance
+        # is within bound when e2 arrives
+        ql = S12 + """
+        @info(name = 'query1')
+        from (every (e1=Stream1[price>20] -> e3=Stream1[price>20]) -> e2=Stream2[price>e1.price]) within 2 sec
+        select e1.price as price1, e3.price as price3, e2.price as price2
+        insert into OutputStream ;
+        """
+        got = run_ts(ql, [
+            ("Stream1", ("WSO2", 55.6, 100), 1_000),
+            ("Stream1", ("GOOG", 54.0, 100), 1_600),
+            ("Stream1", ("WSO2", 53.6, 100), 2_200),
+            ("Stream1", ("GOOG", 53.0, 100), 2_800),
+            ("Stream2", ("IBM", 57.7, 100), 3_400),
+        ])
+        assert_rows(got, [(53.6, 53.0, 57.7)])
