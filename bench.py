@@ -154,6 +154,50 @@ WORKLOADS = {
 }
 
 
+def _table_scaling(rows_list=(100_000, 1_000_000), batch=1024, batches=24):
+    """Events/s of a stream query probing+updating a table at capacity N
+    (VERDICT r1 item 9: evidence for the exhaustive-scan-vs-index decision;
+    reference analog: table/holder/IndexEventHolder primary-key fast path)."""
+    import numpy as np
+
+    from siddhi_tpu import SiddhiManager
+
+    out = {}
+    for n_rows in rows_list:
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(f"""
+        @app:batch(size='{batch}')
+        define stream Loader (k long, v long);
+        define stream S (k long, v long);
+        @capacity(size='{n_rows}')
+        define table T (k long, v long);
+        @info(name='load') from Loader insert into T;
+        @info(name='upd')
+        from S select k, v update T on T.k == k;
+        """)
+        rt.start()
+        lk = np.arange(n_rows, dtype=np.int64)
+        rt.get_input_handler("Loader").send_columns(
+            np.arange(n_rows, dtype=np.int64),
+            {"k": lk, "v": lk},
+        )
+        rng = np.random.default_rng(3)
+        ks = rng.integers(0, n_rows, size=batch * batches).astype(np.int64)
+        vs = np.arange(batch * batches, dtype=np.int64)
+        h = rt.get_input_handler("S")
+        h.send_columns(np.arange(batch, dtype=np.int64), {"k": ks[:batch], "v": vs[:batch]})
+        _block_on_states(rt)
+        t0 = time.perf_counter()
+        h.send_columns(np.arange(batch * batches, dtype=np.int64), {"k": ks, "v": vs})
+        _block_on_states(rt)
+        dt = time.perf_counter() - t0
+        rt.shutdown()
+        mgr.shutdown()
+        label = f"{n_rows // 1000}k" if n_rows < 1_000_000 else f"{n_rows // 1_000_000}m"
+        out[f"table_update_{label}"] = round(batch * batches / dt, 1)
+    return out
+
+
 def _p99_detect_latency_ms(data, batch=256, batches=60):
     """p99 detection latency: wall time from the START of a micro-batch send
     to the query callback having DELIVERED that batch's matches (ingest pack
@@ -222,9 +266,14 @@ def main():
     if args.verbose:
         print(f"# p99 pattern detection latency (256-row micro-batch): {p99:.1f} ms")
 
+    scaling = _table_scaling()
+    if args.verbose:
+        print(f"# table scaling: {scaling}")
+
     geomean = math.exp(sum(math.log(v) for v in per.values()) / len(per))
     detail = {k: round(v, 1) for k, v in per.items()}
     detail["p99_detect_ms"] = round(p99, 2)
+    detail.update(scaling)
     print(
         json.dumps(
             {

@@ -234,8 +234,30 @@ class AggregationRuntime:
             if bname.startswith("last__g_"):
                 continue
             table_attrs.append(Attribute(f"AGG_{bname}", t))
+        # @store on the aggregation rides through to every duration table
+        # (reference: AggregationParser initDefaultTables passes the
+        # aggregation's annotations to each internal table definition)
+        from siddhi_tpu.query_api.annotation import find_annotation
+
+        store_ann = find_annotation(
+            getattr(definition, "annotations", []) or [], "store"
+        )
         for d in self.durations:
-            td = TableDefinition(f"{self.agg_id}_{d.name}", list(table_attrs))
+            tid = f"{self.agg_id}_{d.name}"
+            anns = []
+            if store_ann is not None:
+                # each duration table needs its OWN store namespace: a shared
+                # store.id would make the tables clobber each other's rows
+                from siddhi_tpu.query_api.annotation import Annotation
+
+                els = [
+                    (k, v) for k, v in store_ann.elements
+                    if k != "store.id"
+                ]
+                base_id = store_ann.element("store.id") or self.agg_id
+                els.append(("store.id", f"{base_id}__{d.name}"))
+                anns.append(Annotation(store_ann.name, els))
+            td = TableDefinition(tid, list(table_attrs), annotations=anns)
             self.tables[d] = InMemoryTable(td, interner)
 
         # output schema of the find path: AGG_TIMESTAMP + selected attrs
@@ -249,10 +271,105 @@ class AggregationRuntime:
         self.state = self.init_state()
         self._step = jax.jit(self._step_impl)
         self._finds = {}
+        self.rebuild_from_tables()
 
     def _base(self, name, kind, arg, t):
         if name not in self.bases:
             self.bases[name] = (kind, arg, t)
+
+    # ---- restart rebuild ---------------------------------------------------
+
+    def rebuild_from_tables(self):
+        """Rebuild each coarser duration's OPEN bucket from the next finer
+        duration's table rows (reference: aggregation/RecreateInMemoryData.java
+        wired at SiddhiAppRuntime.java:380-382). A @store-backed aggregation
+        restarting without a snapshot recovers its in-flight coarse buckets
+        from the persisted fine spills; the finest duration's open bucket is
+        irrecoverable in the reference too (its raw events were never spilled).
+
+        Host-side one-shot: the duration tables were just loaded from the
+        record store; rows are small and this runs once at creation."""
+        import numpy as np
+
+        fine_tbl = self.tables[self.durations[0]]
+        f_state = fine_tbl.state
+        f_valid = np.asarray(f_state["valid"])
+        if not f_valid.any():
+            return
+        latest = int(np.asarray(f_state["cols"][AGG_TS])[f_valid].max())
+
+        for i in range(1, len(self.durations)):
+            d = self.durations[i]
+            src = self.tables[self.durations[i - 1]].state
+            valid = np.asarray(src["valid"])
+            if not valid.any():
+                continue
+            ts = np.asarray(src["cols"][AGG_TS])[valid]
+            open_bucket = int(align_bucket(jnp.asarray(latest), d))
+            in_open = np.asarray(
+                align_bucket(jnp.asarray(ts), d)
+            ) == open_bucket
+            if not in_open.any():
+                # nothing to rebuild; _merge_into initializes the bucket on
+                # the next live merge
+                continue
+            cols = {
+                n: np.asarray(c)[valid][in_open]
+                for n, c in src["cols"].items()
+            }
+            row_ts = ts[in_open]
+            order = np.argsort(row_ts, kind="stable")
+
+            # group rows by the stored group attributes
+            gvals = [cols[g] for g in self.group_names]
+            groups: dict = {}
+            for ri in order:
+                gk = tuple(v[ri].item() for v in gvals)
+                groups.setdefault(gk, []).append(ri)
+
+            store = self._empty_store()
+            keys = np.asarray(store["keys"]).copy()
+            used = np.asarray(store["used"]).copy()
+            vals = {b: np.asarray(v).copy() for b, v in store["vals"].items()}
+            for slot_i, (gk, ridx) in enumerate(groups.items()):
+                if slot_i >= self.g:
+                    break
+                # the device key: float group cols bitcast to int32, mixed
+                kcols = []
+                for gname, gv in zip(self.group_names, gvals):
+                    t = dict(self.bases)[f"last__g_{gname}"][2]
+                    v = np.asarray([gv[ridx[0]]])
+                    if t in (AttrType.FLOAT, AttrType.DOUBLE):
+                        v = v.astype(np.float32).view(np.int32).astype(np.int64)
+                    kcols.append(jnp.asarray(v, jnp.int64))
+                if kcols:
+                    from siddhi_tpu.ops.group import mix_keys
+
+                    keys[slot_i] = int(mix_keys(kcols)[0])
+                used[slot_i] = True
+                for bname, (kind, _arg, _t) in self.bases.items():
+                    col = (
+                        cols[bname[len("last__g_"):]]
+                        if bname.startswith("last__g_")
+                        else cols[f"AGG_{bname}"]
+                    )
+                    sel = col[ridx]
+                    if kind in ("sum", "count"):
+                        vals[bname][slot_i] = sel.sum()
+                    elif kind == "min":
+                        vals[bname][slot_i] = sel.min()
+                    elif kind == "max":
+                        vals[bname][slot_i] = sel.max()
+                    elif kind == "first":
+                        vals[bname][slot_i] = sel[0]
+                    else:  # last
+                        vals[bname][slot_i] = sel[-1]
+            self.state["stores"][i] = {
+                "keys": jnp.asarray(keys),
+                "used": jnp.asarray(used),
+                "vals": {b: jnp.asarray(v) for b, v in vals.items()},
+                "bucket": jnp.asarray(open_bucket, jnp.int64),
+            }
 
     # ---- state -----------------------------------------------------------
 
@@ -525,6 +642,8 @@ class AggregationRuntime:
         self.state = new_state
         for t in self.tables.values():
             t.state = tstates[t.table_id]
+            if t.record_store is not None:
+                t.notify_change()  # spills write through to the record store
         return aux
 
     def _step_full(self, batch, now, tstates):

@@ -688,15 +688,41 @@ class SiddhiAppRuntime:
     def start(self) -> None:
         self._running = True
         if self.statistics_manager is not None:
-            self.statistics_manager.start_reporting()
+            # device-memory metric per component (reference analog:
+            # util/statistics/memory/ObjectSizeCalculator — here the bytes
+            # are HBM buffers held by each component's carried state)
+            def _tree_bytes(get_tree):
+                def fn():
+                    return sum(
+                        getattr(leaf, "nbytes", 0)
+                        for leaf in jax.tree_util.tree_leaves(get_tree())
+                    )
+                return fn
+
+            sm = self.statistics_manager
+            for qid, qr in self.queries.items():
+                sm.register_memory(
+                    f"query.{qid}", _tree_bytes(lambda _q=qr: _q.state)
+                )
+            for tid, t in self.tables.items():
+                sm.register_memory(
+                    f"table.{tid}", _tree_bytes(lambda _t=t: _t.state)
+                )
+            for wid, w in self.named_windows.items():
+                sm.register_memory(
+                    f"window.{wid}", _tree_bytes(lambda _w=w: _w.state)
+                )
+            for aid, ar in self.aggregations.items():
+                sm.register_memory(
+                    f"aggregation.{aid}", _tree_bytes(lambda _a=ar: _a.state)
+                )
+            sm.start_reporting()
         if self._playback_clock is not None:
             self._playback_clock.start_heartbeat()
         # absent-at-start patterns must arm their timers before any event
         # (reference: SiddhiAppRuntime.start -> eternalReferencedHolders.start)
-        from siddhi_tpu.core.pattern_runtime import PatternQueryRuntime
-
         for qr in self.queries.values():
-            if isinstance(qr, PatternQueryRuntime) and qr.needs_scheduler:
+            if getattr(qr, "needs_scheduler", False) and hasattr(qr, "prime"):
                 aux = qr.prime(self.clock())
                 self._maybe_schedule(qr, aux)
             if getattr(qr, "host_next_timer", None) and getattr(qr, "timer_target", None):

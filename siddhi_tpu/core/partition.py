@@ -258,10 +258,6 @@ class PartitionedPatternQueryRuntime:
             group_capacity=group_capacity, token_capacity=token_capacity,
             count_capacity=count_capacity, batch_size=batch_size, tables={},
         )
-        if self._inner.needs_scheduler:
-            raise SiddhiAppCreationError(
-                "absent states inside partitions are not supported yet"
-            )
         inner = self._inner
         self.query = query
         self.query_id = query_id
@@ -272,7 +268,9 @@ class PartitionedPatternQueryRuntime:
         self.rate_limiter = inner.rate_limiter
         self.table_op = None
         self.tables = {}
-        self.needs_scheduler = False
+        # absent deadlines: every partition's NFA shares the TIMER feed;
+        # next_timer min-reduces across the [P] axis (_reduce_paux)
+        self.needs_scheduler = inner.needs_scheduler
         self.timer_target = None
         self.inner_publish = None
         self.p = int(p_capacity)
@@ -311,8 +309,8 @@ class PartitionedPatternQueryRuntime:
     def flush_aux_warnings(self):
         self._inner.flush_aux_warnings()
 
-    def init_state(self):
-        one = self._inner.init_state()
+    def init_state(self, now: int = 0):
+        one = self._inner.init_state(now)
         return jax.tree_util.tree_map(lambda x: _tile(x, self.p), one)
 
     def _pstep_impl(self, ptable, states, batch: EventBatch, now, stream_id: str):
@@ -323,10 +321,11 @@ class PartitionedPatternQueryRuntime:
         pk, pu, pn, slot, _grp, povf = assign_slots(
             ptable["keys"], ptable["used"], ptable["n"], keys, active
         )
+        is_timer = batch.valid & (batch.kind == KIND_TIMER)
         step = self._inner._make_step(stream_id)
 
         def one(state, p):
-            sub_valid = active & (slot == p)
+            sub_valid = (active & (slot == p)) | is_timer
             b2 = EventBatch(batch.ts, batch.kind, sub_valid, batch.cols)
             st, _ts, out, aux = step(state, {}, b2, now)
             return st, out, aux
@@ -334,6 +333,51 @@ class PartitionedPatternQueryRuntime:
         states2, outs, auxs = jax.vmap(one)(states, jnp.arange(self.p))
         aux = _reduce_paux(auxs, povf)
         return {"keys": pk, "used": pu, "n": pn}, states2, outs, aux
+
+    def _ptimer_impl(self, states, used, batch: EventBatch, now):
+        def one(state):
+            st, _ts, out, aux = self._inner._make_step(None)(state, {}, batch, now)
+            return st, out, aux
+
+        states2, outs, auxs = jax.vmap(one)(states)
+        # only lanes holding a live key may emit/schedule — unused lanes
+        # still carry armed virgin tokens (absent-at-start would fire on
+        # every empty lane otherwise)
+        outs = EventBatch(
+            outs.ts, outs.kind, outs.valid & used[:, None], outs.cols
+        )
+        if "next_timer" in auxs:
+            auxs = {
+                **auxs,
+                "next_timer": jnp.where(
+                    used, auxs["next_timer"], jnp.int64(NO_TIMER)
+                ),
+            }
+        return states2, outs, _reduce_paux(auxs)
+
+    def prime(self, now: int) -> dict:
+        """Arm absent-at-start deadlines across every partition lane."""
+        from siddhi_tpu.core.query_runtime import BaseQueryRuntime
+
+        with self._receive_lock:
+            if self.state is None:
+                self.state = BaseQueryRuntime._fresh(self.init_state(now))
+            t = jax.vmap(self.prog.next_timer)(self.state["tok"]).min()
+        return {"next_timer": t}
+
+    def receive_timer_partitioned(self, ptable, batch: EventBatch, t_ms: int):
+        with self._receive_lock:
+            if self.state is None:
+                from siddhi_tpu.core.query_runtime import BaseQueryRuntime
+
+                self.state = BaseQueryRuntime._fresh(self.init_state(t_ms))
+            if not hasattr(self, "_ptimer"):
+                self._ptimer = jax.jit(self._ptimer_impl, donate_argnums=(0,))
+            self.state, outs, aux = self._ptimer(
+                self.state, ptable["used"], batch, jnp.asarray(t_ms, jnp.int64)
+            )
+        self._warn_aux(aux)
+        return _flatten(outs), aux
 
     def receive_partitioned(self, ptable, batch: EventBatch, now: int, stream_id: str):
         with self._receive_lock:
@@ -493,7 +537,6 @@ class PartitionRuntime:
         app.queries[qid] = qr
 
         out = query.output_stream
-        self._check_output_target(query, allow_inner=True)
         inner_target = isinstance(out, InsertIntoStream) and out.is_inner
         if inner_target:
             self.inner_schemas[out.target] = StreamSchema(
@@ -516,11 +559,14 @@ class PartitionRuntime:
             app._wire_insert(qr)
 
         decode = app._decode
+        table_apply = self._attach_table_output(qr, query)
 
         if is_inner:
             def recv_inner(p_out, now, _qr=qr):
                 flat, p_out2, aux = _qr.receive_inner(p_out, now)
                 self._route(_qr, flat, p_out2, now, decode)
+                if table_apply is not None:
+                    table_apply(flat, now)
                 app._maybe_schedule(_qr, aux)
 
             self.inner_subscribers[stream.stream_id].append(recv_inner)
@@ -546,6 +592,8 @@ class PartitionRuntime:
                         self.ptable, batch, now
                     )
                     self._route(_qr, flat, p_out, now, decode)
+                    if table_apply is not None:
+                        table_apply(flat, now)
                 app._maybe_schedule(_qr, aux)
 
             app._junction(stream.stream_id).subscribe(receive)
@@ -564,19 +612,49 @@ class PartitionRuntime:
 
     def _check_output_target(self, query: Query, allow_inner: bool = False) -> None:
         out = query.output_stream
-        target = getattr(out, "target", None)
         if not allow_inner and getattr(out, "is_inner", False):
             raise SiddhiAppCreationError(
                 "#inner outputs from joins/patterns inside partitions are "
                 "not supported yet"
             )
-        if target is not None and target in self.app.tables:
-            raise SiddhiAppCreationError(
-                "writing to a table from inside a partition is not supported yet"
-            )
+
+    def _attach_table_output(self, qr, query: Query):
+        """Table writes from inside a partition apply OUTSIDE the vmapped
+        step, on the flattened [P*K] output: every partition's rows merge
+        into the ONE shared table in output order (reference: cloned inner
+        runtimes all write the same shared table instance,
+        PartitionRuntime.java:256-315 + TablePartitionTestCase).
+
+        Returns an `apply(flat_batch, now)` host hook, or None."""
+        from siddhi_tpu.core.table import compile_table_output
+
+        app = self.app
+        top = compile_table_output(
+            query.output_stream, qr.out_schema, app.tables, app.interner
+        )
+        if top is None:
+            return None
+        target = query.output_stream.target
+        tids = sorted(app.tables)
+
+        @jax.jit
+        def step(tstates, batch, now):
+            aux = {}
+            return top(tstates, batch, now, aux), aux
+
+        def apply(flat: EventBatch, now: int) -> None:
+            tstates = {tid: app.tables[tid].state for tid in tids}
+            tstates, aux = step(tstates, flat, jnp.asarray(now, jnp.int64))
+            for tid in tids:
+                app.tables[tid].state = tstates[tid]
+            app.tables[target].notify_change()
+            qr._warn_aux(aux)
+
+        return apply
 
     def _add_join_query(self, qid: str, query: Query) -> None:
         app = self.app
+        self._check_output_target(query)
         join = query.input_stream
         schemas = []
         key_by_side = {}
@@ -598,7 +676,6 @@ class PartitionRuntime:
                 )
             key_by_side[side] = kf
             schemas.append(sch)
-        self._check_output_target(query)
         qr = PartitionedJoinQueryRuntime(
             query, qid, schemas[0], schemas[1], app.interner,
             p_capacity=self.p, key_of_by_side=key_by_side,
@@ -609,6 +686,7 @@ class PartitionRuntime:
         app.queries[qid] = qr
         app._wire_insert(qr)
         decode = app._decode
+        table_apply = self._attach_table_output(qr, query)
 
         def receive_side(batch: EventBatch, now: int, side: str, _qr=qr) -> None:
             with app._process_lock:
@@ -616,6 +694,8 @@ class PartitionRuntime:
                     self.ptable, batch, now, side
                 )
                 _qr.route_output(flat, now, decode)
+                if table_apply is not None:
+                    table_apply(flat, now)
 
         if join.left.stream_id == join.right.stream_id:
             j = app._junction(join.left.stream_id)
@@ -645,6 +725,7 @@ class PartitionRuntime:
         app.queries[qid] = qr
         app._wire_insert(qr)
         decode = app._decode
+        table_apply = self._attach_table_output(qr, query)
 
         def receive(batch: EventBatch, now: int, sid: str, _qr=qr) -> None:
             with app._process_lock:
@@ -652,11 +733,30 @@ class PartitionRuntime:
                     self.ptable, batch, now, sid
                 )
                 _qr.route_output(flat, now, decode)
+                if table_apply is not None:
+                    table_apply(flat, now)
+                app._maybe_schedule(_qr, aux)
 
         for sid in qr.prog.stream_ids:
             app._junction(sid).subscribe(
                 lambda b, now, _sid=sid: receive(b, now, _sid)
             )
+
+        if qr.needs_scheduler:
+            from siddhi_tpu.core.app_runtime import _pattern_timer_batch
+
+            def fire(t_ms: int, _qr=qr) -> None:
+                batch = _pattern_timer_batch(t_ms)
+                with app._process_lock:
+                    flat, aux = _qr.receive_timer_partitioned(
+                        self.ptable, batch, t_ms
+                    )
+                    _qr.route_output(flat, t_ms, decode)
+                    if table_apply is not None:
+                        table_apply(flat, t_ms)
+                app._maybe_schedule(_qr, aux)
+
+            qr.timer_target = fire
 
     def _route(self, qr, flat: EventBatch, p_out, now: int, decode) -> None:
         if qr.inner_publish is not None:

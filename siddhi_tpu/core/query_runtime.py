@@ -209,6 +209,9 @@ class BaseQueryRuntime:
             target = f"__ret_{query_id}"
         self.out_schema = StreamSchema(target, self.selector.out_attrs)
         self.output_events = out.output_events
+        # ungrouped batch-mode collapse needs the kind filter at selector level
+        # (reference: QuerySelector currentOn/expiredOn gate lastEvent)
+        self.selector.output_events_for_batch = out.output_events
         self.query_callbacks: list[Callable] = []
         self.publish_fn: Optional[Callable] = None
         self._receive_lock = threading.RLock()

@@ -21,16 +21,37 @@ from siddhi_tpu.core.errors import SiddhiAppCreationError
 
 
 class RecordStore:
-    """SPI: durable backing for one table."""
+    """SPI: durable backing for one table.
+
+    Two operating modes (reference: AbstractRecordTable vs
+    AbstractQueryableRecordTable):
+    - MATERIALIZED (default): `load()` returns the full row list; the device
+      columnar arena is the working copy and every probe is a fused on-device
+      scan — condition pushdown is unnecessary, the store is durability.
+    - LAZY/QUERYABLE: `load()` returns None ("too big to materialize");
+      store queries then push their on-condition down via `query()` and only
+      the matching rows are staged onto the device for the select phase.
+      Streaming writes into a lazy store are rejected at runtime."""
 
     def init(self, table_id: str, schema, options: dict) -> None:
         self.table_id = table_id
         self.schema = schema
         self.options = options
 
-    def load(self) -> list[tuple]:
-        """Initial table contents (rows of python values, schema order)."""
+    def load(self) -> Optional[list[tuple]]:
+        """Initial table contents (rows of python values, schema order), or
+        None to stay lazy and serve finds through `query()`."""
         return []
+
+    def query(self, on_expression, interner) -> Optional[list[tuple]]:
+        """Condition pushdown for lazy stores: rows matching the store
+        query's raw `on` Expression AST (None AST = all rows). Return None
+        when the condition cannot be pushed down — the engine then raises
+        (a lazy store without pushdown cannot be probed). The device re-checks
+        the condition, so over-returning rows is always safe
+        (reference: ExpressionBuilder -> CompiledExpression in
+        AbstractQueryableRecordTable)."""
+        return None
 
     def on_change(self, rows: list[tuple]) -> None:
         """Write-through: the table's full row snapshot after a mutation."""

@@ -219,6 +219,28 @@ class CompiledSelector:
             valid = keep_last_per_group(
                 [ctx.key, flow.batch.kind.astype(jnp.int32), seg], valid
             )
+        elif self.batch_mode and self.aggregators:
+            # batch + aggregators + no group-by: only the LAST allowed-kind
+            # event of each flush chunk survives, carrying the final running
+            # aggregate (reference: QuerySelector.processInBatchNoGroupBy —
+            # lastEvent spans kinds, restricted by currentOn/expiredOn)
+            from siddhi_tpu.query_api.execution import OutputEventsFor
+
+            # a flush CHUNK is [prev-bucket EXPIREDs, RESET, bucket CURRENTs]:
+            # expireds precede their reset, so they shift one segment forward
+            # to land with their flush's currents
+            kind = flow.batch.kind
+            seg = jnp.cumsum(flow.reset.astype(jnp.int32)) + (
+                kind == KIND_EXPIRED
+            ).astype(jnp.int32)
+            want = getattr(self, "output_events_for_batch", None)
+            if want is OutputEventsFor.EXPIRED:
+                allowed = valid & (kind == KIND_EXPIRED)
+            elif want is OutputEventsFor.ALL:
+                allowed = valid
+            else:  # CURRENT (the reference default)
+                allowed = valid & (kind == KIND_CURRENT)
+            valid = keep_last_per_group([seg], allowed)
 
         # per-group rate limiters need each row's group key beside it
         # (reference: GroupByKeyGenerator key threading into rate limiters)

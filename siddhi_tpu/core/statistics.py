@@ -75,6 +75,10 @@ class StatisticsManager:
         self.throughput: dict[str, ThroughputTracker] = {}
         self.latency: dict[str, LatencyTracker] = {}
         self.buffered: dict[str, BufferedEventsTracker] = {}
+        # name -> () -> bytes; the TPU-native analog of the reference's
+        # ObjectSizeCalculator memory metric (util/statistics/memory/):
+        # device-buffer bytes held by each component's carried state
+        self.memory: dict[str, callable] = {}
         self.enabled = True
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -88,9 +92,19 @@ class StatisticsManager:
     def buffered_tracker(self, name: str) -> BufferedEventsTracker:
         return self.buffered.setdefault(name, BufferedEventsTracker(name))
 
+    def register_memory(self, name: str, fn) -> None:
+        """fn() -> device bytes held by the named component's state."""
+        self.memory[name] = fn
+
     # ---- reporting ---------------------------------------------------------
 
     def report(self) -> dict:
+        mem = {}
+        for n, fn in self.memory.items():
+            try:
+                mem[n] = int(fn())
+            except Exception:
+                mem[n] = -1
         return {
             "app": self.app_name,
             "throughput": {n: t.count for n, t in self.throughput.items()},
@@ -98,6 +112,7 @@ class StatisticsManager:
                 n: round(t.avg_ms, 3) for n, t in self.latency.items()
             },
             "buffered": {n: t.get_size() for n, t in self.buffered.items()},
+            "memory_bytes": mem,
         }
 
     def start_reporting(self) -> None:

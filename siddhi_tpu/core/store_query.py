@@ -43,6 +43,7 @@ class StoreQueryRuntime:
         aggregations: dict | None = None,
     ):
         store = sq.input_store
+        self._sq = sq
         self.no_from = store is None
         if self.no_from and sq.output_stream is None:
             raise SiddhiAppCreationError(
@@ -190,6 +191,34 @@ class StoreQueryRuntime:
 
     def execute(self, now: int) -> list[Event]:
         tstates = {tid: t.state for tid, t in self.tables.items()}
+        for tid, t in self.tables.items():
+            if getattr(t, "lazy", False):
+                # queryable lazy store: push the on-condition down and stage
+                # only the matching rows (the device re-applies the condition)
+                on = self._sq.input_store.on if (
+                    self._sq.input_store is not None
+                    and self._sq.input_store.store_id == tid
+                ) else None
+                rows = t.record_store.query(on, self.interner)
+                if rows is None:
+                    raise SiddhiAppCreationError(
+                        f"table '{tid}': lazy record store did not push the "
+                        "condition down (query() returned None)"
+                    )
+                if len(rows) > t.capacity:
+                    raise SiddhiAppCreationError(
+                        f"table '{tid}': pushdown returned {len(rows)} rows "
+                        f"but capacity is {t.capacity}; narrow the condition "
+                        "or raise @capacity(size='N')"
+                    )
+                st = t.init_state()
+                if rows:
+                    batch = t.schema.to_batch(
+                        [0] * len(rows), rows, self.interner,
+                        capacity=len(rows),
+                    )
+                    st = t.insert(st, batch, {})
+                tstates[tid] = st
         if self.is_agg:
             batch = self.table.find(self.per, self.within, now)
             if not hasattr(self, "_agg_step"):
@@ -204,6 +233,8 @@ class StoreQueryRuntime:
                 tstates[self.table.table_id] = self.table.state
             tstates, out = self._step(tstates, jnp.asarray(now, dtype=jnp.int64))
         for tid, t in self.tables.items():
+            if getattr(t, "lazy", False):
+                continue  # staged pushdown subsets never become live state
             t.state = tstates[tid]  # windows are read-only: not written back
         if self.table_op is not None and self._write_target in self.tables:
             self.tables[self._write_target].notify_change()

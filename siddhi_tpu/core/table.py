@@ -90,6 +90,7 @@ class InMemoryTable:
         # write a snapshot through after each mutation (reference:
         # AbstractRecordTable SPI; see core/record_table.py)
         self.record_store = None
+        self.lazy = False
         store_ann = find_annotation(definition.annotations, "store")
         if store_ann is not None:
             from siddhi_tpu.core.record_table import build_record_store
@@ -98,18 +99,23 @@ class InMemoryTable:
                 store_ann, self.table_id, self.schema
             )
             rows = self.record_store.load()
-            if len(rows) > self.capacity:
-                raise SiddhiAppCreationError(
-                    f"table '{self.table_id}': record store holds "
-                    f"{len(rows)} rows but capacity is {self.capacity}; "
-                    "raise it with @capacity(size='N') before restarting"
-                )
-            if rows:
-                batch = self.schema.to_batch(
-                    [0] * len(rows), rows, interner, capacity=len(rows)
-                )
-                aux: dict = {}
-                self.state = self.insert(self.state, batch, aux)
+            if rows is None:
+                # lazy/queryable store: finds push conditions down, nothing
+                # materializes (see record_table.RecordStore)
+                self.lazy = True
+            else:
+                if len(rows) > self.capacity:
+                    raise SiddhiAppCreationError(
+                        f"table '{self.table_id}': record store holds "
+                        f"{len(rows)} rows but capacity is {self.capacity}; "
+                        "raise it with @capacity(size='N') before restarting"
+                    )
+                if rows:
+                    batch = self.schema.to_batch(
+                        [0] * len(rows), rows, interner, capacity=len(rows)
+                    )
+                    aux: dict = {}
+                    self.state = self.insert(self.state, batch, aux)
         self._dirty = False
         self._last_flush = 0.0
         self._flush_lock = threading.Lock()
@@ -121,6 +127,12 @@ class InMemoryTable:
         every mutating step). flush_record_store() forces the write."""
         if self.record_store is None:
             return
+        if self.lazy:
+            raise SiddhiAppCreationError(
+                f"table '{self.table_id}': a lazy (queryable) record store "
+                "cannot accept streaming writes; materialize it or write to "
+                "the store directly"
+            )
         import threading as _threading
         import time as _time
 
@@ -471,9 +483,12 @@ def compile_table_output(
                 keep = out_batch.kind == jnp.int8(1)  # KIND_EXPIRED
             else:
                 keep = jnp.ones_like(out_batch.valid)
+            # positional mapping rides the OUT SCHEMA order, not the cols
+            # dict order (jit pytree reconstruction sorts dict keys, so a
+            # batch crossing a jit boundary arrives alphabetized)
             cols = {
-                n: c.astype(dtypes[n])
-                for n, c in zip(names, out_batch.cols.values())
+                n: out_batch.cols[sn].astype(dtypes[n])
+                for n, sn in zip(names, out_schema.attr_names)
             }
             renamed = EventBatch(
                 out_batch.ts,

@@ -1,0 +1,71 @@
+"""Golden corpus: comparison typing + null checks, translated from the
+reference test data (reference: siddhi-core/src/test/.../query/
+StringCompareTestCase.java — all 30 string-vs-numeric comparisons must be
+rejected at app creation — and IsNullTestCase.java)."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+OPS = ["x > y", "x < y", "x >= y", "x <= y", "x == y", "x != y"]
+DEFS = [
+    "x string, y int",
+    "x int, y string",
+    "x long, y string",
+    "x float, y string",
+    "x double, y string",
+]
+
+
+@pytest.mark.parametrize("fields", DEFS)
+@pytest.mark.parametrize("cond", OPS)
+def test_string_numeric_compare_rejected(cond, fields):
+    mgr = SiddhiManager()
+    with pytest.raises((SiddhiAppCreationError, TypeError)):
+        mgr.create_siddhi_app_runtime(f"""
+        define stream cseEventStream ({fields});
+        @info(name = 'query1')
+        from cseEventStream[{cond}]
+        select x insert into outputStream;
+        """)
+
+
+class TestIsNullGolden:
+    def test_is_null_filter(self):
+        # IsNullTestCase.testIsNullStreamConditionCase1
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from cseEventStream[symbol is null]
+        select symbol, price
+        insert into outputStream;
+        """)
+        got = []
+        rt.add_callback("query1", lambda ts, i, r: got.extend(tuple(e.data) for e in i or []))
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(("IBM", 700.0, 100))
+        h.send((None, 60.5, 200))
+        h.send(("WSO2", 60.5, 200))
+        rt.shutdown()
+        assert len(got) == 1 and got[0][0] is None, got
+
+    def test_is_not_null_filter(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream cseEventStream (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from cseEventStream[not (symbol is null)]
+        select symbol, price
+        insert into outputStream;
+        """)
+        got = []
+        rt.add_callback("query1", lambda ts, i, r: got.extend(tuple(e.data) for e in i or []))
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(("IBM", 700.0, 100))
+        h.send((None, 60.5, 200))
+        rt.shutdown()
+        assert len(got) == 1 and got[0][0] == "IBM", got

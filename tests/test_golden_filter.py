@@ -1,0 +1,31 @@
+"""Golden corpus: filter queries, data-driven from the reference's filter test
+corpus (see tests/golden_filter_data.py). Each case runs the reference's exact
+condition over its exact input rows and checks the match count."""
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+from tests.golden_filter_data import CASES
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_filter_golden(case):
+    name, schema, cond, sel, rows, expected = case
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(f"""
+    define stream cseEventStream ({schema});
+    @info(name = 'query1')
+    from cseEventStream[{cond}]
+    select {sel}
+    insert into outputStream;
+    """)
+    got = []
+    rt.add_callback("query1", lambda ts, i, r: got.extend(i or []))
+    rt.start()
+    h = rt.get_input_handler("cseEventStream")
+    for row in rows:
+        h.send(row)
+    rt.shutdown()
+    mgr.shutdown()
+    assert len(got) == expected, (name, cond, [tuple(e.data) for e in got])

@@ -1,0 +1,228 @@
+"""Golden corpus: partitions, translated from the reference test data
+(reference: siddhi-core/src/test/.../query/partition/{PartitionTestCase1,
+WindowPartitionTestCase,PatternPartitionTestCase}.java)."""
+
+import pytest
+
+from tests.test_golden_count import assert_rows, run_app
+
+
+class TestPartitionGolden:
+    def test_query0_value_partition_passthrough(self):
+        ql = """
+        define stream streamA (symbol string, price int);
+        partition with (symbol of streamA)
+        begin
+            @info(name = 'query1')
+            from streamA select symbol, price insert into StockQuote ;
+        end;
+        """
+        got = run_app(ql, [
+            ("streamA", ("IBM", 700)),
+            ("streamA", ("WSO2", 60)),
+            ("streamA", ("WSO2", 60)),
+        ])
+        assert len(got) == 3, got
+
+    def test_query1_per_key_running_sum(self):
+        # PartitionTestCase1.testPartitionQuery1: sum accumulates per key;
+        # the filtered-out WSO2 event contributes nothing
+        ql = """
+        define stream cseEventStream (symbol string, price float, volume long);
+        partition with (symbol of cseEventStream)
+        begin
+            @info(name = 'query1')
+            from cseEventStream[700 > price]
+            select symbol, sum(price) as price, volume
+            insert into OutStockStream ;
+        end;
+        """
+        got = run_app(ql, [
+            ("cseEventStream", ("IBM", 75.6, 100)),
+            ("cseEventStream", ("WSO2", 70005.6, 100)),
+            ("cseEventStream", ("IBM", 75.6, 100)),
+            ("cseEventStream", ("ORACLE", 75.6, 100)),
+        ])
+        assert len(got) == 3, got
+        sums = [round(g[1], 3) for g in got]
+        assert sums == [75.6, 151.2, 75.6], got
+
+    def test_window_partition1_length_expired(self):
+        # WindowPartitionTestCase.testWindowPartitionQuery1: per-key length(2)
+        # expired events
+        ql = """
+        define stream cseEventStream (symbol string, price float, volume int);
+        partition with (symbol of cseEventStream)
+        begin
+            @info(name = 'query1')
+            from cseEventStream#window.length(2)
+            select symbol, sum(price) as price, volume
+            insert expired events into OutStockStream ;
+        end;
+        """
+        from siddhi_tpu import SiddhiManager
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        removed = []
+        rt.add_callback(
+            "query1",
+            lambda ts, i, r: removed.extend(tuple(e.data) for e in r or []),
+        )
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        for row in [
+            ("IBM", 70.0, 100), ("WSO2", 700.0, 100), ("IBM", 100.0, 100),
+            ("IBM", 200.0, 100), ("ORACLE", 75.6, 100), ("WSO2", 1000.0, 100),
+            ("WSO2", 500.0, 100),
+        ]:
+            h.send(row)
+        rt.shutdown()
+        assert len(removed) == 2, removed
+        # evicted IBM(70): per-key window now holds 100,200 -> sum 300 minus
+        # the expiring 70 leaves the running value the reference reports
+        assert round(removed[0][1], 1) == 100.0, removed
+        assert round(removed[1][1], 1) == 1000.0, removed
+
+    def test_window_partition2_length_batch(self):
+        ql = """
+        define stream cseEventStream (symbol string, price float, volume int);
+        partition with (symbol of cseEventStream)
+        begin
+            @info(name = 'query1')
+            from cseEventStream#window.lengthBatch(2)
+            select symbol, sum(price) as price, volume
+            insert all events into OutStockStream ;
+        end;
+        """
+        got = run_app(ql, [
+            ("cseEventStream", ("IBM", 70.0, 100)),
+            ("cseEventStream", ("WSO2", 700.0, 100)),
+            ("cseEventStream", ("IBM", 100.0, 100)),
+            ("cseEventStream", ("IBM", 200.0, 100)),
+            ("cseEventStream", ("WSO2", 1000.0, 100)),
+        ])
+        assert len(got) == 2, got
+        assert round(got[0][1], 1) == 170.0, got
+        assert round(got[1][1], 1) == 1700.0, got
+
+    def test_pattern_partition_counts_per_key(self):
+        # PatternPartitionTestCase.testPatternPartitionQuery1 analog: an
+        # A->B chain completes only within one key's lane
+        ql = """
+        define stream Stream1 (symbol string, price float, volume int);
+        partition with (symbol of Stream1)
+        begin
+            @info(name = 'query1')
+            from every e1=Stream1[price>20] -> e2=Stream1[price>e1.price]
+            select e1.price as price1, e2.price as price2
+            insert into OutputStream ;
+        end;
+        """
+        got = run_app(ql, [
+            ("Stream1", ("IBM", 55.0, 100)),
+            ("Stream1", ("WSO2", 85.0, 100)),
+            ("Stream1", ("IBM", 75.0, 100)),   # completes IBM chain
+            ("Stream1", ("WSO2", 65.0, 100)),  # below 85 -> WSO2 waits
+        ])
+        assert len(got) == 1, got
+        assert round(got[0][0], 1) == 55.0 and round(got[0][1], 1) == 75.0, got
+
+
+class TestPartitionInteriorGolden:
+    def test_time_window_in_partition_playback(self):
+        # WindowPartitionTestCase.testWindowPartitionQuery3 analog under the
+        # playback clock: per-key time windows expire independently
+        from siddhi_tpu import SiddhiManager
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""@app:playback
+        define stream cseEventStream (symbol string, price float, volume int);
+        partition with (symbol of cseEventStream)
+        begin
+            @info(name = 'query1')
+            from cseEventStream#window.time(1 sec)
+            select symbol, sum(price) as price
+            insert all events into OutStockStream ;
+        end;
+        """)
+        ins = []
+        rt.add_callback(
+            "query1", lambda ts, i, r: ins.extend(tuple(e.data) for e in i or [])
+        )
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(("IBM", 70.0, 100), timestamp=1000)
+        h.send(("WSO2", 700.0, 100), timestamp=1100)
+        h.send(("IBM", 100.0, 200), timestamp=1200)
+        h.send(("IBM", 200.0, 300), timestamp=2300)   # IBM 70+100 expired
+        h.send(("WSO2", 1000.0, 100), timestamp=2400)  # WSO2 700 expired
+        rt.shutdown()
+        mgr.shutdown()
+        sums = [round(r[1], 1) for r in ins]
+        assert sums == [70.0, 700.0, 170.0, 200.0, 1000.0], ins
+
+    def test_table_write_in_partition(self):
+        # TablePartitionTestCase analog: per-key queries write ONE shared table
+        from siddhi_tpu import SiddhiManager
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        define table T (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            @info(name = 'q')
+            from S[price > 10]
+            select symbol, price
+            insert into T;
+        end;
+        @info(name = 'reader')
+        from S[price < 0] select symbol, price insert into Sink;
+        """)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(("IBM", 70.0))
+        h.send(("WSO2", 700.0))
+        h.send(("IBM", 5.0))    # filtered out
+        h.send(("ORACLE", 30.0))
+        rows = rt.query("from T select symbol, price")
+        rt.shutdown()
+        mgr.shutdown()
+        got = sorted((e.data[0], round(e.data[1], 1)) for e in rows)
+        assert got == [("IBM", 70.0), ("ORACLE", 30.0), ("WSO2", 700.0)], got
+
+    def test_absent_pattern_in_partition(self):
+        # per-key absent: only the key with no follow-up B emits
+        import time as _t
+
+        from siddhi_tpu import SiddhiManager
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream A (symbol string, price float);
+        define stream B (symbol string, price float);
+        partition with (symbol of A, symbol of B)
+        begin
+            @info(name = 'q')
+            from e1=A[price>20] -> not B[price>20] for 150 milliseconds
+            select e1.symbol as s
+            insert into Out;
+        end;
+        """)
+        got = []
+        rt.add_callback("q", lambda ts, i, r: got.extend(tuple(e.data) for e in i or []))
+        rt.start()
+        ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
+        # warm both streams' compiled steps with inert rows
+        ha.send(("W", 5.0)); hb.send(("W", 5.0))
+        ha.send(("IBM", 50.0))
+        ha.send(("WSO2", 60.0))
+        hb.send(("IBM", 90.0))   # kills IBM's absent wait; WSO2's survives
+        # the first timer fire compiles the vmapped timer step — poll
+        t0 = _t.time()
+        while not got and _t.time() - t0 < 30.0:
+            _t.sleep(0.1)
+        rt.shutdown()
+        mgr.shutdown()
+        assert got == [("WSO2",)], got

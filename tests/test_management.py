@@ -141,3 +141,25 @@ class TestDebugger:
         assert state is not None
         rt.shutdown()
         mgr.shutdown()
+
+
+def test_statistics_report_includes_memory():
+    # TPU-native analog of the reference's ObjectSizeCalculator memory metric:
+    # per-component device-buffer bytes in the stats report
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+    @app:statistics(reporter='none')
+    define stream S (v long);
+    define table T (v long);
+    @info(name='q') from S#window.length(4) select sum(v) as s insert into Out;
+    """)
+    rt.start()
+    rt.get_input_handler("S").send((1,))
+    rep = rt.statistics_manager.report()
+    assert "memory_bytes" in rep
+    assert rep["memory_bytes"].get("query.q", 0) > 0, rep
+    assert "table.T" in rep["memory_bytes"], rep
+    rt.shutdown()
+    mgr.shutdown()

@@ -323,3 +323,50 @@ class TestStoreQueryInsert:
         assert [e.data for e in rows] == [("IBM", 75.5, 10)]
         rt.shutdown()
         mgr.shutdown()
+
+
+class TestLazyQueryableStore:
+    def test_lazy_store_pushdown(self):
+        # reference: AbstractQueryableRecordTable — a store too big to
+        # materialize serves finds through condition pushdown
+        from siddhi_tpu.core.extension import extension
+        from siddhi_tpu.core.record_table import RecordStore
+        from siddhi_tpu.query_api.expression import Compare, CompareOp, Constant, Variable
+
+        calls = []
+
+        @extension("store", "bigmock")
+        class BigMockStore(RecordStore):
+            ROWS = [(f"S{i}", i) for i in range(10_000)]
+
+            def load(self):
+                return None  # lazy
+
+            def query(self, on, interner):
+                calls.append(on)
+                if on is None:
+                    return list(self.ROWS)
+                # understand `volume > <const>` pushdown
+                if (
+                    isinstance(on, Compare)
+                    and on.op is CompareOp.GT
+                    and isinstance(on.left, Variable)
+                    and isinstance(on.right, Constant)
+                ):
+                    return [r for r in self.ROWS if r[1] > on.right.value]
+                return None
+
+        from siddhi_tpu import SiddhiManager
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream S (symbol string, volume long);
+        @store(type='bigmock')
+        define table T (symbol string, volume long);
+        """)
+        rt.start()
+        rows = rt.query("from T on volume > 9997L select symbol, volume")
+        rt.shutdown()
+        mgr.shutdown()
+        assert len(calls) == 1 and calls[0] is not None
+        assert sorted(e.data for e in rows) == [("S9998", 9998), ("S9999", 9999)]

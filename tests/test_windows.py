@@ -81,10 +81,12 @@ def test_length_batch_window():
     h = rt.get_input_handler("S")
     for v in [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0]:
         h.send(("A", v))
-    # flush 1: currents 1,3,6 (running within bucket); flush 2: 4,9,15
-    assert [e.data[1] for e in got["in"]] == [1.0, 3.0, 6.0, 4.0, 9.0, 15.0]
-    # second flush expires the first bucket
-    assert len(got["removed"]) == 3
+    # batch + aggregator + no group-by: only the LAST chunk event survives,
+    # carrying the bucket's final aggregate (reference:
+    # QuerySelector.processInBatchNoGroupBy lastEvent)
+    assert [e.data[1] for e in got["in"]] == [6.0, 15.0]
+    # the final CURRENT wins the chunk, so no expired rows are emitted
+    assert len(got["removed"]) == 0
     mgr.shutdown()
 
 
@@ -98,8 +100,8 @@ def test_length_batch_across_large_send():
     )
     got = collect(rt, "q")
     rt.get_input_handler("S").send_many([(i,) for i in range(1, 8)])  # 1..7
-    # buckets (1,2), (3,4), (5,6); 7 pending
-    assert [e.data[0] for e in got["in"]] == [1, 3, 3, 7, 5, 11]
+    # buckets (1,2), (3,4), (5,6); 7 pending — one final sum per flush
+    assert [e.data[0] for e in got["in"]] == [3, 7, 11]
     mgr.shutdown()
 
 
@@ -190,8 +192,8 @@ def test_time_batch_event_driven():
     h.send((1400, 2.0), timestamp=1400)
     h.send((2100, 4.0), timestamp=2100)  # crosses boundary -> flush bucket 1
     h.send((3050, 8.0), timestamp=3050)  # crosses -> flush bucket 2
-    # flushes emit bucket sums (running within flush chunk)
-    assert [e.data[0] for e in got["in"]] == [1.0, 3.0, 4.0]
+    # flushes emit one final bucket sum each (processInBatchNoGroupBy)
+    assert [e.data[0] for e in got["in"]] == [3.0, 4.0]
     mgr.shutdown()
 
 
