@@ -154,7 +154,7 @@ WORKLOADS = {
 }
 
 
-def _table_scaling(rows_list=(100_000, 1_000_000), batch=1024, batches=24):
+def _table_scaling(rows_list=(100_000, 1_000_000), batch=8192, batches=12):
     """Events/s of a stream query probing+updating a table at capacity N
     (VERDICT r1 item 9: evidence for the exhaustive-scan-vs-index decision;
     reference analog: table/holder/IndexEventHolder primary-key fast path)."""
