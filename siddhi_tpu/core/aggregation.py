@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.lax as lax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.event import (
@@ -93,7 +94,7 @@ def align_bucket(ts_ms, duration: Duration):
     (reference: util/IncrementalTimeConverterUtil.getStartTimeOfAggregates)."""
     ts_ms = jnp.asarray(ts_ms, jnp.int64)
     if duration not in (Duration.MONTHS, Duration.YEARS):
-        step = jnp.int64(duration.millis)
+        step = np.int64(duration.millis)
         return jnp.floor_divide(ts_ms, step) * step
     days = jnp.floor_divide(ts_ms, _DAY_MS)
     y, m, _d = _civil_from_days(days)
@@ -580,7 +581,7 @@ class AggregationRuntime:
         }
         (stores, spills, spill_ns, ovf), _ = lax.scan(
             body,
-            (state["stores"], spill0, spill_n0, jnp.bool_(False)),
+            (state["stores"], spill0, spill_n0, np.bool_(False)),
             xs,
         )
 
@@ -595,7 +596,7 @@ class AggregationRuntime:
             aux["next_timer"] = jnp.where(
                 stores[0]["bucket"] >= 0,
                 stores[0]["bucket"] + d0.millis,
-                jnp.int64(_I64MAX),
+                np.int64(_I64MAX),
             )
         return (
             {"stores": stores, "spill": spills, "spill_n": spill_ns},
@@ -684,7 +685,7 @@ class AggregationRuntime:
         # merge in-flight stores (finest..per) into one temp store aligned to per
         temp = dict(self._empty)
         temp = {**temp, "bucket": jnp.full((), -1, jnp.int64)}
-        ovf = jnp.bool_(False)
+        ovf = np.bool_(False)
         for di in range(per_idx + 1):
             st = state["stores"][di]
             has = st["bucket"] >= 0
@@ -785,7 +786,7 @@ class AggFindable:
 
     def view(self, packed):
         out = self.agg._find_impl(
-            self.per, packed["agg"], packed["table"], jnp.int64(0)
+            self.per, packed["agg"], packed["table"], np.int64(0)
         )
         valid = out.valid
         if self.within is not None:

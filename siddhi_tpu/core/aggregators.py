@@ -16,6 +16,7 @@ import dataclasses
 from typing import Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.executor import CompiledExpr, Env
 from siddhi_tpu.core.groupby import CompiledGroupBy, GroupCtx
@@ -74,7 +75,9 @@ class CompiledAggregator:
 
 
 def _null_arr(t: AttrType):
-    return jnp.asarray(null_value(t), dtype=PHYSICAL_DTYPE[t])
+    # numpy (NOT jnp): trace-time const — a jax.Array here would degrade
+    # every dispatch on tunneled backends (see executor._const_expr).
+    return np.asarray(null_value(t), dtype=PHYSICAL_DTYPE[t])
 
 
 class SumAggregator(CompiledAggregator):

@@ -322,6 +322,8 @@ class SiddhiAppRuntime:
             _t.publish_batch(rename(transform(out_batch)), now)
 
         qr.publish_fn = publish
+        # fused-ingest eligibility checks the live target junction directly
+        qr._insert_target_junction = target_junction
 
     def _timer_batch(self, schema: StreamSchema, t_ms: int) -> EventBatch:
         from siddhi_tpu.core.event import KIND_TIMER
@@ -403,6 +405,14 @@ class SiddhiAppRuntime:
             self._maybe_schedule(_qr, aux)
 
         in_junction.subscribe(receive)
+        from siddhi_tpu.core.ingest import FuseEndpoint
+
+        in_junction.fuse_candidates.append(FuseEndpoint(
+            qr,
+            impl_factory=lambda _qr=qr: _qr._step_impl,
+            init_state=lambda now, _qr=qr: _qr.init_state(),
+            latency_tracker=lt,
+        ))
 
         if qr.needs_scheduler:
             def fire(t_ms: int, _qr=qr, _schema=in_schema) -> None:
@@ -440,10 +450,18 @@ class SiddhiAppRuntime:
                 _qr.route_output(out_batch, now, decode)
             self._maybe_schedule(_qr, aux)
 
+        from siddhi_tpu.core.ingest import FuseEndpoint
+
         for sid in qr.prog.stream_ids:
-            self._junction(sid).subscribe(
+            sj = self._junction(sid)
+            sj.subscribe(
                 lambda b, now, _sid=sid: receive(b, now, _sid)
             )
+            sj.fuse_candidates.append(FuseEndpoint(
+                qr,
+                impl_factory=lambda _qr=qr, _sid=sid: _qr._make_step(_sid),
+                init_state=lambda now, _qr=qr: _qr.init_state(now),
+            ))
 
         if qr.needs_scheduler:
             def fire(t_ms: int, _qr=qr) -> None:
@@ -533,23 +551,63 @@ class SiddhiAppRuntime:
             if "next_timer" in aux:
                 self._schedule_at(aux, _qr.timer_targets.get(side))
 
+        from siddhi_tpu.core.ingest import FuseEndpoint
+
         # self-joins: one subscription drives left then right, in that order
         # (reference: JoinInputStreamParser self-join double dispatch)
         if join.left.stream_id == join.right.stream_id:
             j = self._junction(join.left.stream_id)
             j.subscribe(lambda b, now: (receive_side(b, now, "l"), receive_side(b, now, "r")))
+
+            def _both_sides_impl(_qr=qr):
+                import jax.numpy as jnp
+
+                def impl(st, tst, b, now):
+                    st, tst, _o1, aux1 = _qr._step_impl(st, tst, b, now, "l")
+                    st, tst, out, aux2 = _qr._step_impl(st, tst, b, now, "r")
+                    merged = dict(aux2)
+                    for k, v in aux1.items():
+                        if k == "next_timer":
+                            continue
+                        if k in merged:
+                            merged[k] = (
+                                jnp.asarray(v).astype(bool)
+                                | jnp.asarray(merged[k]).astype(bool)
+                            )
+                        else:
+                            merged[k] = v
+                    return st, tst, out, merged
+
+                return impl
+
+            j.fuse_candidates.append(FuseEndpoint(
+                qr, impl_factory=_both_sides_impl,
+                init_state=lambda now, _qr=qr: _qr.init_state(),
+            ))
         else:
             for side, stream in (("l", join.left), ("r", join.right)):
                 nw = qr.window_sides[side]
                 if nw is not None:
                     # named-window side: driven by the window's emissions
+                    # (no FuseEndpoint: that junction never sees send_columns,
+                    # and the missing candidate keeps it per-batch)
                     nw.out_junction.subscribe(
                         lambda b, now, _s=side: receive_side(b, now, _s)
                     )
                 elif not qr.table_sides[side]:
-                    self._junction(stream.stream_id).subscribe(
+                    sj = self._junction(stream.stream_id)
+                    sj.subscribe(
                         lambda b, now, _s=side: receive_side(b, now, _s)
                     )
+                    sj.fuse_candidates.append(FuseEndpoint(
+                        qr,
+                        impl_factory=lambda _qr=qr, _s=side: (
+                            lambda st, tst, b, now: _qr._step_impl(
+                                st, tst, b, now, _s
+                            )
+                        ),
+                        init_state=lambda now, _qr=qr: _qr.init_state(),
+                    ))
 
         for side, schema in qr.side_schemas.items():
             if qr.needs_scheduler[side]:
@@ -687,6 +745,16 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._running = True
+        # build per-junction fused ingest engines (core/ingest.py) for
+        # junctions where every subscriber registered a FuseEndpoint
+        from siddhi_tpu.core.ingest import FusedJunctionIngest
+
+        chunk = self._capacity_annotation("app:ingestChunk", 32)
+        for j in self.junctions.values():
+            if j.fuse_candidates and len(j.fuse_candidates) == len(j.subscribers):
+                j.fused_ingest = FusedJunctionIngest(
+                    self, j, j.fuse_candidates, chunk_batches=chunk
+                )
         if self.statistics_manager is not None:
             # device-memory metric per component (reference analog:
             # util/statistics/memory/ObjectSizeCalculator — here the bytes

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 
 import jax.lax as lax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.types import (
     NUMERIC_TYPES,
@@ -219,12 +220,16 @@ def _cast(x: jnp.ndarray, t: AttrType) -> jnp.ndarray:
 
 
 def _const_expr(value, t: AttrType, interner: InternTable) -> CompiledExpr:
+    # numpy (NOT jnp): a concrete jax.Array captured as a jaxpr const forces
+    # the PJRT dispatch path off its fast lane on some backends (measured
+    # ~2.5 ms/dispatch process-wide on tunneled TPUs); numpy consts embed as
+    # HLO literals and stay on the fast path.
     if t in (AttrType.STRING, AttrType.OBJECT):
-        dev = jnp.asarray(interner.intern(value), dtype=jnp.int32)
+        dev = np.asarray(interner.intern(value), dtype=np.int32)
     elif value is None:
-        dev = jnp.asarray(null_value(t), dtype=PHYSICAL_DTYPE[t])
+        dev = np.asarray(null_value(t), dtype=PHYSICAL_DTYPE[t])
     else:
-        dev = jnp.asarray(value, dtype=PHYSICAL_DTYPE[t])
+        dev = np.asarray(value, dtype=PHYSICAL_DTYPE[t])
     return CompiledExpr(t, lambda env: dev, const=value, is_const=True)
 
 
@@ -389,7 +394,7 @@ def _is_null_fn(ce: CompiledExpr):
         if t in (AttrType.STRING, AttrType.OBJECT):
             return v == 0
         if t in (AttrType.INT, AttrType.LONG):
-            return v == jnp.asarray(null_value(t), dtype=v.dtype)
+            return v == np.asarray(null_value(t), dtype=v.dtype)
         return jnp.zeros(jnp.shape(v), dtype=jnp.bool_)  # BOOL: never null
 
     return fn
@@ -556,7 +561,7 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
         isnull = _is_null_fn(src)
         return CompiledExpr(
             AttrType.BOOL,
-            lambda env: (~isnull(env)) & jnp.asarray(matches),
+            lambda env: (~isnull(env)) & np.asarray(matches),
         )
 
     if name in ("maximum", "minimum"):

@@ -1,0 +1,252 @@
+"""Fused columnar ingest: K micro-batches per transfer + dispatch.
+
+Reference analog: the @async Disruptor consumer batching events into
+EventExchangeHolders before the query chain runs them
+(stream/StreamJunction.java:262-298, util/event/handler/StreamHandler.java) —
+the TPU-shaped version aggregates K whole micro-batches into ONE contiguous
+host buffer, ONE host->device transfer, and ONE jitted dispatch whose
+`lax.scan` runs the junction's entire subscriber fan-out over the K batches
+with carried state.
+
+Why it exists: behind a network tunnel each transfer/dispatch pays a fixed
+relay overhead (measured 2.5-9 ms once the relay leaves its speculative fast
+mode), so per-micro-batch dispatch caps throughput regardless of device
+speed. Fusing K=32 batches amortizes that overhead 32x and keeps everything
+else identical: the scan body decodes sub-batch k and runs the same
+`_step_impl` chains the per-batch path runs, in the same order.
+
+Engagement is conservative: the fused path is used only when nothing
+host-side observes per-batch boundaries — no stream callbacks, no query
+callbacks, no rate limiters, no scheduler-armed windows/patterns, no live
+debugger, and the queries' insert targets have no consumers. Anything else
+falls back to the per-batch path with identical semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FuseEndpoint:
+    """One junction subscriber in fused form.
+
+    impl_factory() must return a pure step
+    `(state, tstates, batch, now) -> (state', tstates', out, aux)` — the same
+    function object the per-batch jit wraps.
+    """
+
+    def __init__(
+        self,
+        qr,
+        impl_factory: Callable[[], Callable],
+        init_state: Callable[[int], object],
+        latency_tracker=None,
+    ):
+        self.qr = qr
+        self.impl_factory = impl_factory
+        self.init_state = init_state
+        self.latency_tracker = latency_tracker
+
+
+def _needs_scheduler(qr) -> bool:
+    ns = getattr(qr, "needs_scheduler", False)
+    if isinstance(ns, dict):
+        return any(ns.values())
+    return bool(ns)
+
+
+class FusedJunctionIngest:
+    """Per-junction fused ingest engine (built at app start)."""
+
+    def __init__(self, app, junction, endpoints, chunk_batches: int = 32):
+        self.app = app
+        self.junction = junction
+        self.endpoints = list(endpoints)
+        self.K = max(2, int(chunk_batches))
+        self._fused = None
+        self._disabled = False
+        self._lock = threading.Lock()
+
+    # ---- eligibility (cheap dynamic checks, every send) ------------------
+
+    def eligible(self) -> bool:
+        j = self.junction
+        if j.is_async or j.stream_callbacks:
+            return False
+        if getattr(self.app, "_debugger", None) is not None:
+            return False
+        if len(j.subscribers) != len(self.endpoints):
+            return False  # an unfused subscriber is attached
+        for ep in self.endpoints:
+            qr = ep.qr
+            if ep.latency_tracker is not None:
+                return False
+            if getattr(qr, "rate_limiter", None) is not None:
+                return False
+            if getattr(qr, "query_callbacks", None):
+                return False
+            if _needs_scheduler(qr) or getattr(qr, "host_next_timer", None):
+                return False
+            tj = getattr(qr, "_insert_target_junction", None)
+            if tj is not None and (
+                tj.subscribers or tj.stream_callbacks
+                or tj.on_publish_stats is not None
+            ):
+                return False
+        return True
+
+    # ---- device program --------------------------------------------------
+
+    def _build(self):
+        B = self.junction.batch_size
+        schema = self.junction.schema
+        _encode, decode = schema.packed_codec(B)
+        impls = [ep.impl_factory() for ep in self.endpoints]
+
+        def fused(states, tstates, wire, counts, now):
+            def body(carry, xs):
+                sts, tst = carry
+                batch = decode(xs[0], xs[1])
+                new_states = []
+                auxes = []
+                for impl, st in zip(impls, sts):
+                    st2, tst, _out, aux = impl(st, tst, batch, now)
+                    new_states.append(st2)
+                    auxes.append(
+                        tuple(
+                            jnp.asarray(v).astype(bool).any()
+                            for k, v in sorted(aux.items())
+                            if k != "next_timer"
+                        )
+                    )
+                return (tuple(new_states), tst), tuple(auxes)
+
+            (states, tstates), aux_stack = lax.scan(
+                body, (states, tstates), (wire, counts)
+            )
+            aux_red = tuple(
+                tuple(v.any() for v in a) for a in aux_stack
+            )
+            return states, tstates, aux_red
+
+        # donate the per-endpoint states (exclusively owned); tstates may
+        # alias read-only findables shared with other runtimes — not donated
+        self._fused = jax.jit(fused, donate_argnums=(0,))
+        self._aux_keys = [self._probe_aux_keys(i) for i in range(len(impls))]
+
+    # ---- host side -------------------------------------------------------
+
+    def try_send(self, timestamps, cols, now: int) -> bool:
+        """Attempt fused ingest of the whole call. Returns False to make the
+        caller fall back to the per-batch path."""
+        n = len(timestamps)
+        B = self.junction.batch_size
+        # engage only when the call fills a decent fraction of a chunk —
+        # shorter sends would pay a full K-iteration scan of mostly-empty
+        # batches, slower than the per-batch path off the tunnel
+        if n < max(2 * B, self.K * B // 2) or self._disabled or not self.eligible():
+            return False
+        with self._lock:
+            if self._fused is None:
+                try:
+                    self._build()
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "fused ingest disabled for stream '%s' (build failed)",
+                        self.junction.schema.stream_id, exc_info=True,
+                    )
+                    self._disabled = True
+                    return False
+        encode, _decode = self.junction.schema.packed_codec(B)
+
+        app_lock = self.app._process_lock
+        K = self.K
+        for c_off in range(0, n, K * B):
+            c_end = min(c_off + K * B, n)
+            bufs = []
+            counts = np.zeros((K,), dtype=np.int32)
+            for k in range(K):
+                lo = c_off + k * B
+                hi = min(lo + B, c_end)
+                m = max(hi - lo, 0)
+                counts[k] = m
+                if m > 0:
+                    bufs.append(
+                        encode(
+                            timestamps[lo:hi],
+                            {kk: v[lo:hi] for kk, v in cols.items()},
+                            m,
+                        )
+                    )
+                else:
+                    bufs.append(np.zeros_like(bufs[0]))
+            wire = np.stack(bufs)  # [K, bytes]
+
+            with app_lock:
+                states = []
+                for ep in self.endpoints:
+                    if ep.qr.state is None:
+                        ep.qr.state = ep.qr._fresh(ep.init_state(now))
+                    states.append(ep.qr.state)
+                tstates = {}
+                for ep in self.endpoints:
+                    tstates.update(ep.qr._collect_table_states())
+                try:
+                    new_states, tstates, aux_red = self._fused(
+                        tuple(states), tstates, wire,
+                        counts, np.int64(now),
+                    )
+                except Exception as e:
+                    # the call donated the state buffers: they are gone either
+                    # way, so reset to fresh state (lazily re-initialized on
+                    # the next receive) instead of leaving every later send
+                    # crashing on deleted arrays; then honor the junction's
+                    # failure policy like the per-batch path does
+                    for ep in self.endpoints:
+                        ep.qr.state = None
+                    handler = self.junction.exception_handler
+                    if handler is None:
+                        raise
+                    handler(e)
+                    return True
+                for ep, st in zip(self.endpoints, new_states):
+                    ep.qr.state = st
+                for ep in self.endpoints:
+                    ep.qr._writeback_table_states(
+                        {
+                            tid: tstates[tid]
+                            for tid in ep.qr._collect_table_states()
+                        }
+                    )
+            if self.junction.on_publish_stats is not None:
+                self.junction.on_publish_stats(int(counts.sum()))
+            for i, ep in enumerate(self.endpoints):
+                flags = dict(zip(self._aux_keys[i], aux_red[i]))
+                if flags:
+                    ep.qr._warn_aux(flags)
+        return True
+
+    def _probe_aux_keys(self, i: int) -> list:
+        """Sorted non-timer aux keys for endpoint i, discovered by tracing
+        the impl's aux output structure once (abstract eval, no device)."""
+        ep = self.endpoints[i]
+        impl = ep.impl_factory()
+        B = self.junction.batch_size
+        schema = self.junction.schema
+        batch = schema.empty_batch(B)
+        st = ep.init_state(0)
+        tst = {}
+        for e2 in self.endpoints:
+            tst.update(e2.qr._collect_table_states())
+        closed = jax.eval_shape(
+            lambda s, t, bb: impl(s, t, bb, np.int64(0))[3], st, tst, batch
+        )
+        return sorted(k for k in closed.keys() if k != "next_timer")

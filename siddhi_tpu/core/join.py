@@ -19,6 +19,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.event import (
@@ -265,10 +266,10 @@ class CompiledJoin:
                 aux["next_timer"] = wflow.aux["next_timer"]
             exp_src = wflow.batch
 
-        probes = [(batch, cur_rows, jnp.int8(KIND_CURRENT))]
+        probes = [(batch, cur_rows, np.int8(KIND_CURRENT))]
         if self.output_expired and emits:
             exp_rows = exp_src.valid & (exp_src.kind == KIND_EXPIRED)
-            probes.append((exp_src, exp_rows, jnp.int8(KIND_EXPIRED)))
+            probes.append((exp_src, exp_rows, np.int8(KIND_EXPIRED)))
         if not emits:
             probes = []
 
@@ -348,13 +349,13 @@ class CompiledJoin:
 
         def partner_col(name, t):
             base = vcols[name][pj]
-            return jnp.where(is_null_partner, jnp.asarray(null_value(t), base.dtype), base)
+            return jnp.where(is_null_partner, np.asarray(null_value(t), base.dtype), base)
 
         arr_out = {n: c[pi] for n, c in row_cols.items()}
         other_out = {
             n: partner_col(n, t) for n, t in other.schema.attr_types.items()
         }
-        other_ts = jnp.where(is_null_partner, jnp.int64(0), vts[pj])
+        other_ts = jnp.where(is_null_partner, np.int64(0), vts[pj])
 
         out_ts = row_ts[pi]
         # primary batch always carries LEFT-side cols for a stable selector

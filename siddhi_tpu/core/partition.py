@@ -20,6 +20,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.event import (
@@ -58,7 +59,7 @@ def _reduce_paux(auxs: dict, povf=None) -> dict:
     }
     if povf is not None:
         aux["partition_overflow"] = aux.get(
-            "partition_overflow", jnp.bool_(False)
+            "partition_overflow", np.bool_(False)
         ) | povf
     return aux
 
@@ -122,7 +123,7 @@ class PartitionedQueryRuntime(QueryRuntime):
 
         states2, outs, aux = self._vmapped(states, make_valid, batch, now)
         aux["partition_overflow"] = aux.get(
-            "partition_overflow", jnp.bool_(False)
+            "partition_overflow", np.bool_(False)
         ) | povf
         return {"keys": pk, "used": pu, "n": pn}, states2, outs, aux
 
@@ -350,7 +351,7 @@ class PartitionedPatternQueryRuntime:
             auxs = {
                 **auxs,
                 "next_timer": jnp.where(
-                    used, auxs["next_timer"], jnp.int64(NO_TIMER)
+                    used, auxs["next_timer"], np.int64(NO_TIMER)
                 ),
             }
         return states2, outs, _reduce_paux(auxs)
@@ -457,10 +458,10 @@ class PartitionRuntime:
                     for i, c in enumerate(_conds):
                         m = c(env)
                         if key is None:
-                            key = jnp.where(m, jnp.int64(i), jnp.int64(-1))
+                            key = jnp.where(m, np.int64(i), np.int64(-1))
                             matched = m
                         else:
-                            key = jnp.where(~matched & m, jnp.int64(i), key)
+                            key = jnp.where(~matched & m, np.int64(i), key)
                             matched = matched | m
                     return key, matched  # unmatched rows are dropped
 

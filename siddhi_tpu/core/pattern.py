@@ -80,7 +80,7 @@ from siddhi_tpu.query_api.execution import (
 )
 from siddhi_tpu.query_api.expression import Expression
 
-NO_TIMER = jnp.asarray(np.iinfo(np.int64).max, dtype=jnp.int64)
+NO_TIMER = np.int64(np.iinfo(np.int64).max)
 
 DEFAULT_TOKEN_CAPACITY = 128
 DEFAULT_COUNT_CAPACITY = 8
@@ -373,13 +373,13 @@ class PatternProgram:
             else:
                 if attr == TS_ATTR:
                     arr = ts_of(a)
-                    nv = jnp.asarray(null_value(AttrType.LONG), dtype=arr.dtype)
+                    nv = np.asarray(null_value(AttrType.LONG), dtype=arr.dtype)
                 else:
                     t = self.schemas[a.stream_id].attr_types.get(attr)
                     if t is None:
                         continue
                     arr = col_of(a, attr)
-                    nv = jnp.asarray(null_value(t), dtype=arr.dtype)
+                    nv = np.asarray(null_value(t), dtype=arr.dtype)
                 if k >= a.cap:
                     col = jnp.full(arr.shape[:1], nv, dtype=arr.dtype)
                 elif k >= 0:
@@ -775,7 +775,7 @@ class PatternProgram:
                     & (tok["start_ts"] >= 0)
                 )
                 key = jnp.where(
-                    cand, tok["start_ts"] * T + lanes64, jnp.int64(1) << 62
+                    cand, tok["start_ts"] * T + lanes64, np.int64(1) << 62
                 )
                 winner = cand & (jnp.arange(T) == jnp.argmin(key))
                 new_fwd = new_fwd | winner
@@ -851,11 +851,11 @@ class PatternProgram:
             schema = self.schemas[a.stream_id]
             caps[a.ref_idx] = {
                 "n": jnp.where(mask, 0, c["n"]),
-                "ts": jnp.where(mask[:, None], jnp.int64(0), c["ts"]),
+                "ts": jnp.where(mask[:, None], np.int64(0), c["ts"]),
                 "cols": {
                     name: jnp.where(
                         mask[:, None],
-                        jnp.asarray(
+                        np.asarray(
                             null_value(schema.attr_types[name]), arr.dtype
                         ),
                         arr,
@@ -868,7 +868,7 @@ class PatternProgram:
             out["entry_ts"] = jnp.where(mask, ts, out["entry_ts"])
         if slot.index == 0:
             out["start_ts"] = jnp.where(
-                mask, jnp.int64(-1), out["start_ts"]
+                mask, np.int64(-1), out["start_ts"]
             )
         return out
 
@@ -891,7 +891,7 @@ class PatternProgram:
                 schema = self.schemas[a.stream_id]
                 cols = {
                     name: arr.at[dest].set(
-                        jnp.asarray(
+                        np.asarray(
                             null_value(schema.attr_types[name]), arr.dtype
                         ),
                         mode="drop",
@@ -901,7 +901,7 @@ class PatternProgram:
                 caps.append(
                     {
                         "n": c["n"].at[dest].set(0, mode="drop"),
-                        "ts": c["ts"].at[dest].set(jnp.int64(0), mode="drop"),
+                        "ts": c["ts"].at[dest].set(np.int64(0), mode="drop"),
                         "cols": cols,
                     }
                 )
@@ -948,7 +948,7 @@ class PatternProgram:
             schema = self.schemas[a.stream_id]
             cols = {
                 name: arr.at[dest].set(
-                    jnp.asarray(null_value(schema.attr_types[name]), arr.dtype),
+                    np.asarray(null_value(schema.attr_types[name]), arr.dtype),
                     mode="drop",
                 )
                 for name, arr in c["cols"].items()
@@ -956,14 +956,14 @@ class PatternProgram:
             caps.append(
                 {
                     "n": c["n"].at[dest].set(0, mode="drop"),
-                    "ts": c["ts"].at[dest].set(jnp.int64(0), mode="drop"),
+                    "ts": c["ts"].at[dest].set(np.int64(0), mode="drop"),
                     "cols": cols,
                 }
             )
         res = {
             "active": tok["active"].at[dest].set(True, mode="drop"),
             "slot": tok["slot"].at[dest].set(p, mode="drop"),
-            "start_ts": tok["start_ts"].at[dest].set(jnp.int64(-1), mode="drop"),
+            "start_ts": tok["start_ts"].at[dest].set(np.int64(-1), mode="drop"),
             "entry_ts": tok["entry_ts"].at[dest].set(
                 jnp.broadcast_to(ts, (T,)).astype(jnp.int64), mode="drop"
             ),
@@ -1258,13 +1258,13 @@ class PatternProgram:
             cr = dict(caps[atom0.ref_idx])
             cr["n"] = cr["n"].at[dst].set(Ag, mode="drop")
             cr["ts"] = cr["ts"].at[dst].set(
-                jnp.where(wm_g, mts[src_gc], jnp.int64(0)), mode="drop"
+                jnp.where(wm_g, mts[src_gc], np.int64(0)), mode="drop"
             )
             if ev0 is not None:
                 new_cols = {}
                 for name, arr in cr["cols"].items():
                     t = self.schemas[atom0.stream_id].attr_types[name]
-                    nv = jnp.asarray(null_value(t), dtype=arr.dtype)
+                    nv = np.asarray(null_value(t), dtype=arr.dtype)
                     genv = jnp.where(wm_g, ev0[name][mrow_c][src_gc].astype(arr.dtype), nv)
                     new_cols[name] = arr.at[dst].set(genv, mode="drop")
                 cr["cols"] = new_cols
@@ -1275,12 +1275,12 @@ class PatternProgram:
                     has_advg.astype(c1["n"].dtype), mode="drop"
                 )
                 c1["ts"] = c1["ts"].at[dst, 0].set(
-                    jnp.where(has_advg, batch_ts[jgc], jnp.int64(0)), mode="drop"
+                    jnp.where(has_advg, batch_ts[jgc], np.int64(0)), mode="drop"
                 )
                 new_cols = {}
                 for name, arr in c1["cols"].items():
                     t = self.schemas[atom1.stream_id].attr_types[name]
-                    nv = jnp.asarray(null_value(t), dtype=arr.dtype)
+                    nv = np.asarray(null_value(t), dtype=arr.dtype)
                     gv = jnp.where(has_advg, ev1[name][jgc].astype(arr.dtype), nv)
                     new_cols[name] = arr.at[dst, 0].set(gv, mode="drop")
                 c1["cols"] = new_cols
@@ -1294,10 +1294,10 @@ class PatternProgram:
                     continue
                 c = dict(caps[ridx])
                 c["n"] = c["n"].at[dst].set(0, mode="drop")
-                c["ts"] = c["ts"].at[dst].set(jnp.int64(0), mode="drop")
+                c["ts"] = c["ts"].at[dst].set(np.int64(0), mode="drop")
                 c["cols"] = {
                     name: arr.at[dst].set(
-                        jnp.asarray(
+                        np.asarray(
                             null_value(self.schemas[a.stream_id].attr_types[name]),
                             arr.dtype,
                         ),
@@ -1306,7 +1306,7 @@ class PatternProgram:
                     for name, arr in c["cols"].items()
                 }
                 caps[ridx] = c
-            g_start = jnp.where(Ag > 0, mts[jnp.clip(s_g, 0, B - 1)], jnp.int64(-1))
+            g_start = jnp.where(Ag > 0, mts[jnp.clip(s_g, 0, B - 1)], np.int64(-1))
             tok = {
                 "active": tok["active"].at[dst].set(True, mode="drop"),
                 "slot": tok["slot"].at[dst].set(
@@ -1366,7 +1366,7 @@ class PatternProgram:
         done = tok["active"] & (tok["slot"] == S)
         cap = out["valid"].shape[0]
         key = jnp.where(
-            done, entry_row.astype(jnp.int64) * T + toks, jnp.int64(1) << 60
+            done, entry_row.astype(jnp.int64) * T + toks, np.int64(1) << 60
         )
         order = jnp.argsort(key).astype(jnp.int32)
         d_sorted = done[order]
@@ -1537,7 +1537,7 @@ class PatternProgram:
         # completion row (then token index for same-row ties)
         done = tok["active"] & (tok["slot"] == S)
         cap = out["valid"].shape[0]
-        key = jnp.where(done, entry_row.astype(jnp.int64) * T + toks, jnp.int64(1) << 60)
+        key = jnp.where(done, entry_row.astype(jnp.int64) * T + toks, np.int64(1) << 60)
         order = jnp.argsort(key).astype(jnp.int32)  # done tokens first, row order
         d_sorted = done[order]
         rank = (jnp.cumsum(d_sorted) - d_sorted).astype(jnp.int32)
@@ -1563,17 +1563,22 @@ class PatternProgram:
 
         # purge tokens whose within expired by the end of the batch (the scan
         # path kills them on the next arrival; purging bounds table growth)
-        last_ts = jnp.max(jnp.where(v, batch_ts, jnp.int64(0)))
+        last_ts = jnp.max(jnp.where(v, batch_ts, np.int64(0)))
         win_by_slot = np.full((S + 1,), np.iinfo(np.int64).max, dtype=np.int64)
         for p, slot in enumerate(self.slots):
             w = _min_within(slot.within_ms, self.within_ms)
             if w is not None:
                 win_by_slot[p] = w
-        win_t = jnp.asarray(win_by_slot)[jnp.clip(tok["slot"], 0, S)]
+        # select-chain over the (tiny) slot count: keeps the per-slot window
+        # durations as scalar literals instead of a device-array const
+        slot_c = jnp.clip(tok["slot"], 0, S)
+        win_t = jnp.full(slot_c.shape, win_by_slot[S], dtype=jnp.int64)
+        for p in range(S):
+            win_t = jnp.where(slot_c == p, win_by_slot[p], win_t)
         started = tok["start_ts"] >= 0
         expired = started & (last_ts - tok["start_ts"] > win_t)
         keep0 = jnp.arange(T) == 0  # the arming token never dies
-        is_armer = keep0 & jnp.asarray(self.slots[0].persistent)
+        is_armer = keep0 & np.asarray(self.slots[0].persistent)
         tok = {**tok, "active": tok["active"] & ~(expired & ~is_armer)}
         return tok, out, out_n, overflow
 

@@ -15,6 +15,7 @@ from typing import Optional
 import jax
 import jax.lax as lax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.event import EventBatch, KIND_TIMER, StreamSchema
@@ -165,7 +166,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 }
                 (tok, out, _n, ovf), _ = lax.scan(
                     chunk_body,
-                    (state["tok"], out0, jnp.asarray(0, jnp.int32), jnp.asarray(False)),
+                    (state["tok"], out0, np.int32(0), np.bool_(False)),
                     xs,
                 )
                 return self._finish_step(state, tok, out, ovf, tstates, now)
@@ -177,8 +178,8 @@ class PatternQueryRuntime(BaseQueryRuntime):
             carry0 = (
                 state["tok"],
                 out0,
-                jnp.asarray(0, dtype=jnp.int32),
-                jnp.asarray(False),
+                np.int32(0),
+                np.bool_(False),
             )
             xs = {
                 "ts": batch.ts,

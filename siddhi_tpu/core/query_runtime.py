@@ -122,78 +122,146 @@ class CompiledSingleChain:
         return dataclasses.replace(flow, batch=batch)
 
 
-class _AuxWarnWorker:
-    """Process-wide daemon draining deferred aux-flag checks.
+class _AuxWarnPool:
+    """Deferred aux-flag checks with NO background thread.
 
-    The hot dispatch path never touches device scalars; this thread takes the
-    (query, flags) backlog, ORs each flag kind across the backlog ON DEVICE,
-    and pays exactly one blocking read per drain cycle — so overflow warnings
-    cost one tunnel flush per cycle instead of one per step."""
+    The hot dispatch path never blocks on device scalars; submitted flags are
+    coalesced ON DEVICE (an async dispatch, safe from any thread) and the one
+    blocking device->host read happens only (a) in `flush()` and (b) at most
+    once per `DRAIN_EVERY_S` from a main-thread submit. Transfers are pinned
+    to the main thread on purpose: on some tunneled PJRT backends a
+    device->host read issued from a helper thread permanently degrades every
+    subsequent dispatch in the process (measured ~2.5 ms/call), so a daemon
+    drain thread would un-do the engine's own fast path.
+
+    Backlog entries hold weakrefs to the query runtime, so a shut-down app is
+    collectable even if nobody flushes."""
+
+    COALESCE_AT = 32
 
     def __init__(self):
-        self._cv = threading.Condition()
-        self._items: list = []
-        self._thread = None
-        self._draining = False
+        import os
+        import time as _time
+        import weakref
+
+        self._weakref = weakref
+        self._lock = threading.Lock()
+        # id(qr) -> [qr_weakref, {flag_kind: [device bools]}]
+        self._pending: dict = {}
+        self._counts: dict = {}
+        self._last_drain = _time.monotonic()
+        # periodic-drain cadence; 0 or negative disables automatic drains
+        # (flush()/shutdown still drain) — benches that must keep the relay
+        # in its fast mode set SIDDHI_TPU_AUX_DRAIN_S=0
+        try:
+            self.drain_every_s = float(
+                os.environ.get("SIDDHI_TPU_AUX_DRAIN_S", "5.0")
+            )
+        except ValueError:
+            self.drain_every_s = 5.0
+
+    def _may_autodrain(self) -> bool:
+        if self.drain_every_s <= 0:
+            return False
+        if threading.current_thread() is threading.main_thread():
+            return True
+        # helper threads may drain only on backends where a non-main-thread
+        # transfer does not degrade dispatch (see class docstring)
+        from siddhi_tpu.utils.backend import transfer_degrades_dispatch
+
+        return not transfer_degrades_dispatch()
 
     def submit(self, qr, flags: dict) -> None:
-        with self._cv:
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._run, daemon=True, name="siddhi-aux-warn"
-                )
-                self._thread.start()
-            self._items.append((qr, flags))
-            self._cv.notify_all()
+        with self._lock:
+            ent = self._pending.get(id(qr))
+            # id() values are reused after GC: a stale dead entry at this
+            # address must not swallow a live runtime's flags
+            if ent is not None and ent[0]() is not qr:
+                ent = None
+            if ent is None:
+                ent = [self._weakref.ref(qr), {k: [] for k in flags}]
+                self._pending[id(qr)] = ent
+                self._counts[id(qr)] = 0
+            acc = ent[1]
+            for k, v in flags.items():
+                acc.setdefault(k, []).append(v)
+            self._counts[id(qr)] += 1
+            if self._counts[id(qr)] >= self.COALESCE_AT:
+                # async on-device OR — keeps the backlog O(kinds), no read
+                for k, vs in acc.items():
+                    if len(vs) > 1:
+                        acc[k] = [jnp.stack(
+                            [jnp.asarray(v).astype(bool) for v in vs]
+                        ).any()]
+                self._counts[id(qr)] = 0
+        import time as _time
+
+        if (
+            _time.monotonic() - self._last_drain > self.drain_every_s
+            and self._may_autodrain()
+        ):
+            self.flush()
 
     def flush(self) -> None:
-        with self._cv:
-            while self._items or self._draining:
-                self._cv.wait(timeout=0.1)
+        """Drain everything with ONE blocking device read for the whole
+        backlog (all runtimes, all flag kinds stacked into one vector).
+        Call from the main thread on transfer-sensitive backends."""
+        import time as _time
 
-    def _run(self) -> None:
         import numpy as np
 
-        while True:
-            with self._cv:
-                while not self._items:
-                    self._cv.wait()
-                items, self._items = self._items, []
-                self._draining = True
+        with self._lock:
+            pending, self._pending = self._pending, {}
+            self._counts = {}
+            self._last_drain = _time.monotonic()
+        plan = []  # (qr, [keys]) aligned with scalars
+        scalars = []
+        for _qid, (qr_ref, acc) in pending.items():
+            qr = qr_ref()
+            if qr is None:
+                continue  # app GC'd un-flushed: drop its backlog
+            keys = sorted(acc)
             try:
-                per_qr: dict = {}
-                for qr, flags in items:
-                    d = per_qr.setdefault(id(qr), (qr, {}))[1]
-                    for k, v in flags.items():
-                        d.setdefault(k, []).append(v)
-                for qr, flags in per_qr.values():
-                    try:
-                        keys = sorted(flags)
-                        anys = jnp.stack(
-                            [
-                                jnp.stack(
-                                    [jnp.asarray(v).astype(bool) for v in flags[k]]
-                                ).any()
-                                for k in keys
-                            ]
-                        )
-                        vals = np.asarray(anys)  # the cycle's single block
-                        qr._check_aux_flags(
-                            {k: bool(vals[i]) for i, k in enumerate(keys)}
-                        )
-                    except Exception:  # never let a warning path kill the app
-                        import logging
+                qr_scalars = [
+                    jnp.stack(
+                        [jnp.asarray(v).astype(bool) for v in acc[k]]
+                    ).any()
+                    for k in keys
+                ]
+            except Exception:
+                import logging
 
-                        logging.getLogger(__name__).debug(
-                            "aux flag drain failed", exc_info=True
-                        )
-            finally:
-                with self._cv:
-                    self._draining = False
-                    self._cv.notify_all()
+                logging.getLogger(__name__).debug(
+                    "aux flag coalesce failed", exc_info=True
+                )
+                continue  # drop this runtime whole: keeps plan/scalars aligned
+            scalars.extend(qr_scalars)
+            plan.append((qr, keys))
+        if not scalars:
+            return
+        try:
+            vals = np.asarray(jnp.stack(scalars))  # the cycle's single block
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).debug("aux flag drain failed", exc_info=True)
+            return
+        i = 0
+        for qr, keys in plan:
+            try:
+                qr._check_aux_flags(
+                    {k: bool(vals[i + j]) for j, k in enumerate(keys)}
+                )
+            except Exception:  # never let a warning path kill the app
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "aux flag check failed", exc_info=True
+                )
+            i += len(keys)
 
 
-_AUX_WORKER = _AuxWarnWorker()
+_AUX_WORKER = _AuxWarnPool()
 
 
 class BaseQueryRuntime:
@@ -288,10 +356,12 @@ class BaseQueryRuntime:
 
     def _warn_aux(self, aux: dict) -> None:
         """Surface overflow flags WITHOUT stalling the dispatch pipeline:
-        even `Array.is_ready` on an in-flight device scalar forces a queue
-        flush (a full tunnel round trip behind a network-attached chip), so
-        flag checks are handed to a background drain thread that coalesces
-        any backlog into one device read. `flush_aux_warnings` joins it."""
+        flags accumulate (and periodically coalesce on-device) in the
+        process-wide `_AuxWarnPool`; the one blocking device read happens in
+        its periodic main-thread drain or in `flush_aux_warnings`. No helper
+        thread is involved — on some tunneled PJRT backends any device->host
+        read from a non-main thread permanently degrades every subsequent
+        dispatch in the process."""
         flags = {k: v for k, v in aux.items() if k != "next_timer"}
         if flags:
             _AUX_WORKER.submit(self, flags)

@@ -35,6 +35,10 @@ class StreamJunction:
         self.batch_size = batch_size
         self.subscribers: list[Subscriber] = []
         self.stream_callbacks: list[Callable] = []
+        # fused-ingest wiring (core/ingest.py): subscribers that also register
+        # a FuseEndpoint here can be run K-batches-per-dispatch by send_columns
+        self.fuse_candidates: list = []
+        self.fused_ingest = None
         # RLock: a query may legally insert into its own input stream
         # (reference allows self-feeding junctions); recursion stays on-thread
         self.lock = threading.RLock()
@@ -323,6 +327,9 @@ class InputHandler:
         if now is None:
             now = self.clock()  # same wall-clock default as send/send_many
         numeric = all(np.asarray(v).dtype.kind not in "OUS" for v in cols.values())
+        fi = j.fused_ingest
+        if numeric and fi is not None and fi.try_send(timestamps, cols, now):
+            return
         if numeric:
             encode, decode = j.schema.packed_codec(j.batch_size)
             for ofs in range(0, n, j.batch_size):

@@ -22,6 +22,7 @@ from typing import Callable, Optional
 import jax
 import jax.lax as lax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.event import EventBatch, KIND_CURRENT, StreamSchema
@@ -480,7 +481,7 @@ def compile_table_output(
             if want is OutputEventsFor.CURRENT:
                 keep = out_batch.kind == KIND_CURRENT
             elif want is OutputEventsFor.EXPIRED:
-                keep = out_batch.kind == jnp.int8(1)  # KIND_EXPIRED
+                keep = out_batch.kind == np.int8(1)  # KIND_EXPIRED
             else:
                 keep = jnp.ones_like(out_batch.valid)
             # positional mapping rides the OUT SCHEMA order, not the cols

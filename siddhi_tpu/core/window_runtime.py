@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.event import (
@@ -71,9 +72,9 @@ class NamedWindow:
         # `output current|expired events` narrows what downstream queries see
         # (reference: Window.java outputEventType dispatch)
         if self.out_events == "current":
-            keep = b.kind != jnp.int8(KIND_EXPIRED)
+            keep = b.kind != np.int8(KIND_EXPIRED)
         elif self.out_events == "expired":
-            keep = b.kind != jnp.int8(KIND_CURRENT)
+            keep = b.kind != np.int8(KIND_CURRENT)
         else:
             keep = jnp.ones_like(b.valid)
         out = EventBatch(b.ts, b.kind, b.valid & keep, b.cols)

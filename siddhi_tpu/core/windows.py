@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.event import (
@@ -139,7 +140,7 @@ class SlidingWindow(WindowStage):
             bwts = b.ts
         rank = jnp.cumsum(valid_cur) - valid_cur.astype(jnp.int32)
         c = valid_cur.sum(dtype=jnp.int32)
-        seq_batch = jnp.where(valid_cur, total + rank, jnp.int64(-1))
+        seq_batch = jnp.where(valid_cur, total + rank, np.int64(-1))
 
         # element view: ring slots then batch rows
         elem_ts = jnp.concatenate([state["ts"], b.ts])
@@ -214,7 +215,7 @@ class SlidingWindow(WindowStage):
         o_trig_row = jnp.clip(o_key // 2, 0, bsz - 1)
         out = EventBatch(
             ts=jnp.where(o_exp, trigger_ts[o_trig_row], elem_ts[o_elem]),
-            kind=jnp.where(o_exp, jnp.int8(KIND_EXPIRED), jnp.int8(KIND_CURRENT)),
+            kind=jnp.where(o_exp, np.int8(KIND_EXPIRED), np.int8(KIND_CURRENT)),
             valid=o_valid,
             cols={n: elem_cols[n][o_elem] for n in elem_cols},
         )
@@ -225,7 +226,7 @@ class SlidingWindow(WindowStage):
         # reproduces the reference's one-by-one add/remove ordering exactly.
         inv = jnp.argsort(order)  # candidate index -> sorted output position
         birth_pos = jnp.where(
-            own_row >= 0, inv[k + jnp.clip(own_row, 0, bsz - 1)], jnp.int32(-1)
+            own_row >= 0, inv[k + jnp.clip(own_row, 0, bsz - 1)], np.int32(-1)
         )
         death_pos = jnp.where(evict, inv[jnp.arange(k)], BIG)
         alive_src = present
@@ -272,8 +273,8 @@ class SlidingWindow(WindowStage):
         ring_evicted = evict[:w]
         batch_evicted = evict[w:]
         insert = valid_cur & ~batch_evicted & (rank >= c - w)
-        slots = jnp.where(insert, (total + rank) % w, jnp.int64(w)).astype(jnp.int32)
-        new_seq = jnp.where(ring_evicted, jnp.int64(-1), state["seq"])
+        slots = jnp.where(insert, (total + rank) % w, np.int64(w)).astype(jnp.int32)
+        new_seq = jnp.where(ring_evicted, np.int64(-1), state["seq"])
         return {
             "cols": {
                 n: _place_ring(state["cols"][n], ring_evicted, slots, b.cols[n])
@@ -321,7 +322,7 @@ class SlidingWindow(WindowStage):
         # scatter EXPIREDs (rank space)
         exp_dst = jnp.where(e, exp_pos_rank, n_out)
         out_ts = out_ts.at[exp_dst].set(trig_ts, mode="drop")
-        out_kind = out_kind.at[exp_dst].set(jnp.int8(KIND_EXPIRED), mode="drop")
+        out_kind = out_kind.at[exp_dst].set(np.int8(KIND_EXPIRED), mode="drop")
         out_valid = out_valid.at[exp_dst].set(True, mode="drop")
         for n in out_cols:
             out_cols[n] = out_cols[n].at[exp_dst].set(
@@ -341,7 +342,7 @@ class SlidingWindow(WindowStage):
         birth_pos = jnp.concatenate(
             [
                 jnp.full((w,), -1, jnp.int32),
-                jnp.where(valid_cur, cur_pos_row, jnp.int32(-1)),
+                jnp.where(valid_cur, cur_pos_row, np.int32(-1)),
             ]
         )
         E_at = E[jnp.clip(trig_rank, 0, bsz - 1)]
@@ -477,16 +478,16 @@ class BatchWindow(WindowStage):
             # --- timeBatch: flush when a trigger row enters a later bucket ---
             trigger_ok = valid_cur | is_timer
             if self.start_time is not None:
-                start0 = jnp.int64(self.start_time)
+                start0 = np.int64(self.start_time)
             else:
                 first_trig = jnp.argmax(trigger_ok)
                 start0 = jnp.where(
                     state["bucket_start"] >= 0,
                     state["bucket_start"],
-                    jnp.where(trigger_ok.any(), bwts[first_trig], jnp.int64(-1)),
+                    jnp.where(trigger_ok.any(), bwts[first_trig], np.int64(-1)),
                 )
             rel = jnp.maximum(bwts - start0, 0)
-            g = jnp.where(trigger_ok & (start0 >= 0), rel // self.t, jnp.int64(0))
+            g = jnp.where(trigger_ok & (start0 >= 0), rel // self.t, np.int64(0))
             open_g = jax.lax.associative_scan(jnp.maximum, g)
             prev_open = jnp.concatenate([jnp.zeros((1,), jnp.int64), open_g[:-1]])
             had_bucket = (state["bucket_start"] >= 0) | (
@@ -647,7 +648,7 @@ class BatchWindow(WindowStage):
             aux["next_timer"] = jnp.where(
                 new_state["bucket_start"] >= 0,
                 new_state["bucket_start"] + self.t,
-                jnp.int64(NO_TIMER),
+                np.int64(NO_TIMER),
             )
 
         return new_state, Flow(

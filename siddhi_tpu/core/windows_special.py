@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax.lax as lax
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
 from siddhi_tpu.core.event import (
@@ -52,7 +53,7 @@ def _out_append(out, n, ovf, cols, ts, kind, flag, cap):
     pos = jnp.where(flag & (n < cap), n, cap)  # cap == out-of-bounds: dropped
     new = {
         "ts": out["ts"].at[pos].set(ts, mode="drop"),
-        "kind": out["kind"].at[pos].set(jnp.int8(kind), mode="drop"),
+        "kind": out["kind"].at[pos].set(np.int8(kind), mode="drop"),
         "valid": out["valid"].at[pos].set(True, mode="drop"),
         "cols": {
             k: out["cols"][k].at[pos].set(v.astype(out["cols"][k].dtype), mode="drop")
@@ -74,7 +75,7 @@ def _out_append_many(out, n, ovf, cols, ts, kind, flags, cap):
     ts_b = jnp.broadcast_to(ts, flags.shape)
     new = {
         "ts": out["ts"].at[pos].set(ts_b, mode="drop"),
-        "kind": out["kind"].at[pos].set(jnp.int8(kind), mode="drop"),
+        "kind": out["kind"].at[pos].set(np.int8(kind), mode="drop"),
         "valid": out["valid"].at[pos].set(True, mode="drop"),
         "cols": {
             k: out["cols"][k].at[pos].set(v.astype(out["cols"][k].dtype), mode="drop")
@@ -182,10 +183,10 @@ class SortWindow(WindowStage):
             full = st["occ"].all() & is_cur
             # victim: lexicographic max by sort keys, ties -> latest insertion
             skeys = self._sort_keys(cand_cols) + [cand_seq]
-            best = jnp.int32(0)
+            best = np.int32(0)
             for i in range(1, w + 1):
-                gt = jnp.bool_(False)
-                eq = jnp.bool_(True)
+                gt = np.bool_(False)
+                eq = np.bool_(True)
                 for kcol in skeys:
                     a, bb = kcol[i], kcol[best]
                     gt = gt | (eq & (a > bb))
@@ -193,7 +194,7 @@ class SortWindow(WindowStage):
                 # unoccupied candidates never win
                 gt = gt & cand_occ[i]
                 lose = ~cand_occ[best]
-                best = jnp.where(gt | lose, jnp.int32(i), best)
+                best = jnp.where(gt | lose, np.int32(i), best)
             # if full: emit the victim as EXPIRED (ts = now) and remove it
             out, n, ovf = _out_append(
                 out, n, ovf,
@@ -237,7 +238,7 @@ class SortWindow(WindowStage):
             **{f"c.{k}": c for k, c in b.cols.items()},
         }
         (st, out, _n, ovf), _ = lax.scan(
-            body, (state, out0, jnp.int32(0), jnp.bool_(False)), xs
+            body, (state, out0, np.int32(0), np.bool_(False)), xs
         )
         aux = dict(flow.aux)
         aux["window_overflow"] = ovf
@@ -327,7 +328,7 @@ class CronWindow(WindowStage):
             pos = jnp.where(cur_mask & (n + rank < cap), n + rank, cap)
             out = {
                 "ts": out2["ts"].at[pos].set(st["cur_ts"], mode="drop"),
-                "kind": out2["kind"].at[pos].set(jnp.int8(KIND_CURRENT), mode="drop"),
+                "kind": out2["kind"].at[pos].set(np.int8(KIND_CURRENT), mode="drop"),
                 "valid": out2["valid"].at[pos].set(True, mode="drop"),
                 "cols": {
                     k: out2["cols"][k].at[pos].set(st["cur_cols"][k], mode="drop")
@@ -381,7 +382,7 @@ class CronWindow(WindowStage):
             **{f"c.{k}": c for k, c in b.cols.items()},
         }
         (st, out, _n, ovf), _ = lax.scan(
-            body, (state, out0, jnp.int32(0), jnp.bool_(False)), xs
+            body, (state, out0, np.int32(0), np.bool_(False)), xs
         )
         aux = dict(flow.aux)
         aux["window_overflow"] = ovf
@@ -485,7 +486,7 @@ class FrequentWindow(WindowStage):
             **{f"c.{k}": c for k, c in b.cols.items()},
         }
         (st, out, _n, ovf), _ = lax.scan(
-            body, (state, out0, jnp.int32(0), jnp.bool_(False)), xs
+            body, (state, out0, np.int32(0), np.bool_(False)), xs
         )
         aux = dict(flow.aux)
         aux["window_overflow"] = ovf
@@ -558,7 +559,7 @@ class LossyFrequentWindow(WindowStage):
             )[0]
             total = st["total"] + is_cur.astype(jnp.int64)
             cur_bucket = jnp.where(
-                total <= 1, jnp.int64(1), (total + width - 1) // width
+                total <= 1, np.int64(1), (total + width - 1) // width
             )
             hit = st["occ"] & (st["key"] == key)
             exists = hit.any() & is_cur
@@ -616,7 +617,7 @@ class LossyFrequentWindow(WindowStage):
             **{f"c.{k}": c2 for k, c2 in b.cols.items()},
         }
         (st, out, _n, ovf), _ = lax.scan(
-            body, (state, out0, jnp.int32(0), jnp.bool_(False)), xs
+            body, (state, out0, np.int32(0), np.bool_(False)), xs
         )
         aux = dict(flow.aux)
         aux["window_overflow"] = ovf

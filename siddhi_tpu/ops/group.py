@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax.numpy as jnp
+import numpy as np
 
 from siddhi_tpu.ops.prefix import (
     extreme_identity,
@@ -27,8 +28,8 @@ from siddhi_tpu.ops.prefix import (
 )
 
 # 64-bit mixing constants (splitmix64 finalizer) for combining composite keys.
-_MIX1 = jnp.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
-_MIX2 = jnp.int64(-4658895280553007687)  # 0xBF58476D1CE4E5B9 as signed
+_MIX1 = np.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
+_MIX2 = np.int64(-4658895280553007687)  # 0xBF58476D1CE4E5B9 as signed
 
 
 def mix_keys(cols: list[jnp.ndarray]) -> jnp.ndarray:
@@ -91,7 +92,7 @@ def assign_slots(
 
     has_reset = reset is not None and getattr(reset, "shape", None)
     rst = reset if has_reset else jnp.zeros((b,), jnp.bool_)
-    glr = jnp.max(jnp.where(rst, idx, jnp.int32(-1)))  # last reset row, -1 if none
+    glr = jnp.max(jnp.where(rst, idx, np.int32(-1)))  # last reset row, -1 if none
     any_reset = glr >= 0
     post = idx > glr  # rows whose carry lives in the (possibly fresh) new table
     era = jnp.cumsum(rst.astype(jnp.int32))  # segments never span a reset
@@ -125,7 +126,7 @@ def assign_slots(
     slot_new = n_used + alloc_rank
     old_overflow = (jnp.where(is_alloc, slot_new, 0) >= g).any()
     old_slot = jnp.where(in_t, t_slot, jnp.where(slot_new[first] < g, slot_new[first], g))
-    old_slot = jnp.where(active, old_slot, jnp.int32(g)).astype(jnp.int32)
+    old_slot = jnp.where(active, old_slot, np.int32(g)).astype(jnp.int32)
 
     # ---- fresh-table resolution for post-reset rows (first is era-local, so
     # the same head works for the fresh allocation pass)
@@ -138,7 +139,7 @@ def assign_slots(
     ).astype(jnp.int32)
 
     slot = jnp.where(any_reset & post, fresh_slot, old_slot)
-    slot = jnp.where(active, slot, jnp.int32(g))
+    slot = jnp.where(active, slot, np.int32(g))
     overflow = jnp.where(any_reset, fresh_overflow, old_overflow)
 
     # ---- new table state
@@ -234,7 +235,7 @@ def keep_last_per_group(cols: list[jnp.ndarray], valid: jnp.ndarray) -> jnp.ndar
         )
     # last valid original-row index per segment: reverse segmented cummax of
     # where(valid, original row, -1)
-    marked = jnp.where(sv, perm, jnp.int32(-1))
+    marked = jnp.where(sv, perm, np.int32(-1))
     rev = marked[::-1]
     # a reversed segment starts where the forward segment ENDS
     seg_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])

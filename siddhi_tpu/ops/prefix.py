@@ -12,6 +12,7 @@ reduction, which the MXU/VPU eat for B <= ~1024, and it keeps everything static.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 
 def last_reset_index(reset: jnp.ndarray) -> jnp.ndarray:
@@ -19,7 +20,7 @@ def last_reset_index(reset: jnp.ndarray) -> jnp.ndarray:
     import jax.lax as lax
 
     idx = jnp.arange(reset.shape[-1], dtype=jnp.int32)
-    marked = jnp.where(reset, idx, jnp.int32(-1))
+    marked = jnp.where(reset, idx, np.int32(-1))
     # lax.cummax is a parallel (log-depth) scan; jnp.maximum.accumulate
     # lowers to a sequential per-element scan — ~1000x slower at 100k rows
     return lax.cummax(marked, axis=reset.ndim - 1)
@@ -117,11 +118,14 @@ def segmented_carry(vals: jnp.ndarray, seg_start: jnp.ndarray) -> jnp.ndarray:
     return _segmented_scan(vals, seg_start, lambda a, b: a)
 
 
-def extreme_identity(dtype, is_min: bool) -> jnp.ndarray:
+def extreme_identity(dtype, is_min: bool) -> np.ndarray:
+    # numpy (NOT jnp): this is called at trace time and the result is baked
+    # into compiled programs; a concrete jax.Array const knocks PJRT dispatch
+    # off its fast path process-wide on tunneled backends.
     if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype=dtype)
+        return np.asarray(np.inf if is_min else -np.inf, dtype=dtype)
     info = jnp.iinfo(dtype)
-    return jnp.asarray(info.max if is_min else info.min, dtype=dtype)
+    return np.asarray(info.max if is_min else info.min, dtype=dtype)
 
 
 def compact(valid: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

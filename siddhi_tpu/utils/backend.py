@@ -11,6 +11,26 @@ from __future__ import annotations
 from typing import Optional
 
 _CB_SUPPORT: Optional[bool] = None
+_TRANSFER_DEGRADES: Optional[bool] = None
+
+
+def transfer_degrades_dispatch() -> bool:
+    """True when a device->host transfer permanently degrades dispatch on the
+    default backend (observed on tunneled/relayed PJRT plugins, where the
+    relay speculatively acks async work until the first transfer forces it
+    into a synchronous completion cycle of ~100 ms). Detected by platform
+    name — probing behaviorally would itself trigger the degradation."""
+    global _TRANSFER_DEGRADES
+    if _TRANSFER_DEGRADES is None:
+        try:
+            import jax
+
+            client = jax.devices()[0].client
+            pv = getattr(client, "platform_version", "") or ""
+            _TRANSFER_DEGRADES = pv.startswith("axon")
+        except Exception:
+            _TRANSFER_DEGRADES = False
+    return _TRANSFER_DEGRADES
 
 
 def host_callbacks_supported() -> bool:

@@ -80,9 +80,12 @@ class TestEventRate:
 
 class TestTimeRate:
     def test_all_every_period(self):
+        # period must exceed first-send jit-compile time: the flush timer
+        # runs on wall clock, and a flush firing between the two sends would
+        # legitimately deliver A early
         mgr, rt, got = build(BASE + """
         @info(name='q')
-        from S select symbol output all every 100 milliseconds insert into Out;
+        from S select symbol output all every 2 sec insert into Out;
         """)
         h = rt.get_input_handler("S")
         h.send(("A", 1.0))
