@@ -73,7 +73,7 @@ def _truth_sync(rt):
     return float(np.asarray(acc))
 
 
-def _run_workload(ql, query_stream, data, n_events, batch_size, warmup_batches=3):
+def _run_workload(ql, query_stream, data, n_events, batch_size):
     """TRUE throughput of one SiddhiQL app: events/sec through the full
     engine (host pack -> h2d -> fused/step dispatch), timed to completion
     via a truth sync."""
@@ -86,7 +86,9 @@ def _run_workload(ql, query_stream, data, n_events, batch_size, warmup_batches=3
     h = rt.get_input_handler(query_stream)
 
     cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
-    warm_n = min(batch_size * max(warmup_batches, 3), n_events)
+    # warm with the SAME send size as the timed loop so both the per-batch
+    # and fused-ingest programs compile before the clock starts
+    warm_n = min(batch_size * 64, n_events)
     h.send_columns(data["ts"][:warm_n], {k: v[:warm_n] for k, v in cols.items()})
     _truth_sync(rt)  # compile + flip the relay into truth mode before timing
 

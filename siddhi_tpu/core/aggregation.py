@@ -425,7 +425,7 @@ class AggregationRuntime:
         # allocate misses in order
         miss = src_used & ~hit
         n_used = used.sum(dtype=jnp.int32)
-        rank = (jnp.cumsum(miss) - miss).astype(jnp.int32)
+        rank = (jnp.cumsum(miss.astype(jnp.int32)) - miss).astype(jnp.int32)
         new_slot = n_used + rank
         overflow = (jnp.where(miss, new_slot, 0) >= g).any()
         slot = jnp.where(hit, hit_slot, jnp.where(new_slot < g, new_slot, g))

@@ -106,13 +106,25 @@ class FusedJunctionIngest:
     def _build(self):
         B = self.junction.batch_size
         schema = self.junction.schema
-        _encode, decode = schema.packed_codec(B)
+        # projected wire: ship only attributes some subscriber reads
+        used: set | None = set()
+        for ep in self.endpoints:
+            ua = getattr(ep.qr, "used_attrs", None)
+            if ua is None:
+                used = None  # unknown/select * — keep everything
+                break
+            used |= ua
+        self._keep = (
+            None if used is None
+            else frozenset(n for n in schema.attr_names if n in used)
+        )
+        _encode, decode, self._wire_bytes = schema.wire_codec(B, self._keep)
         impls = [ep.impl_factory() for ep in self.endpoints]
 
-        def fused(states, tstates, wire, counts, now):
+        def fused(states, tstates, wire, counts, bases, now):
             def body(carry, xs):
                 sts, tst = carry
-                batch = decode(xs[0], xs[1])
+                batch = decode(xs[0], xs[1], xs[2])
                 new_states = []
                 auxes = []
                 for impl, st in zip(impls, sts):
@@ -128,7 +140,7 @@ class FusedJunctionIngest:
                 return (tuple(new_states), tst), tuple(auxes)
 
             (states, tstates), aux_stack = lax.scan(
-                body, (states, tstates), (wire, counts)
+                body, (states, tstates), (wire, counts, bases)
             )
             aux_red = tuple(
                 tuple(v.any() for v in a) for a in aux_stack
@@ -165,7 +177,10 @@ class FusedJunctionIngest:
                     )
                     self._disabled = True
                     return False
-        encode, _decode = self.junction.schema.packed_codec(B)
+        ts_arr = np.asarray(timestamps)
+        if n and int(ts_arr.max()) - int(ts_arr.min()) >= (1 << 31):
+            return False  # int32 ts-delta wire can't span >24 days per call
+        encode, _decode, _nb = self.junction.schema.wire_codec(B, self._keep)
 
         app_lock = self.app._process_lock
         K = self.K
@@ -173,19 +188,20 @@ class FusedJunctionIngest:
             c_end = min(c_off + K * B, n)
             bufs = []
             counts = np.zeros((K,), dtype=np.int32)
+            bases = np.zeros((K,), dtype=np.int64)
             for k in range(K):
                 lo = c_off + k * B
                 hi = min(lo + B, c_end)
                 m = max(hi - lo, 0)
                 counts[k] = m
                 if m > 0:
-                    bufs.append(
-                        encode(
-                            timestamps[lo:hi],
-                            {kk: v[lo:hi] for kk, v in cols.items()},
-                            m,
-                        )
+                    buf, base = encode(
+                        ts_arr[lo:hi],
+                        {kk: v[lo:hi] for kk, v in cols.items()},
+                        m,
                     )
+                    bufs.append(buf)
+                    bases[k] = base
                 else:
                     bufs.append(np.zeros_like(bufs[0]))
             wire = np.stack(bufs)  # [K, bytes]
@@ -197,34 +213,35 @@ class FusedJunctionIngest:
                         ep.qr.state = ep.qr._fresh(ep.init_state(now))
                     states.append(ep.qr.state)
                 tstates = {}
+                ep_tids = []
                 for ep in self.endpoints:
-                    tstates.update(ep.qr._collect_table_states())
+                    ts_ep = ep.qr._collect_table_states()
+                    ep_tids.append(list(ts_ep))
+                    tstates.update(ts_ep)
                 try:
                     new_states, tstates, aux_red = self._fused(
                         tuple(states), tstates, wire,
-                        counts, np.int64(now),
+                        counts, bases, np.int64(now),
                     )
                 except Exception as e:
                     # the call donated the state buffers: they are gone either
                     # way, so reset to fresh state (lazily re-initialized on
                     # the next receive) instead of leaving every later send
                     # crashing on deleted arrays; then honor the junction's
-                    # failure policy like the per-batch path does
+                    # failure policy like the per-batch path does (which
+                    # drops at most the failing batch and keeps going)
                     for ep in self.endpoints:
                         ep.qr.state = None
                     handler = self.junction.exception_handler
                     if handler is None:
                         raise
                     handler(e)
-                    return True
+                    continue  # next chunk, like per-batch send_columns would
                 for ep, st in zip(self.endpoints, new_states):
                     ep.qr.state = st
-                for ep in self.endpoints:
+                for ep, tids in zip(self.endpoints, ep_tids):
                     ep.qr._writeback_table_states(
-                        {
-                            tid: tstates[tid]
-                            for tid in ep.qr._collect_table_states()
-                        }
+                        {tid: tstates[tid] for tid in tids}
                     )
             if self.junction.on_publish_stats is not None:
                 self.junction.on_publish_stats(int(counts.sum()))

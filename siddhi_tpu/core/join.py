@@ -334,7 +334,7 @@ class CompiledJoin:
         flat = pair.reshape(-1)
         # compact match indices WITHOUT a device sort (nonzero lowers to one):
         # rank matched cells by prefix count and scatter their indices
-        rank = jnp.cumsum(flat) - flat
+        rank = jnp.cumsum(flat.astype(jnp.int32)) - flat
         pos = jnp.where(flat & (rank < cap), rank, cap)
         idx = (
             jnp.full((cap,), -1, jnp.int32)
@@ -402,6 +402,7 @@ class JoinQueryRuntime(BaseQueryRuntime):
         self.query_id = query_id
 
         scope = Scope(interner)
+        self._scope = scope
         lref, rref = join.left.ref, join.right.ref
         scope.add_stream(lref, left_schema.attr_types)
         scope.add_stream(rref, right_schema.attr_types)

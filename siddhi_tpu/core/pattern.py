@@ -989,7 +989,7 @@ class PatternProgram:
         free = ~tok["active"]
         order = jnp.argsort(~free)  # free row indices first (stable)
         nfree = jnp.sum(free)
-        rank = jnp.cumsum(mask) - 1
+        rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
         ok = mask & (rank < nfree)
         dest = jnp.where(ok, order[jnp.clip(rank, 0, T - 1)], T)
         return dest, overflow | jnp.any(mask & ~ok)
@@ -1146,7 +1146,8 @@ class PatternProgram:
             Mc = jnp.zeros((B,), dtype=jnp.bool_)
         midx_excl = jnp.cumsum(Mc.astype(jnp.int32)) - Mc.astype(jnp.int32)
         k_total = midx_excl[-1] + Mc[-1].astype(jnp.int32)
-        mrow = jnp.nonzero(Mc, size=B, fill_value=B)[0].astype(jnp.int32)
+        from siddhi_tpu.ops.prefix import first_indices
+        mrow = first_indices(Mc, B, fill=B)
         mrow_c = jnp.clip(mrow, 0, B - 1)
         mts = batch_ts[mrow_c]
 
@@ -1245,8 +1246,9 @@ class PatternProgram:
             # scatter generations into free lanes
             free = ~tok["active"]
             nfree = jnp.sum(free)
-            free_idx = jnp.nonzero(free, size=Gmax, fill_value=-1)[0]
-            grank = (jnp.cumsum(valid_g) - 1).astype(jnp.int32)
+            from siddhi_tpu.ops.prefix import first_indices
+            free_idx = first_indices(free, Gmax)
+            grank = (jnp.cumsum(valid_g.astype(jnp.int32)) - 1).astype(jnp.int32)
             okg = valid_g & (grank < nfree) & (free_idx[jnp.clip(grank, 0, Gmax - 1)] >= 0)
             overflow = overflow | jnp.any(valid_g & ~okg)
             dst = jnp.where(okg, free_idx[jnp.clip(grank, 0, Gmax - 1)], T)
@@ -1370,7 +1372,7 @@ class PatternProgram:
         )
         order = jnp.argsort(key).astype(jnp.int32)
         d_sorted = done[order]
-        rank = (jnp.cumsum(d_sorted) - d_sorted).astype(jnp.int32)
+        rank = (jnp.cumsum(d_sorted.astype(jnp.int32)) - d_sorted).astype(jnp.int32)
         dest = jnp.where(d_sorted & (out_n + rank < cap), out_n + rank, cap)
         overflow = overflow | (d_sorted & (out_n + rank >= cap)).any()
         src_t = order
@@ -1474,9 +1476,10 @@ class PatternProgram:
             if p == 0 and slot.persistent:
                 # `every`: each matching row forks a fresh token one state on
                 fork = M.any(axis=0) & v  # [B]
-                frank = (jnp.cumsum(fork) - fork).astype(jnp.int32)
+                frank = (jnp.cumsum(fork.astype(jnp.int32)) - fork).astype(jnp.int32)
                 free = ~tok["active"]
-                free_idx = jnp.nonzero(free, size=B, fill_value=-1)[0]
+                from siddhi_tpu.ops.prefix import first_indices
+                free_idx = first_indices(free, B)
                 dest = jnp.where(fork, free_idx[jnp.clip(frank, 0, B - 1)], -1)
                 okf = fork & (dest >= 0)
                 overflow = overflow | (fork & (dest < 0)).any()
@@ -1540,7 +1543,7 @@ class PatternProgram:
         key = jnp.where(done, entry_row.astype(jnp.int64) * T + toks, np.int64(1) << 60)
         order = jnp.argsort(key).astype(jnp.int32)  # done tokens first, row order
         d_sorted = done[order]
-        rank = (jnp.cumsum(d_sorted) - d_sorted).astype(jnp.int32)
+        rank = (jnp.cumsum(d_sorted.astype(jnp.int32)) - d_sorted).astype(jnp.int32)
         dest = jnp.where(d_sorted & (out_n + rank < cap), out_n + rank, cap)
         overflow = overflow | (d_sorted & (out_n + rank >= cap)).any()
         src = order  # token index per sorted position
@@ -1599,7 +1602,7 @@ class PatternProgram:
 
     def _write_emits(self, out, out_n, overflow, emit, tok, ts):
         cap = out["valid"].shape[0]
-        rank = jnp.cumsum(emit) - 1
+        rank = jnp.cumsum(emit.astype(jnp.int32)) - 1
         dest_raw = out_n + rank
         ok = emit & (dest_raw < cap)
         dest = jnp.where(ok, dest_raw, cap)

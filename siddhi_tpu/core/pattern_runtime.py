@@ -86,6 +86,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
         )
         self._setup_output(query, query_id)
         self._attach_tables(tables, interner)
+        self._scope = self.prog.scope
         self.needs_scheduler = self.prog.needs_scheduler
         self.timer_target = None
         self._steps = {

@@ -269,6 +269,16 @@ class BaseQueryRuntime:
     callback/junction routing, state container (reference: QueryRuntime.java:45
     + OutputParser callback construction)."""
 
+    @property
+    def used_attrs(self):
+        """Input attribute names this query can ever read (from the compile
+        scope's resolved keys), or None when unknown/everything (select *).
+        Fused ingest drops un-read columns from the wire."""
+        scope = getattr(self, "_scope", None)
+        if scope is None or getattr(self.query.selector, "select_all", False):
+            return None
+        return {k[2] for k in scope.used_keys}
+
     def _setup_output(self, query: "Query", query_id: str) -> None:
         out = query.output_stream
         if isinstance(out, InsertIntoStream):
@@ -558,6 +568,7 @@ class QueryRuntime(BaseQueryRuntime):
                 return make_window(spec, schema, ref, _scope)
 
         self.chain = CompiledSingleChain(stream, in_schema, scope, window_factory)
+        self._scope = scope
         self.selector = CompiledSelector(
             query.selector,
             scope,

@@ -281,7 +281,7 @@ class CompiledSelector:
                 cols={n: c[perm] for n, c in out.cols.items()},
             )
         if self.limit is not None or self.offset is not None:
-            rank = jnp.cumsum(out.valid) - out.valid.astype(jnp.int32)
+            rank = jnp.cumsum(out.valid.astype(jnp.int32)) - out.valid.astype(jnp.int32)
             lo = 0 if self.offset is None else int(self.offset)
             hi = _BIG if self.limit is None else lo + int(self.limit)
             out = EventBatch(

@@ -261,8 +261,9 @@ class InMemoryTable:
         aux["table_overflow"] = aux.get(
             "table_overflow", jnp.zeros((), jnp.bool_)
         ) | (n_rows > n_free)
-        free_idx = jnp.nonzero(free, size=b, fill_value=-1)[0]  # first B free slots
-        rank = jnp.cumsum(rows) - 1  # rank of each inserting row
+        from siddhi_tpu.ops.prefix import first_indices
+        free_idx = first_indices(free, b)  # first B free slots
+        rank = jnp.cumsum(rows.astype(jnp.int32)) - 1  # rank of each inserting row
         slot = jnp.where(rows, free_idx[jnp.clip(rank, 0, b - 1)], -1)
         ok = rows & (slot >= 0)
         # non-inserting rows scatter out of bounds and are dropped

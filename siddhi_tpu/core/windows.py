@@ -138,7 +138,7 @@ class SlidingWindow(WindowStage):
             bwts = b.cols[self.time_attr].astype(jnp.int64)
         else:
             bwts = b.ts
-        rank = jnp.cumsum(valid_cur) - valid_cur.astype(jnp.int32)
+        rank = jnp.cumsum(valid_cur.astype(jnp.int32)) - valid_cur.astype(jnp.int32)
         c = valid_cur.sum(dtype=jnp.int32)
         seq_batch = jnp.where(valid_cur, total + rank, np.int64(-1))
 
@@ -456,7 +456,7 @@ class BatchWindow(WindowStage):
             if self.time_attr is not None
             else b.ts
         )
-        rank = jnp.cumsum(valid_cur) - valid_cur.astype(jnp.int32)
+        rank = jnp.cumsum(valid_cur.astype(jnp.int32)) - valid_cur.astype(jnp.int32)
         c = valid_cur.sum(dtype=jnp.int32)
         perm = jnp.argsort(~valid_cur, stable=True).astype(jnp.int32)  # rank -> row
         cur_n0 = state["cur_n"]
@@ -488,13 +488,15 @@ class BatchWindow(WindowStage):
                 )
             rel = jnp.maximum(bwts - start0, 0)
             g = jnp.where(trigger_ok & (start0 >= 0), rel // self.t, np.int64(0))
-            open_g = jax.lax.associative_scan(jnp.maximum, g)
+            from siddhi_tpu.ops.prefix import cummax as _cummax
+
+            open_g = _cummax(g)
             prev_open = jnp.concatenate([jnp.zeros((1,), jnp.int64), open_g[:-1]])
             had_bucket = (state["bucket_start"] >= 0) | (
-                jnp.cumsum(trigger_ok) - trigger_ok.astype(jnp.int32) > 0
+                jnp.cumsum(trigger_ok.astype(jnp.int32)) - trigger_ok.astype(jnp.int32) > 0
             )
             flush_here = trigger_ok & (g > prev_open) & had_bucket
-            e_row = jnp.cumsum(flush_here)  # inclusive: flush at i precedes row i
+            e_row = jnp.cumsum(flush_here.astype(jnp.int32))  # inclusive: flush at i precedes row i
             n_flush = flush_here.sum(dtype=jnp.int32)
             row_of_flush = jnp.where(
                 rows < n_flush,
@@ -601,7 +603,7 @@ class BatchWindow(WindowStage):
         if self.n is not None:
             rem_slot = jnp.where(remaining, pos - n_flush * self.n, w)
         else:
-            rem_rank = jnp.cumsum(remaining) - remaining.astype(jnp.int32)
+            rem_rank = jnp.cumsum(remaining.astype(jnp.int32)) - remaining.astype(jnp.int32)
             rem_slot = jnp.where(
                 remaining, rem_rank + jnp.where(keep_carried, cur_n0, 0), w
             )
@@ -617,7 +619,7 @@ class BatchWindow(WindowStage):
         in_last = row_emit & (e_row == n_flush - 1)
         carried_in_last = carried_valid & (n_flush == 1)
         n_carried_last = jnp.where(n_flush == 1, cur_n0, 0)
-        lb_rank = jnp.cumsum(in_last) - in_last.astype(jnp.int32)
+        lb_rank = jnp.cumsum(in_last.astype(jnp.int32)) - in_last.astype(jnp.int32)
         lb_slot_c = jnp.where(carried_in_last, cw, w).astype(jnp.int32)
         lb_slot_b = jnp.where(in_last, n_carried_last + lb_rank, w).astype(jnp.int32)
 
@@ -700,6 +702,7 @@ def make_window(
         )
     if name == "externaltime":
         attr = _time_attr(spec, 0, schema)
+        scope.record_key((ref, None, attr))
         t = _const_param(spec, 1, "duration")
         return SlidingWindow(
             schema, ref, capacity=time_capacity, duration_ms=t, time_attr=attr
@@ -716,6 +719,7 @@ def make_window(
         )
     if name == "externaltimebatch":
         attr = _time_attr(spec, 0, schema)
+        scope.record_key((ref, None, attr))
         t = _const_param(spec, 1, "duration")
         start = _const_param(spec, 2, "start time") if len(spec.parameters) > 2 else None
         return BatchWindow(
@@ -745,6 +749,8 @@ def make_window(
                 i += 1
             keys.append((p.attribute, desc))
             i += 1
+        for a, _d in keys:
+            scope.record_key((ref, None, a))
         return SortWindow(schema, ref, n, keys)
     if name == "frequent":
         from siddhi_tpu.core.windows_special import FrequentWindow
@@ -756,6 +762,8 @@ def make_window(
             if not isinstance(p, Variable):
                 raise SiddhiAppCreationError("frequent window keys must be attributes")
             attrs.append(p.attribute)
+        for a in (attrs or schema.attr_names):  # no keys = whole-event key
+            scope.record_key((ref, None, a))
         return FrequentWindow(schema, ref, n, attrs)
     if name == "lossyfrequent":
         from siddhi_tpu.core.windows_special import LossyFrequentWindow
@@ -775,6 +783,8 @@ def make_window(
                     "lossyFrequent window keys must be attributes"
                 )
             attrs.append(p.attribute)
+        for a in (attrs or schema.attr_names):  # no keys = whole-event key
+            scope.record_key((ref, None, a))
         return LossyFrequentWindow(schema, ref, float(support), float(error), attrs)
     if name == "cron":
         from siddhi_tpu.core.windows_special import CronWindow

@@ -122,7 +122,7 @@ def assign_slots(
     t_slot = jnp.argmax(eq_t, axis=1).astype(jnp.int32)
 
     is_alloc = active & ~in_t & (first == idx)
-    alloc_rank = (jnp.cumsum(is_alloc) - is_alloc).astype(jnp.int32)
+    alloc_rank = (jnp.cumsum(is_alloc.astype(jnp.int32)) - is_alloc).astype(jnp.int32)
     slot_new = n_used + alloc_rank
     old_overflow = (jnp.where(is_alloc, slot_new, 0) >= g).any()
     old_slot = jnp.where(in_t, t_slot, jnp.where(slot_new[first] < g, slot_new[first], g))
@@ -132,7 +132,7 @@ def assign_slots(
     # the same head works for the fresh allocation pass)
     post_active = active & post
     is_alloc_f = post_active & (first == idx)
-    rank_f = (jnp.cumsum(is_alloc_f) - is_alloc_f).astype(jnp.int32)
+    rank_f = (jnp.cumsum(is_alloc_f.astype(jnp.int32)) - is_alloc_f).astype(jnp.int32)
     fresh_overflow = (jnp.where(is_alloc_f, rank_f, 0) >= g).any()
     fresh_slot = jnp.where(
         post_active & (rank_f[first] < g), rank_f[first], g

@@ -1,0 +1,66 @@
+"""Wire-format projection/narrowing for fused ingest (event.wire_codec)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+
+
+def test_projection_drops_unread_columns_and_shrinks_wire():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""@app:batch(size='64')
+    define stream S (symbol string, price float, volume long);
+    @info(name='q') from S[price > 50] select symbol, price insert into Out;
+    """)
+    rt.start()
+    fi = rt.junctions["S"].fused_ingest
+    assert fi is not None
+    fi._build()
+    assert fi._keep is not None and "volume" not in fi._keep
+    assert {"symbol", "price"} <= set(fi._keep)
+    # wire: 4B ts-delta + 4B symbol + 4B price = 12B/event vs 24B packed
+    assert fi._wire_bytes == 64 * 12
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_select_star_keeps_everything():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""@app:batch(size='64')
+    define stream S (symbol string, price float, volume long);
+    @info(name='q') from S select * insert into Out;
+    """)
+    rt.start()
+    fi = rt.junctions["S"].fused_ingest
+    fi._build()
+    assert fi._keep is None
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_wire_codec_roundtrip_with_dropped_column():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""@app:batch(size='8')
+    define stream S (symbol string, price float, volume long);
+    @info(name='q') from S select symbol insert into Out;
+    """)
+    rt.start()
+    schema = rt.junctions["S"].schema
+    enc, dec, nb = schema.wire_codec(8, frozenset({"symbol"}))
+    ts = np.arange(5, dtype=np.int64) + 1_700_000_000_000
+    cols = {
+        "symbol": np.arange(1, 6, dtype=np.int32),
+        "price": np.ones(5, np.float32),
+        "volume": np.ones(5, np.int64),
+    }
+    buf, base = enc(ts, cols, 5)
+    b = dec(buf, np.int32(5), base)
+    assert np.array_equal(np.asarray(b.ts[:5]), ts)
+    assert np.array_equal(np.asarray(b.cols["symbol"][:5]), cols["symbol"])
+    assert np.asarray(b.valid).sum() == 5
+    # dropped columns exist with schema dtype (null-filled)
+    assert b.cols["price"].shape == (8,)
+    assert b.cols["volume"].shape == (8,)
+    rt.shutdown()
+    mgr.shutdown()
