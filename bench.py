@@ -145,7 +145,7 @@ WORKLOADS = {
         insert into Out;
         """,
         "StockStream",
-        0.25,
+        1.0,
         8192,
     ),
     # BASELINE.json config 4: pattern `every A -> B within` (2-state NFA,
@@ -175,8 +175,8 @@ WORKLOADS = {
         insert into Out;
         """,
         "StockStream",
-        0.02,
-        1024,
+        0.5,
+        None,  # same batch as the sibling legs (VERDICT r2 item 2)
     ),
 }
 
@@ -380,6 +380,188 @@ def _leg_timebudget(batch=32768) -> dict:
     return out
 
 
+VERIFY_HEAD = (
+    "@app:batch(size='32')\n"
+    "define stream S (symbol string, price float, volume long);\n"
+)
+
+# ~20 representative behaviors for the CPU-vs-TPU differential (VERDICT r2
+# item 4): the same app + events run on both backends; rows must match within
+# float tolerance. Each case: (QL, store-queries to read afterwards).
+VERIFY_CASES = {
+    "filter_num": VERIFY_HEAD + "@info(name='q') from S[price > 50 and volume < 800] select symbol, price insert into Out;",
+    "filter_str": VERIFY_HEAD + "@info(name='q') from S[symbol == 'IBM' or symbol == 'WSO2'] select symbol, volume insert into Out;",
+    "arith_promote": VERIFY_HEAD + "@info(name='q') from S select symbol, price * 2 as p2, volume / 7 as v7, volume % 5 as v5 insert into Out;",
+    "builtins": VERIFY_HEAD + "@info(name='q') from S select ifThenElse(price > 50, 'hi', 'lo') as tag, cast(volume, 'double') as vd, maximum(price, 50.0) as mx insert into Out;",
+    "len_window_avg": VERIFY_HEAD + "@info(name='q') from S#window.length(7) select symbol, avg(price) as ap, sum(volume) as tv insert into Out;",
+    "len_window_minmax": VERIFY_HEAD + "@info(name='q') from S#window.length(5) select min(price) as mn, max(price) as mx insert into Out;",
+    "len_batch_group": VERIFY_HEAD + "@info(name='q') from S#window.lengthBatch(8) select symbol, sum(volume) as tv, count() as c group by symbol insert into Out;",
+    "time_window": "@app:playback\n" + VERIFY_HEAD + "@info(name='q') from S#window.time(40) select symbol, sum(volume) as tv insert into Out;",
+    "external_time": VERIFY_HEAD + "@info(name='q') from S#window.externalTime(volume, 500) select symbol, count() as c insert into Out;",
+    "stddev_distinct": VERIFY_HEAD + "@info(name='q') from S#window.length(9) select stdDev(price) as sd, distinctCount(symbol) as dc insert into Out;",
+    "having_order": VERIFY_HEAD + "@info(name='q') from S#window.lengthBatch(8) select symbol, sum(volume) as tv group by symbol having tv > 100 order by tv desc limit 3 insert into Out;",
+    "self_join": VERIFY_HEAD + """@app:joinCapacity(size='256')
+        @info(name='q') from S#window.length(4) as a join S#window.length(4) as b
+        on a.volume == b.volume select a.symbol as s1, b.symbol as s2 insert into Out;""",
+    "pattern_within": VERIFY_HEAD + """@app:patternCapacity(size='64')
+        @info(name='q') from every a=S[price > 90] -> b=S[price < 10] within 100 milliseconds
+        select a.symbol as s1, b.symbol as s2 insert into Out;""",
+    "count_seq": VERIFY_HEAD + """@app:patternCapacity(size='64')
+        @info(name='q') from every a=S[price > 80]<2:3> -> b=S[price < 20]
+        select b.symbol as s2 insert into Out;""",
+    "logical_pattern": VERIFY_HEAD + """@app:patternCapacity(size='64')
+        @info(name='q') from every (a=S[price > 90] and b=S[volume > 500])
+        select a.price as pa, b.volume as vb insert into Out;""",
+    "sort_window": VERIFY_HEAD + "@info(name='q') from S#window.sort(5, price) select min(price) as mn, count() as c insert into Out;",
+    "frequent": VERIFY_HEAD + "@info(name='q') from S#window.frequent(3, symbol) select symbol, count() as c insert into Out;",
+    "stream_fn": VERIFY_HEAD + "@info(name='q') from S#log('v') select symbol, price insert into Out;",
+}
+
+# cases observed via store queries over tables instead of callbacks
+VERIFY_TABLE_CASES = {
+    "table_crud": (
+        VERIFY_HEAD + """@capacity(size='512') define table T (symbol string, total long);
+        @info(name='w') from S#window.lengthBatch(8)
+        select symbol, sum(volume) as total group by symbol
+        update or insert into T on T.symbol == symbol;""",
+        "from T select symbol, total",
+    ),
+    "partitioned": (
+        VERIFY_HEAD + """@app:partitionCapacity(size='16')
+        @capacity(size='2048') define table T (symbol string, ap float);
+        partition with (symbol of S) begin
+        @info(name='w') from S[price > 20] select symbol, price as ap
+        insert into T;
+        end;""",
+        "from T select symbol, ap",
+    ),
+}
+
+
+def _leg_verify() -> dict:
+    """Run every verify case on the CURRENT backend and return its rows."""
+    from siddhi_tpu import SiddhiManager
+
+    rng = np.random.default_rng(99)
+    n = 96
+    ts = np.arange(n, dtype=np.int64) * 7 + 1_700_000_000_000
+    rows = [
+        (
+            ["WSO2", "IBM", "GOOG", "MSFT"][int(rng.integers(0, 4))],
+            float(np.round(rng.uniform(0.0, 100.0), 3)),
+            int(rng.integers(1, 1000)),
+        )
+        for _ in range(n)
+    ]
+    out: dict = {}
+    for name, ql in VERIFY_CASES.items():
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(ql)
+            got = []
+            rt.add_callback(
+                "q", lambda t, ins, rem: got.extend(
+                    [("+",) + tuple(e.data) for e in (ins or [])]
+                    + [("-",) + tuple(e.data) for e in (rem or [])]
+                )
+            )
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i, r in enumerate(rows):
+                h.send(r, timestamp=int(ts[i]))
+            rt.shutdown()
+            mgr.shutdown()
+            out[name] = got
+        except Exception as e:
+            out[name] = f"ERROR: {type(e).__name__}: {e}"
+    for name, (ql, sq) in VERIFY_TABLE_CASES.items():
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(ql)
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i, r in enumerate(rows):
+                h.send(r, timestamp=int(ts[i]))
+            out[name] = sorted(
+                tuple(e.data) for e in rt.query(sq)
+            )
+            rt.shutdown()
+            mgr.shutdown()
+        except Exception as e:
+            out[name] = f"ERROR: {type(e).__name__}: {e}"
+    import jax
+
+    return {"cases": out, "backend": jax.default_backend()}
+
+
+def _rows_match(a, b, tol=2e-4):
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, (list, tuple)):
+        return len(a) == len(b) and all(_rows_match(x, y, tol) for x, y in zip(a, b))
+    if isinstance(a, float):
+        if b == 0:
+            return abs(a) < tol
+        return abs(a - b) <= tol * max(1.0, abs(a), abs(b))
+    return a == b
+
+
+def _verify_tpu_vs_cpu(args) -> dict:
+    """Run the verify cases on the default (TPU) backend and on CPU in
+    separate subprocesses; diff per case with float tolerance."""
+    results = {}
+    backends = {}
+    for plat in ("tpu", "cpu"):
+        cmd = [sys.executable, os.path.abspath(__file__), "--leg", "verify_cases"]
+        env = dict(os.environ)
+        env["SIDDHI_TPU_AUX_DRAIN_S"] = "0"
+        if plat == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PALLAS_AXON_POOL_IPS"] = ""
+        else:
+            # the accelerator side must not inherit a dev shell's CPU pin,
+            # or the differential silently compares CPU against CPU
+            env.pop("JAX_PLATFORMS", None)
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=1300, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "{}"
+        got = json.loads(line) if proc.returncode == 0 else {}
+        results[plat] = got.get("cases", {})
+        backends[plat] = got.get("backend", "subprocess-failed")
+    per_case = {}
+    for name in sorted(set(results["tpu"]) | set(results["cpu"])):
+        a, b = results["tpu"].get(name), results["cpu"].get(name)
+        if isinstance(a, str) or isinstance(b, str):
+            per_case[name] = "FAIL"  # an ERROR on either side never passes
+            continue
+        # JSON round-trip turns tuples into lists on both sides equally
+        per_case[name] = "pass" if _rows_match(a, b) else "FAIL"
+    if backends["tpu"] == backends["cpu"]:
+        # same backend on both sides = no differential at all; fail loudly
+        per_case = {k: "FAIL(same-backend)" for k in per_case}
+    n_pass = sum(1 for v in per_case.values() if v == "pass")
+    artifact = {
+        "n_pass": n_pass,
+        "n_cases": len(per_case),
+        "backends": backends,
+        "per_case": per_case,
+    }
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "VERIFY.json"),
+            "w",
+        ) as f:
+            json.dump(
+                {**artifact, "tpu": results["tpu"], "cpu": results["cpu"]},
+                f, indent=1, default=str,
+            )
+    except Exception:
+        pass
+    return {"verify_pass": n_pass, "verify_cases": len(per_case)}
+
+
 def _run_leg(name: str, args) -> dict:
     if name in WORKLOADS:
         v = _leg_throughput(name, args.events, args.batch)
@@ -390,6 +572,10 @@ def _run_leg(name: str, args) -> dict:
         return _leg_p99()
     if name == "timebudget":
         return _leg_timebudget()
+    if name == "verify_cases":
+        return _leg_verify()
+    if name == "verify":
+        return _verify_tpu_vs_cpu(args)
     raise SystemExit(f"unknown leg {name!r}")
 
 
@@ -406,7 +592,7 @@ def main():
         return
 
     detail: dict = {}
-    legs = list(WORKLOADS) + ["p99", "tables", "timebudget"]
+    legs = list(WORKLOADS) + ["p99", "tables", "timebudget", "verify"]
     for leg in legs:
         cmd = [sys.executable, os.path.abspath(__file__), "--leg", leg,
                "--events", str(args.events), "--batch", str(args.batch)]
@@ -415,7 +601,8 @@ def main():
         env.setdefault("PYTHONPATH", os.path.dirname(os.path.abspath(__file__)))
         try:
             proc = subprocess.run(
-                cmd, capture_output=True, text=True, timeout=1200, env=env,
+                cmd, capture_output=True, text=True,
+                timeout=2800 if leg == "verify" else 1200, env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
             line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "{}"
@@ -425,7 +612,7 @@ def main():
                 print(f"# leg {leg} FAILED: {e}", file=sys.stderr)
                 if 'proc' in dir():
                     print(proc.stderr[-2000:], file=sys.stderr)
-            got = {}
+            got = {f"{leg}_error": f"{type(e).__name__}"}
         detail.update(got)
         if args.verbose:
             print(f"# {leg}: {got}")

@@ -35,6 +35,19 @@ KIND_RESET = 3
 Event = collections.namedtuple("Event", ["timestamp", "data"])
 
 
+def _bitcast_split(buf, offset: int, cap: int, dt: np.dtype):
+    """Slice one column section out of a packed uint8 buffer and bitcast it
+    to its dtype — shared by packed_codec and wire_codec so the 1-byte-wide
+    special case lives in exactly one place."""
+    seg = jax.lax.slice(buf, (offset,), (offset + cap * dt.itemsize,))
+    w = dt.itemsize
+    if w == 1:
+        return jax.lax.bitcast_convert_type(seg, jnp.dtype(dt))
+    return jax.lax.bitcast_convert_type(
+        seg.reshape(cap, w), jnp.dtype(dt)
+    ).reshape(cap)
+
+
 
 
 @jax.tree_util.register_dataclass
@@ -239,14 +252,7 @@ class StreamSchema:
             cols_out = {}
             ts = None
             for (name, dt), o in zip(sections, offsets):
-                seg = jax.lax.slice(buf, (o,), (o + cap * dt.itemsize,))
-                w = dt.itemsize
-                if w == 1:
-                    arr = jax.lax.bitcast_convert_type(seg, jnp.dtype(dt))
-                else:
-                    arr = jax.lax.bitcast_convert_type(
-                        seg.reshape(cap, w), jnp.dtype(dt)
-                    ).reshape(cap)
+                arr = _bitcast_split(buf, o, cap, dt)
                 if name == "__ts__":
                     ts = arr
                 else:
@@ -310,7 +316,16 @@ class StreamSchema:
             for (name, dt), o in zip(sections, offsets):
                 dst = buf[o : o + cap * dt.itemsize].view(dt)
                 if name == "__tsd__":
-                    dst[:n] = (timestamps[:n] - base).astype(np.int32)
+                    deltas = timestamps[:n] - base
+                    if n > 0 and (
+                        int(deltas.max(initial=0)) >= (1 << 31)
+                        or int(deltas.min(initial=0)) < -(1 << 31)
+                    ):
+                        raise ValueError(
+                            "wire_codec: timestamp span exceeds int32 deltas "
+                            "(>~24.8 days per batch); use packed_codec"
+                        )
+                    dst[:n] = deltas.astype(np.int32)
                 else:
                     dst[:n] = cols[name][:n].astype(dt, copy=False)
             return buf, base
@@ -319,11 +334,7 @@ class StreamSchema:
             cols_out = {}
             ts = None
             for (name, dt), o in zip(sections, offsets):
-                seg = jax.lax.slice(buf, (o,), (o + cap * dt.itemsize,))
-                w = dt.itemsize
-                arr = jax.lax.bitcast_convert_type(
-                    seg.reshape(cap, w), jnp.dtype(dt)
-                ).reshape(cap)
+                arr = _bitcast_split(buf, o, cap, dt)
                 if name == "__tsd__":
                     ts = base + arr.astype(jnp.int64)
                 else:

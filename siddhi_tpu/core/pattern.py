@@ -78,6 +78,7 @@ from siddhi_tpu.query_api.execution import (
     StateStreamType,
     StreamStateElement,
 )
+from siddhi_tpu.ops.prefix import first_indices
 from siddhi_tpu.query_api.expression import Expression
 
 NO_TIMER = np.int64(np.iinfo(np.int64).max)
@@ -1146,7 +1147,6 @@ class PatternProgram:
             Mc = jnp.zeros((B,), dtype=jnp.bool_)
         midx_excl = jnp.cumsum(Mc.astype(jnp.int32)) - Mc.astype(jnp.int32)
         k_total = midx_excl[-1] + Mc[-1].astype(jnp.int32)
-        from siddhi_tpu.ops.prefix import first_indices
         mrow = first_indices(Mc, B, fill=B)
         mrow_c = jnp.clip(mrow, 0, B - 1)
         mts = batch_ts[mrow_c]
@@ -1246,7 +1246,6 @@ class PatternProgram:
             # scatter generations into free lanes
             free = ~tok["active"]
             nfree = jnp.sum(free)
-            from siddhi_tpu.ops.prefix import first_indices
             free_idx = first_indices(free, Gmax)
             grank = (jnp.cumsum(valid_g.astype(jnp.int32)) - 1).astype(jnp.int32)
             okg = valid_g & (grank < nfree) & (free_idx[jnp.clip(grank, 0, Gmax - 1)] >= 0)
@@ -1478,7 +1477,6 @@ class PatternProgram:
                 fork = M.any(axis=0) & v  # [B]
                 frank = (jnp.cumsum(fork.astype(jnp.int32)) - fork).astype(jnp.int32)
                 free = ~tok["active"]
-                from siddhi_tpu.ops.prefix import first_indices
                 free_idx = first_indices(free, B)
                 dest = jnp.where(fork, free_idx[jnp.clip(frank, 0, B - 1)], -1)
                 okf = fork & (dest >= 0)

@@ -125,11 +125,12 @@ class CompiledSingleChain:
 class _AuxWarnPool:
     """Deferred aux-flag checks with NO background thread.
 
-    The hot dispatch path never blocks on device scalars; submitted flags are
-    coalesced ON DEVICE (an async dispatch, safe from any thread) and the one
-    blocking device->host read happens only (a) in `flush()` and (b) at most
-    once per `DRAIN_EVERY_S` from a main-thread submit. Transfers are pinned
-    to the main thread on purpose: on some tunneled PJRT backends a
+    The hot dispatch path never blocks on device scalars (and does no device
+    work at all — even eager coalesce ops cost seconds through a degraded
+    relay): submitted flags accumulate in a bounded host-side backlog, and
+    the one blocking device->host read happens only (a) in `flush()` and
+    (b) at most once per `drain_every_s` from a main-thread submit.
+    Transfers are pinned to the main thread on purpose: on some tunneled PJRT backends a
     device->host read issued from a helper thread permanently degrades every
     subsequent dispatch in the process (measured ~2.5 ms/call), so a daemon
     drain thread would un-do the engine's own fast path.
@@ -148,7 +149,6 @@ class _AuxWarnPool:
         self._lock = threading.Lock()
         # id(qr) -> [qr_weakref, {flag_kind: [device bools]}]
         self._pending: dict = {}
-        self._counts: dict = {}
         self._last_drain = _time.monotonic()
         # periodic-drain cadence; 0 or negative disables automatic drains
         # (flush()/shutdown still drain) — benches that must keep the relay
@@ -181,19 +181,16 @@ class _AuxWarnPool:
             if ent is None:
                 ent = [self._weakref.ref(qr), {k: [] for k in flags}]
                 self._pending[id(qr)] = ent
-                self._counts[id(qr)] = 0
             acc = ent[1]
             for k, v in flags.items():
-                acc.setdefault(k, []).append(v)
-            self._counts[id(qr)] += 1
-            if self._counts[id(qr)] >= self.COALESCE_AT:
-                # async on-device OR — keeps the backlog O(kinds), no read
-                for k, vs in acc.items():
-                    if len(vs) > 1:
-                        acc[k] = [jnp.stack(
-                            [jnp.asarray(v).astype(bool) for v in vs]
-                        ).any()]
-                self._counts[id(qr)] = 0
+                vs = acc.setdefault(k, [])
+                vs.append(v)
+                # bound the backlog with NO device work (eager coalesce ops
+                # through a degraded relay cost seconds): keep the first
+                # COALESCE_AT flags (overflows usually start early) plus a
+                # ring of the most recent ones
+                if len(vs) > 2 * self.COALESCE_AT:
+                    del vs[self.COALESCE_AT]
         import time as _time
 
         if (
@@ -212,7 +209,6 @@ class _AuxWarnPool:
 
         with self._lock:
             pending, self._pending = self._pending, {}
-            self._counts = {}
             self._last_drain = _time.monotonic()
         plan = []  # (qr, [keys]) aligned with scalars
         scalars = []

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.ops.prefix import first_indices
 from siddhi_tpu.core.event import EventBatch, KIND_CURRENT, StreamSchema
 from siddhi_tpu.core.executor import (
     CompiledExpr,
@@ -261,7 +262,6 @@ class InMemoryTable:
         aux["table_overflow"] = aux.get(
             "table_overflow", jnp.zeros((), jnp.bool_)
         ) | (n_rows > n_free)
-        from siddhi_tpu.ops.prefix import first_indices
         free_idx = first_indices(free, b)  # first B free slots
         rank = jnp.cumsum(rows.astype(jnp.int32)) - 1  # rank of each inserting row
         slot = jnp.where(rows, free_idx[jnp.clip(rank, 0, b - 1)], -1)

@@ -36,6 +36,7 @@ from siddhi_tpu.core.event import (
     StreamSchema,
 )
 from siddhi_tpu.core.executor import Env, Scope, TS_ATTR, compile_expression
+from siddhi_tpu.ops.prefix import cummax as _cummax
 from siddhi_tpu.core.flow import Flow
 from siddhi_tpu.core.types import AttrType
 from siddhi_tpu.query_api.definition import WindowSpec
@@ -488,8 +489,6 @@ class BatchWindow(WindowStage):
                 )
             rel = jnp.maximum(bwts - start0, 0)
             g = jnp.where(trigger_ok & (start0 >= 0), rel // self.t, np.int64(0))
-            from siddhi_tpu.ops.prefix import cummax as _cummax
-
             open_g = _cummax(g)
             prev_open = jnp.concatenate([jnp.zeros((1,), jnp.int64), open_g[:-1]])
             had_bucket = (state["bucket_start"] >= 0) | (

@@ -27,7 +27,11 @@ def transfer_degrades_dispatch() -> bool:
 
             client = jax.devices()[0].client
             pv = getattr(client, "platform_version", "") or ""
-            _TRANSFER_DEGRADES = pv.startswith("axon")
+            # under PJRT the version string is multi-line:
+            # "PJRT C API\naxon 0.1.0; ..."; under IFRT it starts with "axon"
+            _TRANSFER_DEGRADES = any(
+                line.startswith("axon") for line in pv.splitlines()
+            )
         except Exception:
             _TRANSFER_DEGRADES = False
     return _TRANSFER_DEGRADES
@@ -37,6 +41,16 @@ def host_callbacks_supported() -> bool:
     """True when jax io/debug callbacks execute on the default backend."""
     global _CB_SUPPORT
     if _CB_SUPPORT is None:
+        if transfer_degrades_dispatch():
+            # tunneled relays ack async work speculatively, so a
+            # block_until_ready probe would "succeed" and the UNIMPLEMENTED
+            # error only surfaces at first real completion — and forcing
+            # completion here would flip the relay out of its fast mode.
+            # These backends reject host send/recv callbacks anyway.
+            _CB_SUPPORT = False
+            return _CB_SUPPORT
+        import numpy as _np
+
         import jax
         import jax.numpy as jnp
         from jax.experimental import io_callback
@@ -47,7 +61,10 @@ def host_callbacks_supported() -> bool:
             )
 
         try:
-            jax.jit(probe)(jnp.int32(0)).block_until_ready()
+            # the readback (not just block) forces real completion, so a
+            # backend that accepts the launch but fails the callback at
+            # execution time is still detected
+            _np.asarray(jax.jit(probe)(jnp.int32(0)))
             _CB_SUPPORT = True
         except Exception:
             _CB_SUPPORT = False
