@@ -292,21 +292,28 @@ class AggregationRuntime:
         record store; rows are small and this runs once at creation."""
         import numpy as np
 
-        fine_tbl = self.tables[self.durations[0]]
-        f_state = fine_tbl.state
-        f_valid = np.asarray(f_state["valid"])
-        if not f_valid.any():
-            return
-        latest = int(np.asarray(f_state["cols"][AGG_TS])[f_valid].max())
-
         for i in range(1, len(self.durations)):
             d = self.durations[i]
             src = self.tables[self.durations[i - 1]].state
             valid = np.asarray(src["valid"])
             if not valid.any():
-                continue
+                continue  # only skip durations whose OWN source is empty
             ts = np.asarray(src["cols"][AGG_TS])[valid]
+            # the open bucket is judged by each SOURCE table's latest row —
+            # an empty finest table must not suppress coarser rebuilds from
+            # the intermediate duration tables
+            latest = int(ts.max())
             open_bucket = int(align_bucket(jnp.asarray(latest), d))
+            own = self.tables[d].state
+            own_valid = np.asarray(own["valid"])
+            if own_valid.any() and (
+                np.asarray(own["cols"][AGG_TS])[own_valid] == open_bucket
+            ).any():
+                # this bucket already closed and spilled into d's own table
+                # (e.g. the finer table's tail predates the spill); treating
+                # it as in-flight again would double-insert it at the next
+                # close — spill is a plain insert with no AGG_TS dedupe
+                continue
             in_open = np.asarray(
                 align_bucket(jnp.asarray(ts), d)
             ) == open_bucket

@@ -322,6 +322,24 @@ class PartitionedPatternQueryRuntime:
         pk, pu, pn, slot, _grp, povf = assign_slots(
             ptable["keys"], ptable["used"], ptable["n"], keys, active
         )
+        # a lane allocated to a key seen for the FIRST time must start with
+        # freshly-stamped token state: all lanes share the vmapped state and
+        # have had their virgin tokens' absent deadlines advancing since app
+        # start, so a late key would otherwise inherit an already-elapsed
+        # absence window (reference: AbsentStreamPreStateProcessor is armed
+        # at partition-INSTANCE creation, PartitionRuntime.java:256-315)
+        fresh = pu & ~ptable["used"]
+        init_lane = self._inner.init_state(now)
+
+        def _do_refresh(st):
+            def _refresh(cur, init):
+                mask = fresh.reshape((self.p,) + (1,) * (cur.ndim - 1))
+                return jnp.where(mask, jnp.broadcast_to(init, cur.shape), cur)
+
+            return jax.tree_util.tree_map(_refresh, st, init_lane)
+
+        # steady state allocates no lanes: skip the full-state rewrite
+        states = jax.lax.cond(fresh.any(), _do_refresh, lambda st: st, states)
         is_timer = batch.valid & (batch.kind == KIND_TIMER)
         step = self._inner._make_step(stream_id)
 
@@ -332,6 +350,19 @@ class PartitionedPatternQueryRuntime:
             return st, out, aux
 
         states2, outs, auxs = jax.vmap(one)(states, jnp.arange(self.p))
+        # TIMER rows riding a stream batch reach every lane; outputs and
+        # timer re-arms from lanes with no live key must be masked just like
+        # the dedicated timer path does
+        outs = EventBatch(
+            outs.ts, outs.kind, outs.valid & pu[:, None], outs.cols
+        )
+        if "next_timer" in auxs:
+            auxs = {
+                **auxs,
+                "next_timer": jnp.where(
+                    pu, auxs["next_timer"], np.int64(NO_TIMER)
+                ),
+            }
         aux = _reduce_paux(auxs, povf)
         return {"keys": pk, "used": pu, "n": pn}, states2, outs, aux
 

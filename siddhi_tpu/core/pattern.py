@@ -38,8 +38,12 @@ Deliberate deviations from the reference interpreter (documented, test-covered):
   with m above `@app:countCapacity` still fires — only the first `cap`
   occurrences are retrievable;
 - absent states with a waiting time are supported standalone (`A -> not B for 5
-  sec`); inside logical elements only the kill/`and`-completion semantics are
-  implemented.
+  sec`) and inside logical elements (`A and not B for t` completes at the
+  deadline once every present side arrived; `A or not B for t` completes via
+  the present side immediately or at the deadline with the absent ref null —
+  reference: AbsentLogicalPreStateProcessor, LogicalAbsentPatternTestCase
+  testQueryAbsent11-16). A logical element whose BOTH sides are absent
+  (`not A for t and/or not B for t`) is not supported.
 """
 
 from __future__ import annotations
@@ -211,13 +215,6 @@ def _flatten_state(
         atoms = []
         for side in (elem.left, elem.right):
             if isinstance(side, AbsentStreamStateElement):
-                if (
-                    side.waiting_time_ms is not None
-                    and elem.type is not LogicalType.AND
-                ):
-                    raise SiddhiAppCreationError(
-                        "absent-with-waiting inside 'or' is not supported yet"
-                    )
                 atoms.append(
                     new_atom(
                         side.stream, absent=True,
@@ -545,8 +542,11 @@ class PatternProgram:
                 touched = touched | fire
             elif slot.logical is not None:
                 # `A and not B for t`: completes at the deadline once every
-                # present side has arrived (reference:
-                # AbsentLogicalPreStateProcessor waiting-time scheduling)
+                # present side has arrived. `A or not B for t`: completes at
+                # the deadline iff B never arrived inside the window (an A
+                # arrival would have advanced the token immediately).
+                # (reference: AbsentLogicalPreStateProcessor waiting-time
+                # scheduling for both logical types)
                 ab = next(
                     (
                         a for a in slot.atoms
@@ -556,15 +556,21 @@ class PatternProgram:
                 )
                 if ab is None:
                     continue
-                arrived = jnp.ones((self.T,), dtype=jnp.bool_)
-                for a2 in slot.atoms:
-                    if not a2.absent:
-                        arrived = arrived & (
-                            tok["caps"][a2.ref_idx]["n"] > 0
-                        )
                 at_p = tok["active"] & (tok["slot"] == p)
                 deadline = tok["entry_ts"] + ab.waiting_ms
-                fire = at_p & is_timer & arrived & (ts >= deadline)
+                if slot.logical is LogicalType.OR:
+                    # B's arrival was recorded as a capture marker (it must
+                    # not kill the token — A can still complete the or)
+                    b_arrived = tok["caps"][ab.ref_idx]["n"] > 0
+                    fire = at_p & is_timer & ~b_arrived & (ts >= deadline)
+                else:
+                    arrived = jnp.ones((self.T,), dtype=jnp.bool_)
+                    for a2 in slot.atoms:
+                        if not a2.absent:
+                            arrived = arrived & (
+                                tok["caps"][a2.ref_idx]["n"] > 0
+                            )
+                    fire = at_p & is_timer & arrived & (ts >= deadline)
                 if p == last:
                     out, out_n, overflow = self._write_emits(
                         out, out_n, overflow, fire, tok, deadline
@@ -605,6 +611,26 @@ class PatternProgram:
                 for c in self._conds[(p, atom.ref_idx)]:
                     match = match & c(env)
                 if atom.absent:
+                    if (
+                        slot.logical is LogicalType.OR
+                        and atom.waiting_ms is not None
+                    ):
+                        # `A or not B for t`: B's arrival inside the window
+                        # must not kill the token (A can still satisfy the
+                        # or) — record it as a capture marker so the TIMER
+                        # path knows the absent side can never fire
+                        # (reference: AbsentLogicalPreStateProcessor OR —
+                        # the partner processor keeps waiting)
+                        mark = match & (
+                            ts <= tok["entry_ts"] + atom.waiting_ms
+                        )
+                        new_caps = list(tok["caps"])
+                        new_caps[atom.ref_idx] = self._capture(
+                            tok["caps"][atom.ref_idx], atom, mark, ts, ev
+                        )
+                        tok = {**tok, "caps": new_caps}
+                        slot_touch = slot_touch | mark
+                        continue
                     # arrival on an absent stream kills the token
                     # (reference: AbsentStreamPreStateProcessor.process kill);
                     # with a waiting time, only arrivals INSIDE the window

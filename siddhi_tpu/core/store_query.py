@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from siddhi_tpu.core.errors import (
     DefinitionNotExistError,
     SiddhiAppCreationError,
+    SiddhiAppRuntimeError,
 )
 from siddhi_tpu.core.event import Event, EventBatch, StreamSchema
 from siddhi_tpu.core.executor import Scope, compile_expression
@@ -132,12 +133,20 @@ class StoreQueryRuntime:
         self.out_schema = StreamSchema(f"__sq_{self.ref}", self.selector.out_attrs)
         self.interner = interner
 
+        self._write_target = getattr(sq.output_stream, "target", None)
+        if sq.output_stream is not None and self._write_target not in self.tables:
+            # a store query has no stream junctions: its insert/update/delete
+            # target MUST be a defined table (reference: StoreQueryParser
+            # resolves the target against the table map and fails otherwise)
+            raise DefinitionNotExistError(
+                f"store query target '{self._write_target}' is not a "
+                "defined table"
+            )
         self.table_op = (
             compile_table_output(sq.output_stream, self.out_schema, self.tables, interner)
             if sq.output_stream is not None
             else None
         )
-        self._write_target = getattr(sq.output_stream, "target", None)
         self._step = jax.jit(self._step_impl)
 
     # ---- device program --------------------------------------------------
@@ -201,12 +210,14 @@ class StoreQueryRuntime:
                 ) else None
                 rows = t.record_store.query(on, self.interner)
                 if rows is None:
-                    raise SiddhiAppCreationError(
+                    # a per-execution failure, not a deployment error
+                    # (reference: StoreQuery runtime exceptions)
+                    raise SiddhiAppRuntimeError(
                         f"table '{tid}': lazy record store did not push the "
                         "condition down (query() returned None)"
                     )
                 if len(rows) > t.capacity:
-                    raise SiddhiAppCreationError(
+                    raise SiddhiAppRuntimeError(
                         f"table '{tid}': pushdown returned {len(rows)} rows "
                         f"but capacity is {t.capacity}; narrow the condition "
                         "or raise @capacity(size='N')"

@@ -596,8 +596,12 @@ def collect_used_tables(query, tables: dict[str, InMemoryTable]) -> set[str]:
 def _check_positional_schema(
     out_schema: StreamSchema, table: InMemoryTable, what: str
 ) -> None:
-    """Positional attribute mapping requires matching arity and types
-    (reference: DefinitionParserHelper validateOutputStream)."""
+    """Positional attribute mapping requires matching arity and types, with
+    Java implicit numeric widening allowed (reference: DefinitionParserHelper
+    validateOutputStream; StoreQueryParser coerces numeric constants into
+    wider columns — e.g. an INT literal inserts into a LONG column)."""
+    from siddhi_tpu.core.types import NUMERIC_TYPES, promote
+
     if len(out_schema.attrs) != len(table.schema.attrs):
         raise SiddhiAppCreationError(
             f"{what} table '{table.table_id}': selector emits "
@@ -605,11 +609,18 @@ def _check_positional_schema(
             f"{len(table.schema.attrs)}"
         )
     for (on_, ot), (tn, tt) in zip(out_schema.attrs, table.schema.attrs):
-        if ot is not tt:
-            raise SiddhiAppCreationError(
-                f"{what} table '{table.table_id}': output attribute "
-                f"'{on_}' is {ot.name} but table column '{tn}' is {tt.name}"
-            )
+        if ot is tt:
+            continue
+        if (
+            ot in NUMERIC_TYPES
+            and tt in NUMERIC_TYPES
+            and promote(ot, tt) is tt
+        ):
+            continue  # widening coercion; the op's astype performs it
+        raise SiddhiAppCreationError(
+            f"{what} table '{table.table_id}': output attribute "
+            f"'{on_}' is {ot.name} but table column '{tn}' is {tt.name}"
+        )
 
 
 def compile_set_attributes(

@@ -231,3 +231,117 @@ class TestLogicalAbsentPatternGolden:
             ("Stream1", ("W", 5.0, 1)), ("Stream2", ("W", 5.0, 1)),
         ])
         assert got == [("A1",), ("A2",)], got
+
+
+class TestOrAbsentWithWaitingGolden:
+    """`A or not B for t` forms — reference LogicalAbsentPatternTestCase
+    testQueryAbsent11-16 (data translated, 1 sec scaled to 150 ms)."""
+
+    QL = S123 + """
+    @info(name = 'query1')
+    from e1=Stream1[price>10] -> not Stream2[price>20] for 150 milliseconds or e3=Stream3[price>30]
+    select e1.symbol as symbol1, e3.symbol as symbol3
+    insert into OutputStream ;
+    """
+    WARM = (
+        ("Stream1", ("X", 1.0, 1)),
+        ("Stream2", ("X", 1.0, 1)),
+        ("Stream3", ("X", 1.0, 1)),
+    )
+
+    def test_or11_present_side_completes(self):
+        # testQueryAbsent11: e1 then e3 -> one event via the present side
+        got = run_timed(self.QL, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("sleep", 0.05),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+        ], warm=self.WARM)
+        assert got == [("WSO2", "GOOGLE")]
+
+    def test_or12_no_duplicate_at_deadline(self):
+        # testQueryAbsent12: completion via e3 then waiting past the deadline
+        # must not emit a second (absent-side) event
+        got = run_timed(self.QL, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("sleep", 0.05),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+            ("sleep", 0.3),
+        ], warm=self.WARM)
+        assert got == [("WSO2", "GOOGLE")]
+
+    def test_or13_absent_side_fires_with_null_ref(self):
+        # testQueryAbsent13: e1 only; the deadline fires with e3 = null
+        got = run_timed(self.QL, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("sleep", 0.4),
+        ], warm=self.WARM)
+        assert got == [("WSO2", None)]
+
+    def test_or14_nothing_before_deadline(self):
+        # testQueryAbsent14: e1 only, checked before the waiting time elapses
+        got = run_timed(self.QL, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+        ], settle=0.05, warm=self.WARM)
+        assert got == []
+
+    def test_or15_b_arrival_disables_absent_side(self):
+        # testQueryAbsent15 shape: e1 then e2 inside the window; no e3 ->
+        # nothing may fire, even after the deadline
+        got = run_timed(self.QL, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("sleep", 0.05),
+            ("send", "Stream2", ("IBM", 25.0, 100)),
+            ("sleep", 0.3),
+        ], warm=self.WARM)
+        assert got == []
+
+    def test_or16_b_arrival_then_present_still_completes(self):
+        # e2 disables only the absent side: a later e3 still completes the or
+        got = run_timed(self.QL, [
+            ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ("sleep", 0.05),
+            ("send", "Stream2", ("IBM", 25.0, 100)),
+            ("sleep", 0.3),
+            ("send", "Stream3", ("GOOGLE", 35.0, 100)),
+        ], warm=self.WARM)
+        assert got == [("WSO2", "GOOGLE")]
+
+
+class TestPartitionedAbsentLateKey:
+    def test_late_key_gets_a_fresh_absence_window(self):
+        """A key first seen long after app start must wait the full absence
+        window from ITS first event, not inherit the shared lane's elapsed
+        clock (reference: AbsentStreamPreStateProcessor armed at
+        partition-instance creation, PartitionRuntime.java:256-315)."""
+        import time as _t
+
+        from siddhi_tpu import SiddhiManager
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:partitionCapacity(size='8')
+        define stream S (k string, price float);
+        partition with (k of S)
+        begin
+            @info(name = 'q')
+            from not S[price > 100] for 150 milliseconds -> e2=S[price < 50]
+            select e2.k as k
+            insert into Out;
+        end;
+        """)
+        got = []
+        rt.add_callback("q", lambda ts, i, r: got.extend(tuple(e.data) for e in i or []))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(("WARM", 75.0))  # compile warm-up; matches neither side
+        _t.sleep(0.5)           # well past the absence window from app start
+        h.send(("X", 10.0))     # X's FIRST event: must NOT complete yet
+        _t.sleep(0.05)
+        n_after_first = len(got)
+        _t.sleep(0.4)           # X's own absence window elapses
+        h.send(("X", 10.0))     # now the advanced token completes
+        _t.sleep(0.3)
+        rt.shutdown()
+        mgr.shutdown()
+        assert n_after_first == 0, f"late key inherited an elapsed window: {got}"
+        assert ("X",) in got
