@@ -186,9 +186,29 @@ class InMemoryTable:
 
     # ---- state ------------------------------------------------------------
 
+    @property
+    def _pk_indexed(self) -> bool:
+        """True once some compiled query actually uses the PK probe path:
+        only then does state carry (and inserts maintain) the sorted-key
+        index — a @PrimaryKey table used purely for overwrite semantics
+        must not pay an O(C log C) sort per ingest batch."""
+        return len(self.primary_keys) == 1 and self._pk_index_used
+
+    _pk_index_used = False
+
+    def enable_pk_index(self) -> None:
+        """Called at query-compile time by compile_table_output when a
+        `T.pk == probe` update compiles; upgrades live state in place."""
+        if self._pk_index_used or len(self.primary_keys) != 1:
+            self._pk_index_used = True
+            return
+        self._pk_index_used = True
+        with self.lock:
+            self.state = self._rebuild_pk_index(dict(self.state))
+
     def init_state(self):
         c = self.capacity
-        return {
+        st = {
             "cols": {
                 n: jnp.zeros((c,), a.dtype)
                 for n, a in self.schema.empty_batch(1).cols.items()
@@ -198,6 +218,22 @@ class InMemoryTable:
             "seq": jnp.full((c,), jnp.iinfo(jnp.int64).max, jnp.int64),
             "next": jnp.zeros((), jnp.int64),
         }
+        if self._pk_indexed:
+            kd = st["cols"][self.primary_keys[0]].dtype
+            st["pk_order"] = jnp.arange(c, dtype=jnp.int32)
+            st["pk_sorted"] = jnp.full((c,), _sort_sentinel(kd), kd)
+        return st
+
+    def _rebuild_pk_index(self, state):
+        if not self._pk_indexed:
+            return state
+        keys = state["cols"][self.primary_keys[0]]
+        sent = _sort_sentinel(keys.dtype)
+        # valid rows first then keys ascending: a genuine max-valued key
+        # still sorts before the invalid tail, so it remains findable
+        order = jnp.lexsort((keys, ~state["valid"])).astype(jnp.int32)
+        sk = jnp.where(state["valid"][order], keys[order], sent)
+        return {**state, "pk_order": order, "pk_sorted": sk}
 
     def view(self, state):
         """(cols, ts, mask) — probe view, same contract as WindowStage.view."""
@@ -273,13 +309,15 @@ class InMemoryTable:
             return dst.at[slot_c].set(src.astype(dst.dtype), mode="drop")
 
         new_seq = state["next"] + rank
-        return {
+        out = {
+            **state,
             "cols": {n: scatter(state["cols"][n], batch.cols[n]) for n in state["cols"]},
             "ts": scatter(state["ts"], batch.ts),
             "valid": scatter(state["valid"], jnp.ones((b,), jnp.bool_)),
             "seq": scatter(state["seq"], new_seq),
             "next": state["next"] + n_rows.astype(jnp.int64),
         }
+        return self._rebuild_pk_index(out)
 
     def match(
         self,
@@ -324,10 +362,69 @@ class InMemoryTable:
         probe_ref,
         now,
         aux: dict,
+        parallel_ok: bool = False,
+        pk_probe=None,
+        reindex_after: bool = False,
     ):
-        """Sequential per-probe-row update (reference: InMemoryTable.update
-        iterates the updating chunk event by event)."""
+        """Update matching table rows from each probe row.
+
+        `parallel_ok` (decided at compile time by
+        `_update_parallel_vectorizable`) selects a fully vectorized one-pass
+        form: per table slot, the LAST matching probe row wins — provably
+        equal to the reference's event-by-event iteration when the set
+        values are independent of table state and the on-condition's table
+        reads are stable under the update. Otherwise the sequential scan
+        reproduces InMemoryTable.update's row-at-a-time semantics exactly."""
         rows = batch.valid & (batch.kind == KIND_CURRENT)
+        if parallel_ok and pk_probe is not None:
+            return self._update_pk(
+                state, batch, pk_probe, set_fns, probe_ref, now, rows
+            )
+        if parallel_ok:
+            b = rows.shape[0]
+            c = self.capacity
+            pair = self.match(
+                state, batch.cols, batch.ts, probe_ref, on, now
+            ) & rows[:, None]
+            # keep every [C]-sized intermediate 2D ([C/128, 128]): 1D
+            # reductions/selects of this shape get placed in TPU scalar
+            # space (S(1)) and run ~1000x slower (profiled at C=1M)
+            L = 128
+            two_d = c % L == 0 and c >= L
+            if two_d:
+                pair = pair.reshape(b, c // L, L)
+            writer = jnp.where(
+                pair,
+                jnp.arange(b, dtype=jnp.int32).reshape(
+                    (b, 1, 1) if two_d else (b, 1)
+                ),
+                -1,
+            ).max(axis=0)  # last matching probe row per slot, -1 if none
+            has = writer >= 0
+            wi = jnp.clip(writer, 0, b - 1)
+            env_cols = {
+                (probe_ref, None, n): v[wi] for n, v in batch.cols.items()
+            }
+            env_cols[(probe_ref, None, TS_ATTR)] = batch.ts[wi]
+
+            def _flat(x):
+                return x.reshape(c) if two_d else x
+
+            env_cols = {k: _flat(v) for k, v in env_cols.items()}
+            has = _flat(has)
+            env_cols.update(
+                {(self.table_id, None, n): v for n, v in state["cols"].items()}
+            )
+            env_cols[(self.table_id, None, TS_ATTR)] = state["ts"]
+            env = Env(env_cols, now=now)
+            new_cols = dict(state["cols"])
+            for name, fn in set_fns:
+                new_cols[name] = jnp.where(
+                    has,
+                    fn(env).astype(state["cols"][name].dtype),
+                    state["cols"][name],
+                )
+            return {**state, "cols": new_cols}
 
         def body(carry, xs):
             cols = carry
@@ -350,6 +447,64 @@ class InMemoryTable:
 
         xs = (batch.cols, batch.ts, rows)
         new_cols, _ = lax.scan(body, state["cols"], xs)
+        out = {**state, "cols": new_cols}
+        return self._rebuild_pk_index(out) if reindex_after else out
+
+    def _update_pk(self, state, batch, pk_probe, set_fns, probe_ref, now, rows):
+        """O(B log C) primary-key update: sort the key column once per batch
+        and binary-search each probe key instead of the O(B*C) dense compare
+        (reference: IndexEventHolder primary-key HashMap put/get,
+        table/holder/IndexEventHolder.java:59-110). Taken when the condition
+        is exactly `T.pk == <probe expr>` for the table's sole @PrimaryKey —
+        uniqueness makes one candidate row per probe exact."""
+        pk_col, probe_fn = pk_probe
+        b = rows.shape[0]
+        c = self.capacity
+        keys = state["cols"][pk_col]
+        order = state["pk_order"]
+        sk = state["pk_sorted"]
+
+        env_cols = {(probe_ref, None, n): v for n, v in batch.cols.items()}
+        env_cols[(probe_ref, None, TS_ATTR)] = batch.ts
+        probe_raw = probe_fn(Env(env_cols, now=now))
+        # cast only to LOCATE the candidate; the hit test compares under
+        # numeric promotion so a fractional float probe cannot "match" the
+        # integer key it truncates to (parity with the dense-compare path)
+        probe = probe_raw.astype(keys.dtype)
+        pos = jnp.clip(
+            jnp.searchsorted(sk, probe, side="left"), 0, c - 1
+        ).astype(jnp.int32)
+        cand = order[pos]
+        hit = rows & (keys[cand] == probe_raw) & state["valid"][cand]
+        # last duplicate probe key wins, like the sequential iteration
+        writer_slot = jnp.where(hit, cand, c)
+        winner = (
+            jnp.full((c + 1,), -1, jnp.int32)
+            .at[writer_slot]
+            .max(jnp.arange(b, dtype=jnp.int32))[:c]
+        )
+        L = 128
+        two_d = c % L == 0 and c >= L
+        if two_d:  # keep [C] intermediates out of TPU scalar space
+            winner = winner.reshape(c // L, L)
+        has = winner >= 0
+        wi = jnp.clip(winner, 0, b - 1)
+        upd_cols = {(probe_ref, None, n): v[wi] for n, v in batch.cols.items()}
+        upd_cols[(probe_ref, None, TS_ATTR)] = batch.ts[wi]
+        if two_d:
+            upd_cols = {k: v.reshape(c) for k, v in upd_cols.items()}
+            has = has.reshape(c)
+        upd_cols.update(
+            {(self.table_id, None, n): v for n, v in state["cols"].items()}
+        )
+        upd_cols[(self.table_id, None, TS_ATTR)] = state["ts"]
+        env = Env(upd_cols, now=now)
+        new_cols = dict(state["cols"])
+        for name, fn in set_fns:
+            new_cols[name] = jnp.where(
+                has, fn(env).astype(state["cols"][name].dtype),
+                state["cols"][name],
+            )
         return {**state, "cols": new_cols}
 
     def update_or_insert(
@@ -416,7 +571,13 @@ class InMemoryTable:
         xs = (batch.cols, batch.ts, rows)
         (cols, ts, valid, seq, nxt, ovf), _ = lax.scan(body, carry, xs)
         aux["table_overflow"] = ovf
-        return {"cols": cols, "ts": ts, "valid": valid, "seq": seq, "next": nxt}
+        return self._rebuild_pk_index(
+            {
+                **state,
+                "cols": cols, "ts": ts, "valid": valid, "seq": seq,
+                "next": nxt,
+            }
+        )
 
     # ---- host-side convenience (tests / record-table parity) --------------
 
@@ -544,16 +705,204 @@ def compile_table_output(
                     )
                     return tstates
             else:
+                par_ok = _update_parallel_vectorizable(
+                    output_stream.on, output_stream.set_attributes,
+                    table, out_schema,
+                )
+                pk_probe = None
+                if par_ok:
+                    p_side = _pk_probe_expr(output_stream.on, table, out_schema)
+                    if p_side is not None:
+                        pk_probe = (
+                            table.primary_keys[0],
+                            compile_expression(p_side, scope),
+                        )
+                        table.enable_pk_index()
+                # an update that can rewrite the PK to a value the match does
+                # not pin must rebuild the sorted index afterwards
+                reindex = _pk_written_unpinned(
+                    output_stream.on, output_stream.set_attributes,
+                    table, out_schema,
+                )
+
                 def op(tstates, out_batch, now, aux, _t=table, _tid=target):
                     tstates = dict(tstates)
                     tstates[_tid] = _t.update(
-                        tstates[_tid], out_batch, on, set_fns, "__out__", now, aux
+                        tstates[_tid], out_batch, on, set_fns, "__out__", now,
+                        aux, parallel_ok=par_ok, pk_probe=pk_probe,
+                        reindex_after=reindex,
                     )
                     return tstates
 
         return op
 
     return None
+
+
+def _sort_sentinel(dtype):
+    """Largest value of a column dtype (numpy, never a device const) — used
+    to push invalid rows to the tail of the sorted-key view."""
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return np.asarray(np.inf, dt)
+    return np.asarray(np.iinfo(dt).max, dt)
+
+
+def _conjuncts(e):
+    from siddhi_tpu.query_api.expression import And
+
+    if isinstance(e, And):
+        yield from _conjuncts(e.left)
+        yield from _conjuncts(e.right)
+    else:
+        yield e
+
+
+def _pk_probe_expr(on_expr, table: InMemoryTable, out_schema: StreamSchema):
+    """The probe expression when the condition is exactly
+    `T.pk == <probe expr>` over the table's single @PrimaryKey, else None."""
+    from siddhi_tpu.query_api.expression import Compare, CompareOp, Variable
+
+    if on_expr is None or len(table.primary_keys) != 1:
+        return None
+    conj = list(_conjuncts(on_expr))
+    if len(conj) != 1 or not (
+        isinstance(conj[0], Compare) and conj[0].op is CompareOp.EQ
+    ):
+        return None
+    c = conj[0]
+    for t_side, p_side in ((c.left, c.right), (c.right, c.left)):
+        if (
+            isinstance(t_side, Variable)
+            and _reads_table(t_side, table, out_schema)
+            and t_side.attribute == table.primary_keys[0]
+            and not _reads_table(p_side, table, out_schema)
+        ):
+            return p_side
+    return None
+
+
+def _set_map(set_attributes, table, out_schema):
+    from siddhi_tpu.query_api.expression import Variable
+
+    if set_attributes:
+        return {
+            sa.table_variable.attribute: sa.expression for sa in set_attributes
+        }
+    return {
+        name: Variable(name)
+        for name, _t in table.schema.attrs
+        if name in out_schema.attr_names
+    }
+
+
+def _eq_sources(on_expr, table, out_schema):
+    from siddhi_tpu.query_api.expression import Compare, CompareOp, Variable
+
+    out: dict = {}
+    if on_expr is None:
+        return out
+    for c in _conjuncts(on_expr):
+        if isinstance(c, Compare) and c.op is CompareOp.EQ:
+            for t_side, p_side in ((c.left, c.right), (c.right, c.left)):
+                if (
+                    isinstance(t_side, Variable)
+                    and _reads_table(t_side, table, out_schema)
+                    and not _reads_table(p_side, table, out_schema)
+                ):
+                    out[t_side.attribute] = p_side
+    return out
+
+
+def _pk_written_unpinned(on_expr, set_attributes, table, out_schema) -> bool:
+    """True when an update's set clause may change the @PrimaryKey column
+    to a value the on-condition does not pin to its current value — the
+    sorted PK index must be rebuilt after such an update."""
+    if len(table.primary_keys) != 1:
+        return False
+    pk = table.primary_keys[0]
+    sm = _set_map(set_attributes, table, out_schema)
+    if pk not in sm:
+        return False
+    return _eq_sources(on_expr, table, out_schema).get(pk) != sm[pk]
+
+
+def _reads_table(expr, table: InMemoryTable, out_schema: StreamSchema) -> bool:
+    """True when an expression AST can read a column of `table` under the
+    update scope (prefer_default resolves unqualified names to the output
+    stream first, so a table read needs `T.col` or an attr only the table
+    has)."""
+    import dataclasses as _dc
+
+    from siddhi_tpu.query_api.expression import Variable
+
+    if isinstance(expr, Variable):
+        if expr.stream_id == table.table_id:
+            return True
+        return (
+            expr.stream_id is None
+            and expr.attribute not in out_schema.attr_names
+            and expr.attribute in table.schema.attr_names
+        )
+    if _dc.is_dataclass(expr) and not isinstance(expr, type):
+        return any(
+            _reads_table(getattr(expr, f.name), table, out_schema)
+            for f in _dc.fields(expr)
+        )
+    if isinstance(expr, (list, tuple)):
+        return any(_reads_table(x, table, out_schema) for x in expr)
+    return False
+
+
+def _update_parallel_vectorizable(
+    on_expr, set_attributes, table: InMemoryTable, out_schema: StreamSchema
+) -> bool:
+    """Decide whether `update T on <cond> [set ...]` may run as one
+    vectorized last-writer-wins pass instead of the reference's sequential
+    row-at-a-time iteration. Safe iff
+
+    1. every set VALUE is independent of table state (so the last matching
+       probe row's values equal what the sequential loop would leave), and
+    2. every table column the on-condition reads is either not written, or
+       is written from exactly the probe expression it is equated with in a
+       top-level conjunct (`on T.c == e ... set T.c = e` / the positional
+       default set) — so earlier updates within the batch cannot change
+       later rows' match results.
+    """
+    from siddhi_tpu.query_api.expression import Variable
+
+    set_map = _set_map(set_attributes, table, out_schema)
+    for src in set_map.values():
+        if _reads_table(src, table, out_schema):
+            return False
+
+    # table columns read by the condition, and the equality conjuncts
+    if on_expr is None:
+        return True
+
+    eq_sources = _eq_sources(on_expr, table, out_schema)
+
+    def table_cols_read(e, acc):
+        import dataclasses as _dc
+
+        if isinstance(e, Variable):
+            if _reads_table(e, table, out_schema):
+                acc.add(e.attribute)
+            return acc
+        if _dc.is_dataclass(e) and not isinstance(e, type):
+            for f in _dc.fields(e):
+                table_cols_read(getattr(e, f.name), acc)
+        elif isinstance(e, (list, tuple)):
+            for x in e:
+                table_cols_read(x, acc)
+        return acc
+
+    for col in table_cols_read(on_expr, set()):
+        if col not in set_map:
+            continue  # not written: always stable
+        if eq_sources.get(col) != set_map[col]:
+            return False  # written to a value the match does not pin
+    return True
 
 
 def collect_used_tables(query, tables: dict[str, InMemoryTable]) -> set[str]:

@@ -286,3 +286,72 @@ class TestJoinGolden:
                  ("twitterStream", ("U", "t", "Y"))])
         ins, rem = totals(d)
         assert ins == 2 and rem == 0
+
+
+class TestOuterJoinGolden:
+    STREAMS = """define stream cseEventStream (symbol string, price float, volume int);
+    define stream twitterStream (user string, tweet string, company string);
+    """
+
+    def test1_full_outer(self):
+        # OuterJoinTestCase.joinTest1
+        ql = self.STREAMS + """@info(name = 'query1')
+        from cseEventStream#window.length(3) full outer join twitterStream#window.length(1)
+        on cseEventStream.symbol== twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert all events into outputStream ;"""
+        d = run(ql, [
+            ("cseEventStream", ("WSO2", 55.6, 100)),
+            ("twitterStream", ("User1", "Hello World", "WSO2")),
+            ("cseEventStream", ("IBM", 75.6, 100)),
+            ("cseEventStream", ("WSO2", 57.6, 100)),
+        ])
+        flat_in = [r for i, _ in d for r in i]
+        assert [
+            (r[0], r[1], round(r[2], 4) if r[2] is not None else None)
+            for r in flat_in
+        ] == [
+            ("WSO2", None, round(55.6, 4)),
+            ("WSO2", "Hello World", round(55.6, 4)),
+            ("IBM", None, round(75.6, 4)),
+            ("WSO2", "Hello World", round(57.6, 4)),
+        ]
+
+    def test2_right_outer(self):
+        # OuterJoinTestCase.joinTest2
+        ql = self.STREAMS + """@info(name = 'query1')
+        from cseEventStream#window.length(1) right outer join twitterStream#window.length(2)
+        on cseEventStream.symbol== twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price, twitterStream.company as company
+        insert all events into outputStream ;"""
+        d = run(ql, [
+            ("twitterStream", ("User1", "Hello World", "WSO2")),
+            ("cseEventStream", ("BMW", 57.6, 100)),
+            ("twitterStream", ("User2", "Welcome", "IBM")),
+            ("cseEventStream", ("WSO2", 57.6, 100)),
+        ])
+        flat_in = [r for i, _ in d for r in i]
+        assert [(r[0], r[1], r[3]) for r in flat_in] == [
+            (None, "Hello World", "WSO2"),
+            (None, "Welcome", "IBM"),
+            ("WSO2", "Hello World", "WSO2"),
+        ]
+
+
+class TestExternalTimeWindowGolden:
+    def test1_event_time_expiry(self):
+        # ExternalTimeWindowTestCase.externalTimeWindowTest1 — fully
+        # event-time driven, no wall clock involved
+        ql = """define stream LoginEvents (timestamp long, ip string) ;
+        @info(name = 'query1')
+        from LoginEvents#window.externalTime(timestamp,5 sec)
+        select timestamp, ip
+        insert all events into uniqueIps ;"""
+        d = run(ql, [
+            ("LoginEvents", (1366335804341, "192.10.1.3")),
+            ("LoginEvents", (1366335804342, "192.10.1.4")),
+            ("LoginEvents", (1366335814341, "192.10.1.5")),
+            ("LoginEvents", (1366335814345, "192.10.1.6")),
+            ("LoginEvents", (1366335824341, "192.10.1.7")),
+        ])
+        assert totals(d) == (5, 4)
