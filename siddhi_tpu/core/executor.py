@@ -276,20 +276,37 @@ _CMP = {
 }
 
 
+def _notnull(v: jnp.ndarray, t: AttrType):
+    """Mask of rows whose value is NOT the type's null encoding."""
+    if t in (AttrType.FLOAT, AttrType.DOUBLE):
+        return ~jnp.isnan(v)
+    if t in (AttrType.INT, AttrType.LONG):
+        return v != np.asarray(null_value(t), dtype=v.dtype)
+    if t in (AttrType.STRING, AttrType.OBJECT):
+        return v != 0
+    return True  # BOOL: never null
+
+
 def _compare(op: CompareOp, le: CompiledExpr, re_: CompiledExpr) -> CompiledExpr:
     lt, rt = le.type, re_.type
     if lt in NUMERIC_TYPES and rt in NUMERIC_TYPES:
         t = promote(lt, rt)
 
         def fn(env: Env) -> jnp.ndarray:
-            return _CMP[op](_cast(le(env), t), _cast(re_(env), t))
+            lv, rv = le(env), re_(env)
+            # a null operand makes ANY comparison false, NEQ included
+            # (reference: CompareConditionExpressionExecutor.java:42)
+            ok = _notnull(lv, lt) & _notnull(rv, rt)
+            return _CMP[op](_cast(lv, t), _cast(rv, t)) & ok
 
     elif lt == rt and lt in (AttrType.BOOL, AttrType.STRING, AttrType.OBJECT):
         if op not in (CompareOp.EQ, CompareOp.NEQ):
             raise TypeError(f"operator {op.value} not defined for {lt!r}")
 
         def fn(env: Env) -> jnp.ndarray:
-            return _CMP[op](le(env), re_(env))
+            lv, rv = le(env), re_(env)
+            ok = _notnull(lv, lt) & _notnull(rv, rt)
+            return _CMP[op](lv, rv) & ok
 
     else:
         raise TypeError(f"cannot compare {lt!r} {op.value} {rt!r}")

@@ -389,10 +389,9 @@ class InMemoryTable:
             # keep every [C]-sized intermediate 2D ([C/128, 128]): 1D
             # reductions/selects of this shape get placed in TPU scalar
             # space (S(1)) and run ~1000x slower (profiled at C=1M)
-            L = 128
-            two_d = c % L == 0 and c >= L
+            two_d = c % 128 == 0 and c >= 128
             if two_d:
-                pair = pair.reshape(b, c // L, L)
+                pair = pair.reshape(b, c // 128, 128)
             writer = jnp.where(
                 pair,
                 jnp.arange(b, dtype=jnp.int32).reshape(
@@ -400,31 +399,10 @@ class InMemoryTable:
                 ),
                 -1,
             ).max(axis=0)  # last matching probe row per slot, -1 if none
-            has = writer >= 0
-            wi = jnp.clip(writer, 0, b - 1)
-            env_cols = {
-                (probe_ref, None, n): v[wi] for n, v in batch.cols.items()
-            }
-            env_cols[(probe_ref, None, TS_ATTR)] = batch.ts[wi]
-
-            def _flat(x):
-                return x.reshape(c) if two_d else x
-
-            env_cols = {k: _flat(v) for k, v in env_cols.items()}
-            has = _flat(has)
-            env_cols.update(
-                {(self.table_id, None, n): v for n, v in state["cols"].items()}
+            out = self._apply_winner(
+                state, batch, writer, two_d, set_fns, probe_ref, now
             )
-            env_cols[(self.table_id, None, TS_ATTR)] = state["ts"]
-            env = Env(env_cols, now=now)
-            new_cols = dict(state["cols"])
-            for name, fn in set_fns:
-                new_cols[name] = jnp.where(
-                    has,
-                    fn(env).astype(state["cols"][name].dtype),
-                    state["cols"][name],
-                )
-            return {**state, "cols": new_cols}
+            return self._rebuild_pk_index(out) if reindex_after else out
 
         def body(carry, xs):
             cols = carry
@@ -475,7 +453,15 @@ class InMemoryTable:
             jnp.searchsorted(sk, probe, side="left"), 0, c - 1
         ).astype(jnp.int32)
         cand = order[pos]
-        hit = rows & (keys[cand] == probe_raw) & state["valid"][cand]
+        from siddhi_tpu.core.executor import _notnull
+
+        probe_t = getattr(probe_fn, "type", self.schema.attr_types[pk_col])
+        hit = (
+            rows
+            & (keys[cand] == probe_raw)
+            & state["valid"][cand]
+            & _notnull(probe_raw, probe_t)
+        )
         # last duplicate probe key wins, like the sequential iteration
         writer_slot = jnp.where(hit, cand, c)
         winner = (
@@ -483,22 +469,35 @@ class InMemoryTable:
             .at[writer_slot]
             .max(jnp.arange(b, dtype=jnp.int32))[:c]
         )
-        L = 128
-        two_d = c % L == 0 and c >= L
+        two_d = c % 128 == 0 and c >= 128
         if two_d:  # keep [C] intermediates out of TPU scalar space
-            winner = winner.reshape(c // L, L)
+            winner = winner.reshape(c // 128, 128)
+        # the PK value never changes on this path (the match pins it), so
+        # no reindex is needed afterwards
+        return self._apply_winner(
+            state, batch, winner, two_d, set_fns, probe_ref, now
+        )
+
+    def _apply_winner(
+        self, state, batch, winner, two_d, set_fns, probe_ref, now
+    ):
+        """Shared tail of the vectorized update paths: gather each slot's
+        winning probe row, build the per-slot env, apply the set clauses.
+        `winner` is [C] (or [C/128,128] when two_d) with -1 = no match."""
+        b = batch.valid.shape[0]
+        c = self.capacity
         has = winner >= 0
         wi = jnp.clip(winner, 0, b - 1)
-        upd_cols = {(probe_ref, None, n): v[wi] for n, v in batch.cols.items()}
-        upd_cols[(probe_ref, None, TS_ATTR)] = batch.ts[wi]
+        env_cols = {(probe_ref, None, n): v[wi] for n, v in batch.cols.items()}
+        env_cols[(probe_ref, None, TS_ATTR)] = batch.ts[wi]
         if two_d:
-            upd_cols = {k: v.reshape(c) for k, v in upd_cols.items()}
+            env_cols = {k: v.reshape(c) for k, v in env_cols.items()}
             has = has.reshape(c)
-        upd_cols.update(
+        env_cols.update(
             {(self.table_id, None, n): v for n, v in state["cols"].items()}
         )
-        upd_cols[(self.table_id, None, TS_ATTR)] = state["ts"]
-        env = Env(upd_cols, now=now)
+        env_cols[(self.table_id, None, TS_ATTR)] = state["ts"]
+        env = Env(env_cols, now=now)
         new_cols = dict(state["cols"])
         for name, fn in set_fns:
             new_cols[name] = jnp.where(

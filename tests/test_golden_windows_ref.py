@@ -355,3 +355,47 @@ class TestExternalTimeWindowGolden:
             ("LoginEvents", (1366335824341, "192.10.1.7")),
         ])
         assert totals(d) == (5, 4)
+
+
+class TestNullCompareGolden:
+    def test_null_operand_fails_every_comparison(self):
+        """Any comparison with a null operand is false, NEQ included
+        (reference: CompareConditionExpressionExecutor.java:42)."""
+        ql = CSE + """@info(name = 'query1')
+            from cseEventStream[volume < 150 or volume >= 150 or volume != 7]
+            select symbol, volume insert into outputStream ;"""
+        d = run(ql, [
+            ("cseEventStream", ("IBM", 700.0, 100)),
+            ("cseEventStream", ("CCC", 70.0, None)),
+            ("cseEventStream", ("WSO2", 60.5, 200)),
+        ])
+        assert [r[0] for i, _ in d for r in i] == ["IBM", "WSO2"]
+
+
+class TestExternalTimeBatchGolden:
+    """query/window/ExternalTimeBatchWindowTestCase.java — event-time batch
+    windows; fully deterministic (the clock is the timestamp attribute)."""
+
+    QL = """define stream jmxMetric(cpu int, timestamp long);
+    @info(name='query')
+    from jmxMetric#window.externalTimeBatch(timestamp, 10 sec)
+    select avg(cpu) as avgCpu, count() as c insert into tmp;"""
+
+    def test03_no_flush_inside_first_window(self):
+        # test03NoEdgeCase: 5 events spanning < 10 sec -> no output at all
+        now = 1_700_000_000_000
+        d = run(self.QL, [
+            ("jmxMetric", (15, now + i * 1000)) for i in range(5)
+        ], query_name="query")
+        assert totals(d) == (0, 0)
+
+    def test05_edge_case_two_flushes(self):
+        # test05EdgeCase: two rounds of 3 events 10 sec apart + a trigger:
+        # two flushes, avg 15 then 85, count 3 each
+        now = 0
+        sends = [("jmxMetric", (15, now + i * 10)) for i in range(3)]
+        sends += [("jmxMetric", (85, now + 10000 + i * 10)) for i in range(3)]
+        sends += [("jmxMetric", (10000, now + 10 * 10000))]
+        d = run(self.QL, sends, query_name="query")
+        flat_in = [r for i, _ in d for r in i]
+        assert [(r[0], r[1]) for r in flat_in] == [(15.0, 3), (85.0, 3)]

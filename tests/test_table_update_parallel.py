@@ -82,3 +82,65 @@ def test_analysis_gate():
     assert not decide(
         "@info(name='u') from S select k, v update T set T.k = v on T.k == k;"
     )
+
+
+PK_BASE = """
+define stream L (k long, v long);
+define stream S (k long, v long);
+@PrimaryKey('k')
+@capacity(size='64') define table T (k long, v long);
+@info(name='load') from L insert into T;
+"""
+
+
+@pytest.mark.parametrize("name", ["default_set_pk_eq", "explicit_set"])
+def test_pk_probe_path_matches_sequential(name):
+    ql = PK_BASE + CASES[name]
+    assert _run(ql, force_sequential=False) == _run(ql, force_sequential=True)
+
+
+def test_pk_rewrite_then_pk_probe_stays_correct():
+    """An update that rewrites the PK (non-PK path, reindex_after) must leave
+    the sorted index fresh for a later PK-probe update."""
+    def go(force_seq):
+        orig = table_mod._update_parallel_vectorizable
+        if force_seq:
+            table_mod._update_parallel_vectorizable = lambda *a: False
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(PK_BASE + """
+            @info(name='rekey') from S[v > 500] select k, v update T set T.k = v on T.v == k;
+            @info(name='upd') from S[v <= 500] select k, v update T on T.k == k;
+            """)
+            rt.start()
+            for i in range(10):
+                rt.get_input_handler("L").send((i, i))
+            h = rt.get_input_handler("S")
+            h.send((3, 900))     # rekey: row with v==3 gets k := 900
+            h.send((900, 111))   # pk probe on the REWRITTEN key must find it
+            rows = sorted(tuple(e.data) for e in rt.query("from T select *"))
+            rt.shutdown()
+            mgr.shutdown()
+            return rows
+        finally:
+            table_mod._update_parallel_vectorizable = orig
+
+    fast, slow = go(False), go(True)
+    assert fast == slow
+    assert (900, 111) in fast
+
+
+def test_null_pk_probe_matches_nothing():
+    """A null probe key must not 'match' a null-keyed row — parity with the
+    dense path's null-comparison semantics."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(PK_BASE + """
+    @info(name='upd') from S select k, v update T on T.k == k;
+    """)
+    rt.start()
+    rt.get_input_handler("L").send((None, 7))
+    rt.get_input_handler("S").send((None, 999))
+    rows = sorted(tuple(e.data) for e in rt.query("from T select *"))
+    rt.shutdown()
+    mgr.shutdown()
+    assert rows == [(None, 7)]
