@@ -575,6 +575,29 @@ class QueryRuntime(BaseQueryRuntime):
 
         self._setup_output(query, query_id)
         self._attach_tables(tables, interner)
+        # batch windows skip their EXPIRED candidate lanes when nothing can
+        # observe them: `insert [current] into` output, no rate limiter, and
+        # no membership-consuming aggregator (min/max/distinctCount). Halves
+        # the flow length every selector op runs over.
+        win = self.chain.window
+        if win is not None and win.is_batch and hasattr(win, "emit_expired"):
+            from siddhi_tpu.core.aggregators import (
+                DistinctCountAggregator,
+                ExtremeAggregator,
+            )
+            from siddhi_tpu.query_api.execution import OutputEventsFor
+
+            needs_member = any(
+                isinstance(a, DistinctCountAggregator)
+                or (isinstance(a, ExtremeAggregator) and not a.forever)
+                for a in self.selector.aggregators
+            )
+            if (
+                self.output_events is OutputEventsFor.CURRENT
+                and self.rate_limiter is None
+                and not needs_member
+            ):
+                win.emit_expired = False
         self.needs_scheduler = (
             self.chain.window is not None and self.chain.window.needs_scheduler
         )

@@ -28,7 +28,7 @@ from siddhi_tpu.core.executor import (
 from siddhi_tpu.core.flow import Flow
 from siddhi_tpu.core.groupby import CompiledGroupBy
 from siddhi_tpu.core.types import AttrType
-from siddhi_tpu.ops.group import keep_last_per_group
+from siddhi_tpu.ops.group import keep_last_in_sorted, keep_last_per_group
 from siddhi_tpu.query_api.execution import OutputAttribute, Selector
 from siddhi_tpu.query_api.expression import AttributeFunction, Expression, Variable
 
@@ -215,10 +215,10 @@ class CompiledSelector:
         # diverges for `output all events` where a bucket's CURRENT would
         # shadow the previous bucket's EXPIRED of the same key)
         if self.batch_mode and ctx is not None:
-            seg = jnp.cumsum(flow.reset.astype(jnp.int32))
-            valid = keep_last_per_group(
-                [ctx.key, flow.batch.kind.astype(jnp.int32), seg], valid
-            )
+            # the (reset-era, key) segments of the group-by's sorted view are
+            # exactly the (bucket, key) groups — collapse inside it instead of
+            # re-lexsorting (ops/group.py:keep_last_in_sorted)
+            valid = keep_last_in_sorted(ctx.sorted, flow.batch.kind, valid)
         elif self.batch_mode and self.aggregators:
             # batch + aggregators + no group-by: only the LAST allowed-kind
             # event of each flush chunk survives, carrying the final running

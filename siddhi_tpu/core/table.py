@@ -306,7 +306,10 @@ class InMemoryTable:
         slot_c = jnp.where(ok, slot, c)
 
         def scatter(dst, src):
-            return dst.at[slot_c].set(src.astype(dst.dtype), mode="drop")
+            # 64-bit lanes (ts/seq/long cols) ride the int32-pair scatter path
+            from siddhi_tpu.ops.scatter import set_at
+
+            return set_at(dst, slot_c, src.astype(dst.dtype))
 
         new_seq = state["next"] + rank
         out = {

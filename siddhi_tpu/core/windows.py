@@ -37,6 +37,7 @@ from siddhi_tpu.core.event import (
 )
 from siddhi_tpu.core.executor import Env, Scope, TS_ATTR, compile_expression
 from siddhi_tpu.ops.prefix import cummax as _cummax
+from siddhi_tpu.ops.scatter import set_at as _set_at
 from siddhi_tpu.core.flow import Flow
 from siddhi_tpu.core.types import AttrType
 from siddhi_tpu.query_api.definition import WindowSpec
@@ -283,7 +284,7 @@ class SlidingWindow(WindowStage):
             },
             "ts": _place_ring(state["ts"], ring_evicted, slots, b.ts),
             "wts": _place_ring(state["wts"], ring_evicted, slots, bwts),
-            "seq": new_seq.at[slots].set(seq_batch, mode="drop"),
+            "seq": _set_at(new_seq, slots, seq_batch),
             "total": total + c,
         }
 
@@ -320,22 +321,20 @@ class SlidingWindow(WindowStage):
         out_valid = jnp.zeros((n_out,), jnp.bool_)
         out_cols = {n: jnp.zeros((n_out,), a.dtype) for n, a in b.cols.items()}
 
-        # scatter EXPIREDs (rank space)
+        # scatter EXPIREDs (rank space); set_at keeps int64 lanes fast
         exp_dst = jnp.where(e, exp_pos_rank, n_out)
-        out_ts = out_ts.at[exp_dst].set(trig_ts, mode="drop")
+        out_ts = _set_at(out_ts, exp_dst, trig_ts)
         out_kind = out_kind.at[exp_dst].set(np.int8(KIND_EXPIRED), mode="drop")
         out_valid = out_valid.at[exp_dst].set(True, mode="drop")
         for n in out_cols:
-            out_cols[n] = out_cols[n].at[exp_dst].set(
-                elem_cols[n][elem_idx], mode="drop"
-            )
+            out_cols[n] = _set_at(out_cols[n], exp_dst, elem_cols[n][elem_idx])
         # scatter CURRENTs (row space: row r has rank[r], position via gather)
         cur_pos_row = cur_pos_rank[jnp.clip(rank, 0, bsz - 1)]
         cur_dst = jnp.where(valid_cur, cur_pos_row, n_out)
-        out_ts = out_ts.at[cur_dst].set(b.ts, mode="drop")
+        out_ts = _set_at(out_ts, cur_dst, b.ts)
         out_valid = out_valid.at[cur_dst].set(True, mode="drop")
         for n in out_cols:
-            out_cols[n] = out_cols[n].at[cur_dst].set(b.cols[n], mode="drop")
+            out_cols[n] = _set_at(out_cols[n], cur_dst, b.cols[n])
         out = EventBatch(ts=out_ts, kind=out_kind, valid=out_valid, cols=out_cols)
 
         # --- membership matrix (same contract as the sorted path) ---
@@ -385,7 +384,9 @@ class SlidingWindow(WindowStage):
 
 
 def _place_ring(old, evicted, slots, vals):
-    return jnp.where(evicted, 0, old).at[slots].set(vals, mode="drop")
+    # set_at: 64-bit lanes (ts/wts/seq/long cols) ride the int32-pair scatter
+    # (a raw 64-bit scatter-set serializes on TPU, ops/scatter.py)
+    return _set_at(jnp.where(evicted, 0, old), slots, vals)
 
 
 # ---------------------------------------------------------------------------
@@ -402,9 +403,15 @@ class BatchWindow(WindowStage):
 
     State invariant: the open bucket holds < flush size (cur_n < n for
     lengthBatch); `prev` holds the last flushed bucket awaiting expiry.
+
+    `emit_expired`: the query runtime clears this when nothing downstream can
+    observe EXPIRED rows (output is `insert [current] into`, no rate limiter,
+    no membership-consuming aggregator) — the expired candidate lanes are then
+    omitted entirely, halving the flow every downstream selector op runs over.
     """
 
     is_batch = True
+    emit_expired = True
 
     def __init__(
         self,
@@ -465,11 +472,16 @@ class BatchWindow(WindowStage):
         new_bucket_start = state["bucket_start"]
         if self.n is not None:
             # --- lengthBatch: flush f triggers at the row completing (f+1)*n ---
+            # at most bsz//n + 1 flushes can occur per batch (carried bucket
+            # holds < n), so the flush bookkeeping lanes are [F], not [bsz] —
+            # every downstream candidate lane and the selector's whole flow
+            # shrink with them
             n = self.n
+            F = min(bsz // n + 2, bsz)
             pos = cur_n0 + rank  # fill position of each current row
             e_row = pos // n  # flush index at which the row's bucket closes
             n_flush = (cur_n0 + c) // n
-            f_arr = rows
+            f_arr = jnp.arange(F, dtype=jnp.int32)
             trig_rank_f = (f_arr + 1) * n - 1 - cur_n0
             flush_exists = (trig_rank_f >= 0) & (trig_rank_f < c)
             row_of_flush = jnp.where(
@@ -487,6 +499,7 @@ class BatchWindow(WindowStage):
                     state["bucket_start"],
                     jnp.where(trigger_ok.any(), bwts[first_trig], np.int64(-1)),
                 )
+            F = bsz  # time-driven flush count is bounded only by trigger rows
             rel = jnp.maximum(bwts - start0, 0)
             g = jnp.where(trigger_ok & (start0 >= 0), rel // self.t, np.int64(0))
             open_g = _cummax(g)
@@ -511,7 +524,7 @@ class BatchWindow(WindowStage):
         any_flush = n_flush > 0
 
         def flush_key(f, kindbit):
-            return row_of_flush[jnp.clip(f, 0, bsz - 1)] * 4 + kindbit
+            return row_of_flush[jnp.clip(f, 0, F - 1)] * 4 + kindbit
 
         # --- candidates ---
         # carried open bucket: CURRENT at flush 0, EXPIRED at flush 1
@@ -528,7 +541,7 @@ class BatchWindow(WindowStage):
         bt_exp_key = jnp.where(
             row_emit & (e_row + 1 < n_flush), flush_key(e_row.astype(jnp.int32) + 1, 0), BIG
         )
-        # resets: one per flush
+        # resets: one per flush ([F] lanes)
         rs_key = jnp.where(flush_exists, row_of_flush * 4 + 1, BIG)
 
         # element table: [0,w) carried-cur, [w,2w) prev, [2w,2w+bsz) batch
@@ -538,20 +551,35 @@ class BatchWindow(WindowStage):
         }
         elem_ts = jnp.concatenate([state["cur_ts"], state["prev_ts"], b.ts])
 
-        cand_key = jnp.concatenate([cc_cur_key, cc_exp_key, pv_exp_key, bt_cur_key, bt_exp_key, rs_key])
-        cand_elem = jnp.concatenate([cw, cw, cw + w, rows + 2 * w, rows + 2 * w, jnp.zeros((bsz,), jnp.int32)])
-        cand_kind = jnp.concatenate(
-            [
-                jnp.full((w,), KIND_CURRENT, jnp.int8),
-                jnp.full((w,), KIND_EXPIRED, jnp.int8),
-                jnp.full((w,), KIND_EXPIRED, jnp.int8),
-                jnp.full((bsz,), KIND_CURRENT, jnp.int8),
-                jnp.full((bsz,), KIND_EXPIRED, jnp.int8),
-                jnp.full((bsz,), KIND_RESET, jnp.int8),
-            ]
-        )
+        if self.emit_expired:
+            cand_key = jnp.concatenate([cc_cur_key, cc_exp_key, pv_exp_key, bt_cur_key, bt_exp_key, rs_key])
+            cand_elem = jnp.concatenate([cw, cw, cw + w, rows + 2 * w, rows + 2 * w, jnp.zeros((F,), jnp.int32)])
+            cand_kind = jnp.concatenate(
+                [
+                    jnp.full((w,), KIND_CURRENT, jnp.int8),
+                    jnp.full((w,), KIND_EXPIRED, jnp.int8),
+                    jnp.full((w,), KIND_EXPIRED, jnp.int8),
+                    jnp.full((bsz,), KIND_CURRENT, jnp.int8),
+                    jnp.full((bsz,), KIND_EXPIRED, jnp.int8),
+                    jnp.full((F,), KIND_RESET, jnp.int8),
+                ]
+            )
+            tie = jnp.concatenate([cw, cw, cw, rows + w, rows + w, jnp.arange(F, dtype=jnp.int32)])
+            bt_cur_off = 3 * w
+        else:
+            # CURRENT-only consumers: drop the three expired lanes
+            cand_key = jnp.concatenate([cc_cur_key, bt_cur_key, rs_key])
+            cand_elem = jnp.concatenate([cw, rows + 2 * w, jnp.zeros((F,), jnp.int32)])
+            cand_kind = jnp.concatenate(
+                [
+                    jnp.full((w,), KIND_CURRENT, jnp.int8),
+                    jnp.full((bsz,), KIND_CURRENT, jnp.int8),
+                    jnp.full((F,), KIND_RESET, jnp.int8),
+                ]
+            )
+            tie = jnp.concatenate([cw, rows + w, jnp.arange(F, dtype=jnp.int32)])
+            bt_cur_off = w
         cand_valid = cand_key < BIG
-        tie = jnp.concatenate([cw, cw, cw, rows + w, rows + w, rows])
         order = jnp.lexsort((tie, jnp.where(cand_valid, cand_key, BIG)))
 
         o_elem = cand_elem[order]
@@ -575,25 +603,31 @@ class BatchWindow(WindowStage):
         # cleared the deque; their EXPIRED events remove from empty — a no-op).
         inv = jnp.argsort(order)  # candidate index -> sorted output position
         ncand = cand_key.shape[0]
-        rs_base = 3 * w + 2 * bsz
         birth_cc = jnp.where(carried_valid & any_flush, inv[cw], BIG)
-        death_cc = jnp.where(carried_valid & (n_flush > 1), inv[w + cw], BIG)
-        birth_bt = jnp.where(row_emit, inv[3 * w + rows], BIG)
-        death_bt = jnp.where(
-            row_emit & (e_row + 1 < n_flush), inv[3 * w + bsz + rows], BIG
-        )
-        e_birth = jnp.concatenate([birth_cc, jnp.full((w,), BIG, jnp.int32), birth_bt])
-        e_death = jnp.concatenate([death_cc, jnp.full((w,), -1, jnp.int32), death_bt])
-        e_alive = jnp.concatenate([carried_valid & any_flush, jnp.zeros((w,), bool), row_emit])
-        pos_row = jnp.arange(ncand)
-        member = (
-            e_alive[None, :]
-            & (e_birth[None, :] <= pos_row[:, None])
-            & (pos_row[:, None] < e_death[None, :])
-        )
-        member_cols = {(self.ref, None, nm): elem_cols[nm] for nm in elem_cols}
-        member_cols[(self.ref, None, TS_ATTR)] = elem_ts
-        member_env = Env(member_cols, now=flow.now)
+        birth_bt = jnp.where(row_emit, inv[bt_cur_off + rows], BIG)
+        # without expired lanes there are no death positions, so membership
+        # cannot be expressed — hand downstream None and any (future) member
+        # consumer degrades to its memberless path (`member is None` guards)
+        if self.emit_expired:
+            death_cc = jnp.where(carried_valid & (n_flush > 1), inv[w + cw], BIG)
+            death_bt = jnp.where(
+                row_emit & (e_row + 1 < n_flush), inv[3 * w + bsz + rows], BIG
+            )
+            e_birth = jnp.concatenate([birth_cc, jnp.full((w,), BIG, jnp.int32), birth_bt])
+            e_death = jnp.concatenate([death_cc, jnp.full((w,), -1, jnp.int32), death_bt])
+            e_alive = jnp.concatenate([carried_valid & any_flush, jnp.zeros((w,), bool), row_emit])
+            pos_row = jnp.arange(ncand)
+            member = (
+                e_alive[None, :]
+                & (e_birth[None, :] <= pos_row[:, None])
+                & (pos_row[:, None] < e_death[None, :])
+            )
+            member_cols = {(self.ref, None, nm): elem_cols[nm] for nm in elem_cols}
+            member_cols[(self.ref, None, TS_ATTR)] = elem_ts
+            member_env = Env(member_cols, now=flow.now)
+        else:
+            member = None
+            member_env = None
 
         # --- new buffers ---
         # open bucket: elements whose bucket index == n_flush (not yet closed)
@@ -610,7 +644,7 @@ class BatchWindow(WindowStage):
 
         def place_cur(old, vals):
             kept = jnp.where(keep_carried, old, jnp.zeros_like(old))
-            return kept.at[rem_slot].set(vals, mode="drop")
+            return _set_at(kept, rem_slot, vals)
 
         new_cur_n = jnp.where(keep_carried, cur_n0, 0) + remaining.sum(dtype=jnp.int32)
 
@@ -624,8 +658,8 @@ class BatchWindow(WindowStage):
 
         def place_prev(old_prev, carried_vals, batch_vals):
             base = jnp.where(any_flush, jnp.zeros_like(old_prev), old_prev)
-            base = base.at[lb_slot_c].set(carried_vals, mode="drop")
-            return base.at[lb_slot_b].set(batch_vals, mode="drop")
+            base = _set_at(base, lb_slot_c, carried_vals)
+            return _set_at(base, lb_slot_b, batch_vals)
 
         new_prev_n = jnp.where(
             any_flush, n_carried_last + in_last.sum(dtype=jnp.int32), state["prev_n"]

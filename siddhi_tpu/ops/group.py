@@ -26,6 +26,7 @@ from siddhi_tpu.ops.prefix import (
     segmented_cum_extreme,
     segmented_cumsum,
 )
+from siddhi_tpu.ops.scatter import set_at
 
 # 64-bit mixing constants (splitmix64 finalizer) for combining composite keys.
 _MIX1 = np.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
@@ -117,6 +118,10 @@ def assign_slots(
     first = segmented_carry(perm, seg_start)[inv]
 
     # ---- resolution against the old table (pre-reset gathers + no-reset case)
+    # dense [B, G] eq matrix: at G <= ~1k this is a fully vectorized compare +
+    # argmax the VPU eats (~0.5 ms at B=100k) — measured FASTER than a
+    # searchsorted probe, whose log G binary-search steps serialize into
+    # scalar-space gathers on TPU
     eq_t = used[None, :] & (table_keys[None, :] == batch_keys[:, None])  # [B,G]
     in_t = eq_t.any(axis=1) & active
     t_slot = jnp.argmax(eq_t, axis=1).astype(jnp.int32)
@@ -142,15 +147,16 @@ def assign_slots(
     slot = jnp.where(active, slot, np.int32(g))
     overflow = jnp.where(any_reset, fresh_overflow, old_overflow)
 
-    # ---- new table state
+    # ---- new table state (set_at: int64 key scatters ride the int32-pair
+    # path; a raw 64-bit scatter-set serializes on TPU, ops/scatter.py)
     # no reset: old table + this batch's allocations
     scatter_old = jnp.where(is_alloc & (slot_new < g) & ~any_reset, slot_new, g)
-    keys_old = table_keys.at[scatter_old].set(batch_keys, mode="drop")
+    keys_old = set_at(table_keys, scatter_old, batch_keys)
     used_old = used.at[scatter_old].set(True, mode="drop")
     n_old = jnp.minimum(n_used + is_alloc.sum(dtype=jnp.int32), g)
     # reset: fresh table from post-reset allocations only
     scatter_f = jnp.where(is_alloc_f & (rank_f < g) & any_reset, rank_f, g)
-    keys_f = jnp.zeros_like(table_keys).at[scatter_f].set(batch_keys, mode="drop")
+    keys_f = set_at(jnp.zeros_like(table_keys), scatter_f, batch_keys)
     used_f = jnp.zeros_like(used).at[scatter_f].set(True, mode="drop")
     n_f = jnp.minimum(is_alloc_f.sum(dtype=jnp.int32), g)
 
@@ -158,6 +164,18 @@ def assign_slots(
     new_used = jnp.where(any_reset, used_f, used_old)
     new_n = jnp.where(any_reset, n_f, n_old)
     return new_keys, new_used, new_n, slot, grp, overflow
+
+
+def _final_segment_writers(grp: SortedGroups, slot, post):
+    """Sorted-space mask of rows that END a final-era (post-last-reset)
+    segment, with their slots — the one row per live group whose running
+    value IS the group's new carry. Lets 64-bit carries update via a
+    scatter-SET (int32-pair fast path) instead of a serialized 64-bit
+    scatter reduction."""
+    seg_end = jnp.concatenate([grp.seg_start[1:], jnp.ones((1,), jnp.bool_)])
+    slot_s = slot[grp.perm]
+    post_s = post[grp.perm]
+    return seg_end & post_s, slot_s
 
 
 def keyed_running_sum(
@@ -173,7 +191,8 @@ def keyed_running_sum(
     with no reset in between — exactly the reference's per-key running state
     with RESET zeroing every group."""
     g = carry.shape[0]
-    run = segmented_cumsum(contrib[grp.perm], grp.seg_start)[grp.inv]
+    run_s = segmented_cumsum(contrib[grp.perm], grp.seg_start)
+    run = run_s[grp.inv]
     lr = last_reset_index(reset)
     gathered = jnp.where(slot < g, carry[jnp.clip(slot, 0, g - 1)], 0)
     run = run + jnp.where(lr < 0, gathered, jnp.zeros_like(gathered))
@@ -181,9 +200,20 @@ def keyed_running_sum(
     glr = lr[-1]
     post = jnp.arange(contrib.shape[0], dtype=jnp.int32) > glr
     base = jnp.where(reset.any(), jnp.zeros_like(carry), carry)
-    new_carry = base.at[jnp.where(post, slot, g)].add(
-        jnp.where(post, contrib, 0), mode="drop"
-    )
+    if jnp.dtype(carry.dtype).itemsize >= 8:
+        # 64-bit scatter-add serializes on TPU; in the final era each live
+        # group is exactly one sorted segment, so its carry is base + the
+        # segment END's running sum — one unique-index scatter-set per group
+        writer, slot_s = _final_segment_writers(grp, slot, post)
+        writer = writer & (slot_s < g)
+        newval = (
+            jnp.where(slot_s < g, base[jnp.clip(slot_s, 0, g - 1)], 0) + run_s
+        )
+        new_carry = set_at(base, jnp.where(writer, slot_s, g), newval)
+    else:
+        new_carry = base.at[jnp.where(post, slot, g)].add(
+            jnp.where(post, contrib, 0), mode="drop"
+        )
     return run, new_carry
 
 
@@ -199,23 +229,67 @@ def keyed_running_extreme(
     """Per-event running min/max within each group (no removal)."""
     g = carry.shape[0]
     ident = extreme_identity(values.dtype, is_min)
+    op = jnp.minimum if is_min else jnp.maximum
     masked = jnp.where(active, values, ident)
-    run = segmented_cum_extreme(masked[grp.perm], grp.seg_start, is_min)[grp.inv]
+    run_s = segmented_cum_extreme(masked[grp.perm], grp.seg_start, is_min)
+    run = run_s[grp.inv]
     lr = last_reset_index(reset)
     gathered = jnp.where(
         (slot < g) & (lr < 0), carry[jnp.clip(slot, 0, g - 1)], ident
     )
-    run = jnp.minimum(run, gathered) if is_min else jnp.maximum(run, gathered)
+    run = op(run, gathered)
 
     post = jnp.arange(values.shape[0], dtype=jnp.int32) > lr[-1]
     base = jnp.where(reset.any(), jnp.full_like(carry, ident), carry)
-    scatter = jnp.where(post & active, slot, g)
-    vals_post = jnp.where(post & active, values, ident)
-    if is_min:
-        new_carry = base.at[scatter].min(vals_post, mode="drop")
+    if jnp.dtype(carry.dtype).itemsize >= 8:
+        # 64-bit scatter reductions serialize on TPU — write each live
+        # group's final-era segment extreme with one unique-index scatter-set
+        # (see keyed_running_sum)
+        writer, slot_s = _final_segment_writers(grp, slot, post)
+        writer = writer & (slot_s < g)
+        newval = op(
+            jnp.where(slot_s < g, base[jnp.clip(slot_s, 0, g - 1)], ident),
+            run_s,
+        )
+        new_carry = set_at(base, jnp.where(writer, slot_s, g), newval)
     else:
-        new_carry = base.at[scatter].max(vals_post, mode="drop")
+        scatter = jnp.where(post & active, slot, g)
+        vals_post = jnp.where(post & active, values, ident)
+        if is_min:
+            new_carry = base.at[scatter].min(vals_post, mode="drop")
+        else:
+            new_carry = base.at[scatter].max(vals_post, mode="drop")
     return run, new_carry
+
+
+def keep_last_in_sorted(
+    grp: SortedGroups, kind: jnp.ndarray, valid: jnp.ndarray
+) -> jnp.ndarray:
+    """[B] bool: valid rows that are the LAST valid row of their
+    (segment, kind) — the batch-mode group-by collapse, computed inside an
+    EXISTING SortedGroups view instead of re-lexsorting (the segments of
+    `grp` are exactly the (reset-era, key) groups; `kind` subdivides them).
+    One reverse segmented max per kind lane, no new sort.
+
+    Precondition: `valid` is pre-masked to CURRENT|EXPIRED rows — other kinds
+    would silently compete in the EXPIRED lane."""
+    from siddhi_tpu.core.event import KIND_CURRENT, KIND_EXPIRED
+
+    b = valid.shape[0]
+    idx = jnp.arange(b, dtype=jnp.int32)
+    sv = valid[grp.perm]
+    sk = kind[grp.perm].astype(jnp.int32)
+    seg_end = jnp.concatenate([grp.seg_start[1:], jnp.ones((1,), jnp.bool_)])
+    rev_start = seg_end[::-1]
+
+    def last_of(kbit):
+        marked = jnp.where(sv & (sk == kbit), grp.perm, np.int32(-1))
+        return segmented_cum_extreme(marked[::-1], rev_start, is_min=False)[::-1]
+
+    last_cur = last_of(int(KIND_CURRENT))
+    last_exp = last_of(int(KIND_EXPIRED))
+    last_for_row = jnp.where(sk == int(KIND_CURRENT), last_cur, last_exp)
+    return valid & (last_for_row[grp.inv] == idx)
 
 
 def keep_last_per_group(cols: list[jnp.ndarray], valid: jnp.ndarray) -> jnp.ndarray:
