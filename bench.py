@@ -176,10 +176,15 @@ WORKLOADS = {
         1.0,
         None,
     ),
-    # BASELINE.json config 5: DEBS-style count sequence with a kleene bound
+    # BASELINE.json config 5: DEBS-style count sequence with a kleene bound.
+    # patternCapacity is an ENGINE BUFFER knob, not workload semantics: the
+    # reference's pending lists are unbounded, and at this data rate neither
+    # 128 nor 4096 overflows (identical outputs) — but the batch kernel
+    # chunks the batch at the token-table size, so 128 forced 256 sequential
+    # chunk passes per 32k batch (r4; raised for chunking, outputs unchanged)
     "count_sequence": (
         """
-        @app:patternCapacity(size='128')
+        @app:patternCapacity(size='4096')
         define stream StockStream (symbol string, price float, volume long);
         @info(name='q')
         from every a1=StockStream[price > 90]<2:4> -> a2=StockStream[price < 10]

@@ -83,6 +83,7 @@ from siddhi_tpu.query_api.execution import (
     StreamStateElement,
 )
 from siddhi_tpu.ops.prefix import first_indices
+from siddhi_tpu.ops.scatter import set_at as _set_at
 from siddhi_tpu.query_api.expression import Expression
 
 NO_TIMER = np.int64(np.iinfo(np.int64).max)
@@ -1187,15 +1188,23 @@ class PatternProgram:
         else:
             Madv = jnp.zeros((B,), dtype=jnp.bool_)
 
-        # cnt_nostop[t, b]: occurrences the token would hold entering row b
+        # token t advances at the first row b with Madv[b] and enter-count
+        # n0 + min(midx_excl[b], room) >= m — equivalently midx_excl[b] >=
+        # m - n0, since room = M - n0 with M >= m never blocks reaching m
         # (midx_excl: the reference forwards at min via newAndEvery, pending
         # only from the NEXT event, and checks the next state first — so the
-        # row that reaches min is itself not advance-eligible)
+        # row that reaches min is itself not advance-eligible). ONE dense
+        # [T, B] pred compare on purpose: count arithmetic in [T, B] s32
+        # materialized ~20 int matrices (HLO-verified, ~1.5 GB/chunk), and a
+        # searchsorted form serializes into scalar-space gathers — this
+        # threshold compare fuses to a couple of pred buffers.
         room = (M - jnp.clip(n0, 0, M)).astype(jnp.int32)
-        cnt_nostop = n0[:, None] + jnp.minimum(
-            jnp.maximum(midx_excl[None, :], 0), room[:, None]
+        thresh = (m - jnp.clip(n0, 0, m)).astype(midx_excl.dtype)
+        adv_ok = (
+            at0[:, None]
+            & Madv[None, :]
+            & (midx_excl[None, :] >= thresh[:, None])
         )
-        adv_ok = at0[:, None] & Madv[None, :] & (cnt_nostop >= m)
         has_adv = adv_ok.any(axis=1)
         j = jnp.argmax(adv_ok, axis=1).astype(jnp.int32)
         jc = jnp.clip(j, 0, B - 1)
@@ -1229,14 +1238,13 @@ class PatternProgram:
         if ev1 is not None:
             c1 = dict(caps[atom1.ref_idx])
             c1["n"] = jnp.where(advD, 1, c1["n"]).astype(c1["n"].dtype)
-            c1["ts"] = jnp.where(
-                advD[:, None], c1["ts"].at[toks, 0].set(batch_ts[jc]), c1["ts"]
+            # column-0 writes via static slice update, not arange scatter
+            c1["ts"] = c1["ts"].at[:, 0].set(
+                jnp.where(advD, batch_ts[jc], c1["ts"][:, 0])
             )
             c1["cols"] = {
-                name: jnp.where(
-                    advD[:, None],
-                    arr.at[toks, 0].set(ev1[name][jc].astype(arr.dtype)),
-                    arr,
+                name: arr.at[:, 0].set(
+                    jnp.where(advD, ev1[name][jc].astype(arr.dtype), arr[:, 0])
                 )
                 for name, arr in c1["cols"].items()
             }
@@ -1259,8 +1267,16 @@ class PatternProgram:
             g = jnp.arange(Gmax, dtype=jnp.int32)
             s_g = (m - ny) + g * m
             valid_g = tail_exists & (s_g <= k_total)
-            cnt_g = jnp.clip(midx_excl[None, :] - s_g[:, None], 0, M)
-            advg_ok = valid_g[:, None] & Madv[None, :] & (cnt_g >= m)
+            # generation g advances at the first row b with Madv[b] and
+            # midx_excl[b] >= s_g + m (room never blocks, see adv_ok above).
+            # ONE [G, B] pred compare — count arithmetic in s32 matrices and
+            # a searchsorted loop both measured slower (the former
+            # materializes ~GBs, the latter serializes in scalar space).
+            advg_ok = (
+                valid_g[:, None]
+                & Madv[None, :]
+                & (midx_excl[None, :] >= (s_g + m)[:, None])
+            )
             has_advg = advg_ok.any(axis=1)
             jg = jnp.argmax(advg_ok, axis=1).astype(jnp.int32)
             jgc = jnp.clip(jg, 0, B - 1)
@@ -1284,8 +1300,8 @@ class PatternProgram:
             caps = [dict(c) for c in tok["caps"]]
             cr = dict(caps[atom0.ref_idx])
             cr["n"] = cr["n"].at[dst].set(Ag, mode="drop")
-            cr["ts"] = cr["ts"].at[dst].set(
-                jnp.where(wm_g, mts[src_gc], np.int64(0)), mode="drop"
+            cr["ts"] = _set_at(
+                cr["ts"], dst, jnp.where(wm_g, mts[src_gc], np.int64(0))
             )
             if ev0 is not None:
                 new_cols = {}
@@ -1301,15 +1317,18 @@ class PatternProgram:
                 c1["n"] = c1["n"].at[dst].set(
                     has_advg.astype(c1["n"].dtype), mode="drop"
                 )
-                c1["ts"] = c1["ts"].at[dst, 0].set(
-                    jnp.where(has_advg, batch_ts[jgc], np.int64(0)), mode="drop"
+                c1["ts"] = c1["ts"].at[:, 0].set(
+                    _set_at(
+                        c1["ts"][:, 0], dst,
+                        jnp.where(has_advg, batch_ts[jgc], np.int64(0)),
+                    )
                 )
                 new_cols = {}
                 for name, arr in c1["cols"].items():
                     t = self.schemas[atom1.stream_id].attr_types[name]
                     nv = np.asarray(null_value(t), dtype=arr.dtype)
                     gv = jnp.where(has_advg, ev1[name][jgc].astype(arr.dtype), nv)
-                    new_cols[name] = arr.at[dst, 0].set(gv, mode="drop")
+                    new_cols[name] = arr.at[:, 0].set(_set_at(arr[:, 0], dst, gv))
                 c1["cols"] = new_cols
                 caps[atom1.ref_idx] = c1
             # untouched refs: clear stale lane contents
@@ -1321,14 +1340,20 @@ class PatternProgram:
                     continue
                 c = dict(caps[ridx])
                 c["n"] = c["n"].at[dst].set(0, mode="drop")
-                c["ts"] = c["ts"].at[dst].set(np.int64(0), mode="drop")
+                c["ts"] = _set_at(
+                    c["ts"], dst, jnp.zeros(dst.shape + c["ts"].shape[1:], c["ts"].dtype)
+                )
                 c["cols"] = {
-                    name: arr.at[dst].set(
-                        np.asarray(
-                            null_value(self.schemas[a.stream_id].attr_types[name]),
+                    name: _set_at(
+                        arr, dst,
+                        jnp.full(
+                            dst.shape + arr.shape[1:],
+                            np.asarray(
+                                null_value(self.schemas[a.stream_id].attr_types[name]),
+                                arr.dtype,
+                            ),
                             arr.dtype,
                         ),
-                        mode="drop",
                     )
                     for name, arr in c["cols"].items()
                 }
@@ -1339,9 +1364,9 @@ class PatternProgram:
                 "slot": tok["slot"].at[dst].set(
                     jnp.where(has_advg, 2, 0), mode="drop"
                 ),
-                "start_ts": tok["start_ts"].at[dst].set(g_start, mode="drop"),
-                "entry_ts": tok["entry_ts"].at[dst].set(
-                    mts[jnp.clip(s_g - 1, 0, B - 1)], mode="drop"
+                "start_ts": _set_at(tok["start_ts"], dst, g_start),
+                "entry_ts": _set_at(
+                    tok["entry_ts"], dst, mts[jnp.clip(s_g - 1, 0, B - 1)]
                 ),
                 "caps": caps,
             }
@@ -1368,14 +1393,12 @@ class PatternProgram:
             caps = [dict(c) for c in tok["caps"]]
             crp = dict(caps[atom.ref_idx])
             crp["n"] = jnp.where(has, 1, crp["n"]).astype(crp["n"].dtype)
-            crp["ts"] = jnp.where(
-                has[:, None], crp["ts"].at[toks, 0].set(batch_ts[jjc]), crp["ts"]
+            crp["ts"] = crp["ts"].at[:, 0].set(
+                jnp.where(has, batch_ts[jjc], crp["ts"][:, 0])
             )
             crp["cols"] = {
-                name: jnp.where(
-                    has[:, None],
-                    arr.at[toks, 0].set(ev[name][jjc].astype(arr.dtype)),
-                    arr,
+                name: arr.at[:, 0].set(
+                    jnp.where(has, ev[name][jjc].astype(arr.dtype), arr[:, 0])
                 )
                 for name, arr in crp["cols"].items()
             }
@@ -1407,21 +1430,19 @@ class PatternProgram:
             batch_ts[jnp.clip(entry_row[src_t], 0, B - 1)],
             now,
         )
-        out["ts"] = out["ts"].at[dest].set(emit_ts, mode="drop")
+        out["ts"] = _set_at(out["ts"], dest, emit_ts)
         out["valid"] = out["valid"].at[dest].set(True, mode="drop")
         for a in self.refs:
             c = tok["caps"][a.ref_idx]
             out[f"n{a.ref_idx}"] = out[f"n{a.ref_idx}"].at[dest].set(
                 c["n"][src_t], mode="drop"
             )
-            out[f"ts{a.ref_idx}"] = out[f"ts{a.ref_idx}"].at[dest].set(
-                c["ts"][src_t], mode="drop"
+            out[f"ts{a.ref_idx}"] = _set_at(
+                out[f"ts{a.ref_idx}"], dest, c["ts"][src_t]
             )
             for name in c["cols"]:
-                out[f"c{a.ref_idx}.{name}"] = (
-                    out[f"c{a.ref_idx}.{name}"].at[dest].set(
-                        c["cols"][name][src_t], mode="drop"
-                    )
+                out[f"c{a.ref_idx}.{name}"] = _set_at(
+                    out[f"c{a.ref_idx}.{name}"], dest, c["cols"][name][src_t]
                 )
         out_n = jnp.minimum(
             out_n + done.sum(dtype=jnp.int32), cap
@@ -1510,16 +1531,20 @@ class PatternProgram:
                 dstc = jnp.where(okf, dest, T)  # T = dropped lane
                 active2 = tok["active"].at[dstc].set(True, mode="drop")
                 slot2 = tok["slot"].at[dstc].set(1, mode="drop")
-                start2 = tok["start_ts"].at[dstc].set(batch_ts, mode="drop")
-                entry2 = tok["entry_ts"].at[dstc].set(batch_ts, mode="drop")
+                # set_at / column-slice forms: raw 64-bit scatters serialize
+                # on TPU (ops/scatter.py) — these run once per batch at [B]
+                start2 = _set_at(tok["start_ts"], dstc, batch_ts)
+                entry2 = _set_at(tok["entry_ts"], dstc, batch_ts)
                 entry_row = entry_row.at[dstc].set(rows, mode="drop")
                 caps = [dict(c) for c in tok["caps"]]
                 cr = dict(caps[atom.ref_idx])
                 cr["n"] = cr["n"].at[dstc].set(1, mode="drop")
-                cr["ts"] = cr["ts"].at[dstc, 0].set(batch_ts, mode="drop")
+                cr["ts"] = cr["ts"].at[:, 0].set(
+                    _set_at(cr["ts"][:, 0], dstc, batch_ts)
+                )
                 cr["cols"] = {
-                    name: arr.at[dstc, 0].set(
-                        ev[name].astype(arr.dtype), mode="drop"
+                    name: arr.at[:, 0].set(
+                        _set_at(arr[:, 0], dstc, ev[name].astype(arr.dtype))
                     )
                     for name, arr in cr["cols"].items()
                 }
@@ -1537,14 +1562,13 @@ class PatternProgram:
                 caps = [dict(c) for c in tok["caps"]]
                 cr = dict(caps[atom.ref_idx])
                 cr["n"] = jnp.where(adv, 1, cr["n"])
-                cr["ts"] = jnp.where(
-                    adv[:, None], cr["ts"].at[toks, 0].set(mts), cr["ts"]
+                # column-0 writes via static slice update, not arange scatter
+                cr["ts"] = cr["ts"].at[:, 0].set(
+                    jnp.where(adv, mts, cr["ts"][:, 0])
                 )
                 cr["cols"] = {
-                    name: jnp.where(
-                        adv[:, None],
-                        arr.at[toks, 0].set(ev[name][jc].astype(arr.dtype)),
-                        arr,
+                    name: arr.at[:, 0].set(
+                        jnp.where(adv, ev[name][jc].astype(arr.dtype), arr[:, 0])
                     )
                     for name, arr in cr["cols"].items()
                 }
@@ -1575,15 +1599,15 @@ class PatternProgram:
         emit_ts = jnp.where(
             entry_row[src] >= 0, batch_ts[jnp.clip(entry_row[src], 0, B - 1)], now
         )
-        out["ts"] = out["ts"].at[dest].set(emit_ts, mode="drop")
+        out["ts"] = _set_at(out["ts"], dest, emit_ts)
         out["valid"] = out["valid"].at[dest].set(True, mode="drop")
         for a in self.refs:
             c = tok["caps"][a.ref_idx]
             out[f"n{a.ref_idx}"] = out[f"n{a.ref_idx}"].at[dest].set(c["n"][src], mode="drop")
-            out[f"ts{a.ref_idx}"] = out[f"ts{a.ref_idx}"].at[dest].set(c["ts"][src], mode="drop")
+            out[f"ts{a.ref_idx}"] = _set_at(out[f"ts{a.ref_idx}"], dest, c["ts"][src])
             for name in c["cols"]:
-                out[f"c{a.ref_idx}.{name}"] = (
-                    out[f"c{a.ref_idx}.{name}"].at[dest].set(c["cols"][name][src], mode="drop")
+                out[f"c{a.ref_idx}.{name}"] = _set_at(
+                    out[f"c{a.ref_idx}.{name}"], dest, c["cols"][name][src]
                 )
         out_n = jnp.minimum(out_n + done.sum(dtype=jnp.int32), cap).astype(jnp.int32)
         tok = {**tok, "active": tok["active"] & ~done}

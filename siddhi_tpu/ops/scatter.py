@@ -53,8 +53,3 @@ def set_at(dst: jnp.ndarray, idx: jnp.ndarray, src: jnp.ndarray, *, mode: str = 
     )
 
 
-def where_set(dst: jnp.ndarray, idx: jnp.ndarray, src: jnp.ndarray, pred, *, mode: str = "drop") -> jnp.ndarray:
-    """set_at under a per-row predicate: rows with pred False scatter out of
-    bounds (dropped)."""
-    n = dst.shape[0]
-    return set_at(dst, jnp.where(pred, idx, n), src, mode=mode)

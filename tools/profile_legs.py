@@ -30,8 +30,7 @@ def profile_leg(name: str, batch=32768, reps=4):
 
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime(ql)
-    data = B._make_stock_data(bsz * 40)
-    B._prime_interner(mgr, data["names"])
+    B._prime_interner(mgr, B._make_stock_data(8)["names"])
     rt.start()
     j = rt.junctions[stream]
     fi = j.fused_ingest
@@ -40,6 +39,7 @@ def profile_leg(name: str, batch=32768, reps=4):
         return
     fi._build()
     K = fi.K
+    data = B._make_stock_data(bsz * K)  # sized from the engine's real K
     cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
     encode, _d, wire_bytes = j.schema.wire_codec(bsz, fi._keep)
 
