@@ -696,14 +696,29 @@ class SiddhiAppRuntime:
         if name in self.queries:
             qr = self.queries[name]
 
-            def qcb(ts, ins, removed, _cb=callback):
+            # all-C construction path: namedtuple __new__ measured ~1.5 us
+            # per event against ~0.3 us for map(partial(tuple.__new__, ...))
+            import operator
+            from functools import partial
+
+            _mk = partial(tuple.__new__, Event)
+            _td = operator.itemgetter(0, 2)
+
+            def qcb(ts, ins, removed, _cb=callback, _mk=_mk, _td=_td):
                 _cb(
                     ts,
-                    [Event(t, d) for t, _, d in ins] if ins else None,
-                    [Event(t, d) for t, _, d in removed] if removed else None,
+                    list(map(_mk, map(_td, ins))) if ins else None,
+                    list(map(_mk, map(_td, removed))) if removed else None,
                 )
 
             qr.query_callbacks.append(qcb)
+            # raw-callback registry: the fused egress drain builds Event
+            # lists once and invokes user callbacks directly, skipping the
+            # triple->Event re-extraction (only valid while the two lists
+            # stay in 1:1 correspondence; the drain checks)
+            if not hasattr(qr, "raw_query_callbacks"):
+                qr.raw_query_callbacks = []
+            qr.raw_query_callbacks.append(callback)
             return
         if name in self.stream_schemas:
             j = self._junction(name)

@@ -380,6 +380,66 @@ class StreamSchema:
         return out
 
 
+def column_lists(schema, cols: dict, n: int, interner) -> list[list]:
+    """Vectorized host decode of n packed rows into per-attribute Python
+    lists (bulk .tolist() + fix-ups; ~10x faster than per-row decode_value)."""
+    col_lists = []
+    for name, t in schema.attrs:
+        arr = np.asarray(cols[name])[:n]
+        if t in (AttrType.STRING, AttrType.OBJECT):
+            col_lists.append(interner.lookup_many(arr))
+        elif t is AttrType.BOOL:
+            col_lists.append(arr.astype(bool).tolist())
+        elif t in (AttrType.FLOAT, AttrType.DOUBLE):
+            vals = arr.tolist()
+            nan = np.isnan(arr)
+            if nan.any():
+                for i in np.nonzero(nan)[0]:
+                    vals[i] = None
+            col_lists.append(vals)
+        else:
+            vals = arr.tolist()
+            nv = null_value(t)
+            if nv is not None:
+                isnull = arr == np.asarray(nv, arr.dtype)
+                if isnull.any():
+                    for i in np.nonzero(isnull)[0]:
+                        vals[i] = None
+            col_lists.append(vals)
+    return col_lists
+
+
+def rows_from_arrays(
+    schema, ts: np.ndarray, kind: np.ndarray, cols: dict, n: int, interner
+) -> list[tuple[int, int, tuple]]:
+    """Vectorized host decode of n packed rows -> (ts, kind, data) triples."""
+    if n <= 0:
+        return []
+    col_lists = column_lists(schema, cols, n, interner)
+    # .tolist() already yields Python ints; zip builds the triples directly
+    ts_l = np.asarray(ts)[:n].tolist()
+    if isinstance(kind, int):  # single-kind fast path (deliver drain)
+        kind_l = [kind] * n
+    else:
+        kind_l = np.asarray(kind)[:n].tolist()
+    return list(zip(ts_l, kind_l, zip(*col_lists)))
+
+
+def events_from_arrays(
+    schema, ts: np.ndarray, cols: dict, n: int, interner
+) -> list:
+    """Vectorized host decode straight to Event objects (single-kind fused
+    egress fast path — skips the triple intermediate entirely)."""
+    if n <= 0:
+        return []
+    import functools
+
+    col_lists = column_lists(schema, cols, n, interner)
+    ts_l = np.asarray(ts)[:n].tolist()
+    mk = functools.partial(tuple.__new__, Event)
+    return list(map(mk, zip(ts_l, zip(*col_lists))))
+
+
 def decode_value(v, t: AttrType, interner: InternTable):
     """Device scalar -> host Python value (reversing interning / null sentinels)."""
     if t in (AttrType.STRING, AttrType.OBJECT):

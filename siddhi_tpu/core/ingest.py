@@ -70,6 +70,7 @@ class FusedJunctionIngest:
         self.endpoints = list(endpoints)
         self.K = max(2, int(chunk_batches))
         self._fused = None
+        self._fused_deliver = None
         self._disabled = False
         self._lock = threading.Lock()
 
@@ -89,8 +90,8 @@ class FusedJunctionIngest:
                 return False
             if getattr(qr, "rate_limiter", None) is not None:
                 return False
-            if getattr(qr, "query_callbacks", None):
-                return False
+            # query callbacks are OK: the deliver-mode program packs outputs
+            # device-side and drains them once per chunk (see _build_deliver)
             if _needs_scheduler(qr) or getattr(qr, "host_next_timer", None):
                 return False
             tj = getattr(qr, "_insert_target_junction", None)
@@ -101,9 +102,18 @@ class FusedJunctionIngest:
                 return False
         return True
 
+    def _delivery_set(self) -> frozenset:
+        """Indices of endpoints whose outputs must be packed/drained."""
+        return frozenset(
+            i
+            for i, ep in enumerate(self.endpoints)
+            if getattr(ep.qr, "query_callbacks", None)
+        )
+
     # ---- device program --------------------------------------------------
 
-    def _build(self):
+    def _build(self, deliver_set: Optional[frozenset] = None):
+        deliver = deliver_set is not None
         B = self.junction.batch_size
         schema = self.junction.schema
         # projected wire: ship only attributes some subscriber reads
@@ -120,6 +130,7 @@ class FusedJunctionIngest:
         )
         _encode, decode, self._wire_bytes = schema.wire_codec(B, self._keep)
         impls = [ep.impl_factory() for ep in self.endpoints]
+        impls_want = [ep.qr.output_events for ep in self.endpoints]
 
         def fused(states, tstates, wire, counts, bases, now):
             def body(carry, xs):
@@ -127,8 +138,9 @@ class FusedJunctionIngest:
                 batch = decode(xs[0], xs[1], xs[2])
                 new_states = []
                 auxes = []
-                for impl, st in zip(impls, sts):
-                    st2, tst, _out, aux = impl(st, tst, batch, now)
+                outs = []
+                for ei, (impl, st) in enumerate(zip(impls, sts)):
+                    st2, tst, out, aux = impl(st, tst, batch, now)
                     new_states.append(st2)
                     auxes.append(
                         tuple(
@@ -137,19 +149,118 @@ class FusedJunctionIngest:
                             if k != "next_timer"
                         )
                     )
-                return (tuple(new_states), tst), tuple(auxes)
+                    if deliver and ei in deliver_set:
+                        # ship the raw lanes + a deliverable-row mask; the
+                        # post-scan pack compacts ALL K iterations with one
+                        # cumsum + scatter (per-iteration argsort compaction
+                        # measured ~2x slower). Kind-filter device-side when
+                        # the query emits only one kind.
+                        from siddhi_tpu.core.event import (
+                            KIND_CURRENT as _KC,
+                            KIND_EXPIRED as _KE,
+                        )
+                        from siddhi_tpu.query_api.execution import (
+                            OutputEventsFor as _OEF,
+                        )
 
-            (states, tstates), aux_stack = lax.scan(
+                        want = impls_want[ei]
+                        if want is _OEF.CURRENT:
+                            dv = out.valid & (out.kind == _KC)
+                        elif want is _OEF.EXPIRED:
+                            dv = out.valid & (out.kind == _KE)
+                        else:
+                            dv = out.valid & (
+                                (out.kind == _KC) | (out.kind == _KE)
+                            )
+                        lanes = {"ts": out.ts}
+                        if want is _OEF.ALL:
+                            lanes["kind"] = out.kind
+                        lanes.update(
+                            {f"c.{n}": c for n, c in out.cols.items()}
+                        )
+                        outs.append((lanes, dv))
+                return (tuple(new_states), tst), (tuple(auxes), tuple(outs))
+
+            (states, tstates), (aux_stack, out_stack) = lax.scan(
                 body, (states, tstates), (wire, counts, bases)
             )
             aux_red = tuple(
                 tuple(v.any() for v in a) for a in aux_stack
             )
-            return states, tstates, aux_red
+            if not deliver:
+                return states, tstates, aux_red, ()
+            # pack each endpoint's K compacted segments into ONE contiguous
+            # ROW-MAJOR byte buffer [R, row_bytes]: the host drains exactly
+            # the filled row prefix with a single contiguous slice transfer
+            # (per-lane buffers would need one transfer each)
+            from siddhi_tpu.ops.scatter import set_at
+
+            K = self.K
+            packs = []
+            for stacked, dv in out_stack:
+                cap = dv.shape[1]
+                R = K * cap
+                flat = dv.reshape(R)  # [K, cap] row-major = arrival order
+                rank = jnp.cumsum(flat.astype(jnp.int32)) - flat.astype(
+                    jnp.int32
+                )
+                dst = jnp.where(flat, rank, R)
+                segs = []
+                for name in sorted(stacked):
+                    arr = stacked[name].reshape(R)
+                    if arr.dtype == jnp.bool_:
+                        arr = arr.astype(jnp.uint8)
+                    packed = set_at(jnp.zeros((R,), arr.dtype), dst, arr)
+                    u8 = jax.lax.bitcast_convert_type(packed, jnp.uint8)
+                    if u8.ndim == 1:  # already byte-wide lanes
+                        u8 = u8[:, None]
+                    segs.append(u8)
+                data_buf = jnp.concatenate(segs, axis=1)
+                W = data_buf.shape[1]
+                # header rows carry the per-iteration counts INSIDE the
+                # buffer: the steady-state drain is then ONE d2h transfer
+                # (each transfer pays a ~fixed relay round trip)
+                cnt_u8 = jax.lax.bitcast_convert_type(
+                    dv.sum(axis=1, dtype=jnp.int32), jnp.uint8
+                ).reshape(-1)  # [4K]
+                hdr_rows = -(-cnt_u8.shape[0] // W)
+                hdr = jnp.zeros((hdr_rows * W,), jnp.uint8)
+                hdr = hdr.at[: cnt_u8.shape[0]].set(cnt_u8).reshape(hdr_rows, W)
+                packs.append(
+                    {"buf": jnp.concatenate([hdr, data_buf], axis=0)}
+                )
+            return states, tstates, aux_red, tuple(packs)
 
         # donate the per-endpoint states (exclusively owned); tstates may
         # alias read-only findables shared with other runtimes — not donated
-        self._fused = jax.jit(fused, donate_argnums=(0,))
+        prog = jax.jit(fused, donate_argnums=(0,))
+        if deliver:
+            self._fused_deliver = prog
+            self._deliver_set = deliver_set
+            self._deliver_idx = sorted(deliver_set)
+            # host-side byte layout of each endpoint's drain buffer, in the
+            # same sorted-lane order the device concatenated
+            from siddhi_tpu.query_api.execution import OutputEventsFor
+
+            self._deliver_layout = []
+            for ep in self.endpoints:
+                qr = ep.qr
+                dtypes = {
+                    f"c.{n}": np.dtype(a.dtype)
+                    for n, a in qr.out_schema.empty_batch(1).cols.items()
+                }
+                dtypes["ts"] = np.dtype(np.int64)
+                if qr.output_events is OutputEventsFor.ALL:
+                    dtypes["kind"] = np.dtype(np.int8)
+                layout = []
+                off = 0
+                for name in sorted(dtypes):
+                    dt = dtypes[name]
+                    layout.append((name, dt, off))
+                    off += dt.itemsize
+                self._deliver_layout.append((layout, off))
+        else:
+            self._fused = prog
         self._aux_keys = [self._probe_aux_keys(i) for i in range(len(impls))]
 
     # ---- host side -------------------------------------------------------
@@ -164,10 +275,14 @@ class FusedJunctionIngest:
         # batches, slower than the per-batch path off the tunnel
         if n < max(2 * B, self.K * B // 2) or self._disabled or not self.eligible():
             return False
+        dset = self._delivery_set()
+        deliver = bool(dset)
         with self._lock:
-            if self._fused is None:
+            if deliver and getattr(self, "_deliver_set", None) != dset:
+                self._fused_deliver = None  # callback set changed: rebuild
+            if (self._fused_deliver if deliver else self._fused) is None:
                 try:
-                    self._build()
+                    self._build(deliver_set=dset if deliver else None)
                 except Exception:
                     import logging
 
@@ -177,6 +292,7 @@ class FusedJunctionIngest:
                     )
                     self._disabled = True
                     return False
+        prog = self._fused_deliver if deliver else self._fused
         ts_arr = np.asarray(timestamps)
         if n and int(ts_arr.max()) - int(ts_arr.min()) >= (1 << 31):
             return False  # int32 ts-delta wire can't span >24 days per call
@@ -184,6 +300,7 @@ class FusedJunctionIngest:
 
         app_lock = self.app._process_lock
         K = self.K
+        pending_drain = None  # previous chunk's packs, drained one chunk late
         for c_off in range(0, n, K * B):
             c_end = min(c_off + K * B, n)
             bufs = []
@@ -219,7 +336,7 @@ class FusedJunctionIngest:
                     ep_tids.append(list(ts_ep))
                     tstates.update(ts_ep)
                 try:
-                    new_states, tstates, aux_red = self._fused(
+                    new_states, tstates, aux_red, packs = prog(
                         tuple(states), tstates, wire,
                         counts, bases, np.int64(now),
                     )
@@ -249,7 +366,134 @@ class FusedJunctionIngest:
                 flags = dict(zip(self._aux_keys[i], aux_red[i]))
                 if flags:
                     ep.qr._warn_aux(flags)
+            if deliver:
+                # drain the PREVIOUS chunk now that this chunk's device work
+                # is launched: the host decode overlaps device compute, and
+                # callbacks still fire in order before send_columns returns
+                if pending_drain is not None:
+                    self._drain(pending_drain)
+                pending_drain = packs
+        if pending_drain is not None:
+            self._drain(pending_drain)
         return True
+
+    def _drain(self, packs) -> None:
+        """Deliver one chunk's packed outputs to query callbacks: one counts
+        readback + one sliced transfer per endpoint-with-callbacks, then a
+        vectorized host decode, preserving per-micro-batch callback grouping
+        (reference: QueryCallback.receive per chunk,
+        query/output/callback/QueryCallback.java:52-105)."""
+        import jax
+
+        from siddhi_tpu.core.event import (
+            KIND_CURRENT,
+            KIND_EXPIRED,
+            rows_from_arrays,
+        )
+        from siddhi_tpu.query_api.execution import OutputEventsFor
+
+        if not hasattr(self, "_drain_guess"):
+            self._drain_guess = {}
+        # packs align with the endpoints the program was built to deliver
+        for i, pack in zip(self._deliver_idx, packs):
+            qr = self.endpoints[i].qr
+            if not getattr(qr, "query_callbacks", None):
+                continue
+            layout, row_bytes = self._deliver_layout[i]
+            K = self.K
+            hdr_rows = -(-4 * K // row_bytes)
+            R = pack["buf"].shape[0] - hdr_rows
+
+            def bucket(x: int) -> int:
+                return min(R, 1 << max(0, int(x - 1).bit_length()))
+
+            # ONE round trip in the steady state: the buffer's header rows
+            # carry the per-iteration counts, and the prefix is sized from
+            # the previous chunk's total; top up only when the guess
+            # undershoots (workload rates are stable)
+            guess = bucket(self._drain_guess.get(i, R))
+            head = np.asarray(
+                jax.device_get(pack["buf"][: hdr_rows + guess])
+            )
+            cnts = head[:hdr_rows].reshape(-1)[: 4 * K].view(np.int32)
+            total = int(cnts.sum())
+            self._drain_guess[i] = max(total, 1)
+            if total == 0:
+                continue
+            L = bucket(total)
+            if L <= guess:
+                host = head[hdr_rows:]
+            else:
+                tail = np.asarray(
+                    jax.device_get(
+                        pack["buf"][hdr_rows + guess : hdr_rows + L]
+                    )
+                )
+                host = np.concatenate([head[hdr_rows:], tail])
+            lanes = {}
+            for name, dt, off in layout:
+                lanes[name] = np.ascontiguousarray(
+                    host[:total, off : off + dt.itemsize]
+                ).view(dt)[:, 0]
+            want = qr.output_events
+            cols = {n: lanes[f"c.{n}"] for n in qr.out_schema.attr_names}
+            raw = getattr(qr, "raw_query_callbacks", None)
+            if want is not OutputEventsFor.ALL and raw is not None and len(
+                raw
+            ) == len(qr.query_callbacks):
+                # single-kind fast path: decode straight to Event lists and
+                # invoke the USER callbacks (skips the triple intermediate)
+                from siddhi_tpu.core.event import events_from_arrays
+
+                events = events_from_arrays(
+                    qr.out_schema, lanes["ts"], cols, total, qr._interner
+                )
+                expired = want is OutputEventsFor.EXPIRED
+                off = 0
+                for k in range(len(cnts)):
+                    c = int(cnts[k])
+                    if c == 0:
+                        continue
+                    seg = events[off : off + c]
+                    off += c
+                    ts = seg[-1][0]
+                    for cb in raw:
+                        if expired:
+                            cb(ts, None, seg)
+                        else:
+                            cb(ts, seg, None)
+                continue
+            kind = (
+                lanes["kind"]
+                if want is OutputEventsFor.ALL
+                else int(
+                    KIND_CURRENT
+                    if want is not OutputEventsFor.EXPIRED
+                    else KIND_EXPIRED
+                )
+            )
+            rows = rows_from_arrays(
+                qr.out_schema, lanes["ts"], kind, cols, total, qr._interner
+            )
+            split = want is OutputEventsFor.ALL
+            off = 0
+            for k in range(len(cnts)):
+                c = int(cnts[k])
+                if c == 0:
+                    continue
+                seg = rows[off : off + c]
+                off += c
+                if split:
+                    ins = [e for e in seg if e[1] == KIND_CURRENT]
+                    removed = [e for e in seg if e[1] == KIND_EXPIRED]
+                elif want is OutputEventsFor.EXPIRED:
+                    ins, removed = [], seg
+                else:
+                    ins, removed = seg, []
+                if ins or removed:
+                    ts = seg[-1][0]
+                    for cb in qr.query_callbacks:
+                        cb(ts, ins or None, removed or None)
 
     def _probe_aux_keys(self, i: int) -> list:
         """Sorted non-timer aux keys for endpoint i, discovered by tracing

@@ -96,6 +96,7 @@ class InternTable:
         self._lock = threading.Lock()
         self._to_id: dict[Any, int] = {}
         self._from_id: list[Any] = [None]  # id 0 -> null
+        self._snapshot = None  # cached object-array view for lookup_many
 
     def intern(self, value: Any) -> int:
         if value is None:
@@ -106,10 +107,26 @@ class InternTable:
                 ident = len(self._from_id)
                 self._to_id[value] = ident
                 self._from_id.append(value)
+                self._snapshot = None  # invalidate lookup_many cache
             return ident
 
     def lookup(self, ident: int) -> Any:
         return self._from_id[int(ident)]
+
+    def lookup_many(self, ids) -> list:
+        """Vectorized id -> value for an integer array (one fancy index
+        instead of len(ids) Python calls — the fused egress drain decodes
+        hundreds of thousands of interned ids per chunk). The object-array
+        snapshot is cached and invalidated by intern()."""
+        import numpy as np
+
+        with self._lock:
+            table = self._snapshot
+            if table is None:
+                table = self._snapshot = np.asarray(
+                    self._from_id, dtype=object
+                )
+        return table[np.asarray(ids, dtype=np.int64)].tolist()
 
     def __len__(self) -> int:
         return len(self._from_id)

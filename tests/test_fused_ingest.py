@@ -4,8 +4,8 @@ to the per-batch path.
 Each case runs the same columnar feed twice — fused (the default when a
 junction's subscribers are all fusable) and per-batch (fused engine detached)
 — and compares the full contents of a results table written by the query.
-Tables make outputs observable without callbacks (callbacks disqualify a
-junction from fusing, by design)."""
+Query callbacks ride the fused path too (deliver mode: device-side packed
+egress drained once per chunk) and must see identical events."""
 
 from __future__ import annotations
 
@@ -92,19 +92,57 @@ def test_fused_matches_per_batch(name):
     assert fused == per_batch
 
 
-def test_callback_junction_falls_back():
-    """A query callback disqualifies fusing; outputs must still flow."""
+DELIVER_CASES = {
+    "filter_cb": HEAD
+    + "@info(name='q') from S[price > 60] select symbol, price insert into Out;",
+    "window_avg_cb": HEAD
+    + """@info(name='q') from S#window.length(16)
+        select symbol, avg(price) as ap insert into Out;""",
+    "groupby_cb": HEAD
+    + """@info(name='q') from S#window.lengthBatch(32)
+        select symbol, sum(volume) as total group by symbol insert into Out;""",
+    "all_events_cb": HEAD
+    + """@info(name='q') from S#window.length(8)
+        select symbol, price insert all events into Out;""",
+}
+
+
+def _run_cb(ql, n, fused: bool):
     mgr = SiddhiManager()
-    rt = mgr.create_siddhi_app_runtime(HEAD + """
-        @info(name='q') from S[price > 60] select symbol, price insert into Out;
-    """)
+    rt = mgr.create_siddhi_app_runtime(ql)
     got = []
-    rt.add_callback("q", lambda ts, ins, rem: got.extend(ins or []))
+    rt.add_callback(
+        "q",
+        lambda ts, ins, rem: got.append(
+            (
+                ts,
+                [tuple(e.data) for e in (ins or [])],
+                [tuple(e.data) for e in (rem or [])],
+            )
+        ),
+    )
     for s in ["A", "B", "C", "D"]:
         mgr.interner.intern(s)
     rt.start()
-    ts, cols = _feed(64 * 8)
+    if not fused:
+        for j in rt.junctions.values():
+            j.fused_ingest = None
+    else:
+        assert rt.junctions["S"].fused_ingest is not None
+    ts, cols = _feed(n)
     rt.get_input_handler("S").send_columns(ts, cols)
     rt.shutdown()
     mgr.shutdown()
-    assert len(got) > 100  # ~40% of 512 rows pass the filter
+    return got
+
+
+@pytest.mark.parametrize("name", sorted(DELIVER_CASES))
+def test_fused_delivery_matches_per_batch(name):
+    """Query callbacks on the fused path: identical events, identical
+    per-micro-batch grouping, identical order."""
+    ql = DELIVER_CASES[name]
+    n = 64 * 40
+    fused = _run_cb(ql, n, fused=True)
+    per_batch = _run_cb(ql, n, fused=False)
+    assert fused == per_batch
+    assert sum(len(i) for _t, i, _r in fused) > 50
