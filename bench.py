@@ -230,7 +230,7 @@ def _leg_throughput(name: str, n: int, batch: int) -> float:
     return _run_workload(ql, stream, data, events, batch, callback=callback)
 
 
-def _leg_table_scaling(rows_list=(100_000, 1_000_000), batches=24) -> dict:
+def _leg_table_scaling(rows_list=(100_000, 1_000_000), batches=192) -> dict:
     """Events/s of a stream query probing+updating a table at capacity N.
     batch-1024 legs are the reproducible evidence for the exhaustive-scan-vs-
     index decision (VERDICT r1 item 9 / r2 weak #3); batch-8192 legs are the

@@ -42,8 +42,12 @@ Deliberate deviations from the reference interpreter (documented, test-covered):
   deadline once every present side arrived; `A or not B for t` completes via
   the present side immediately or at the deadline with the absent ref null —
   reference: AbsentLogicalPreStateProcessor, LogicalAbsentPatternTestCase
-  testQueryAbsent11-16). A logical element whose BOTH sides are absent
-  (`not A for t and/or not B for t`) is not supported.
+  testQueryAbsent11-16). Logical elements whose BOTH sides are absent
+  (`not A for t1 and/or not B for t2`) complete on timers: AND at the later
+  deadline iff neither side arrived inside its window; OR at each side's own
+  deadline iff that side never arrived (an `every` generator fires once per
+  clean side; non-every completes once at the earliest —
+  LogicalAbsentPatternTestCase testQueryAbsent25-40, 46-50).
 """
 
 from __future__ import annotations
@@ -228,8 +232,14 @@ def _flatten_state(
                 raise SiddhiAppCreationError(
                     "'and'/'or' sides must be plain or absent streams"
                 )
-        if all(a.absent for a in atoms):
-            raise SiddhiAppCreationError("both sides of a logical element are absent")
+        if all(a.absent for a in atoms) and any(
+            a.waiting_ms is None for a in atoms
+        ):
+            raise SiddhiAppCreationError(
+                "a logical element with both sides absent needs "
+                "'for <time>' on each side "
+                "(reference: AbsentLogicalPreStateProcessor waiting times)"
+            )
         slots.append(
             Slot(len(slots), atoms, logical=elem.type, within_ms=elem.within_ms)
         )
@@ -470,14 +480,29 @@ class PatternProgram:
             "cols": new_cols,
         }
 
-    def apply_event(self, tok, ts, kind, valid, stream_cols: dict[str, dict], out, out_n, overflow):
+    def apply_event(
+        self, tok, ts, kind, valid, stream_cols: dict[str, dict], out, out_n,
+        overflow, timer_seen=None,
+    ):
         """One scan step: apply a single event row to the token table.
 
         stream_cols: {stream_id: {attr: scalar}} — the row's columns, keyed by
         the stream this step function serves (one entry).
+
+        timer_seen: max TIMER timestamp already processed. Deadline blocks
+        fire on any valid row whose effective time max(ts, timer_seen)
+        passes the deadline — redundant when timers arrive in order (the
+        scheduler fires first), but it rescues tokens whose deadlines fall at
+        or before an already-processed timer (late/out-of-order event
+        timestamps), which next_timer's `after` filter would otherwise
+        silently drop.
         """
         is_cur = valid & (kind == KIND_CURRENT)
         is_timer = valid & (kind == KIND_TIMER)
+        if timer_seen is None:
+            timer_seen = np.int64(-(1 << 62))
+        eff_now = jnp.maximum(ts, timer_seen)
+        can_fire = is_timer | is_cur
 
         # within expiry (reference: StreamPreStateProcessor.isExpired :102-121)
         active = tok["active"]
@@ -528,19 +553,106 @@ class PatternProgram:
             p = slot.index
             if slot.is_absent and atom.waiting_ms is not None:
                 at_p = tok["active"] & (tok["slot"] == p)
-                fire = at_p & is_timer & (ts >= tok["entry_ts"] + atom.waiting_ms)
+                deadline = tok["entry_ts"] + atom.waiting_ms
+                fire = at_p & can_fire & (eff_now >= deadline)
+                # a token completed by an absence has no captured event to
+                # start its within clock: the deadline starts it (so `within`
+                # can expire absent-first patterns — AbsentPatternTestCase
+                # testQueryAbsent42)
+                started = jnp.where(
+                    fire & (tok["start_ts"] < 0), deadline, tok["start_ts"]
+                )
+                tok = {**tok, "start_ts": started}
                 if p == last:
                     # emit with this ref not arrived; output ts = deadline
                     out, out_n, overflow = self._write_emits(
-                        out, out_n, overflow, fire, tok,
-                        tok["entry_ts"] + atom.waiting_ms,
+                        out, out_n, overflow, fire, tok, deadline
                     )
-                    tok = self._consume(tok, fire, slot)
+                    if slot.persistent:
+                        # `every not X for t`: the generator re-arms with a
+                        # fresh window starting at the fired deadline
+                        # (EveryAbsentPatternTestCase testQueryAbsent1)
+                        tok = self._clear_slot_caps(
+                            tok, fire, slot, ts=deadline
+                        )
+                    else:
+                        tok = self._consume(tok, fire, slot)
+                elif slot.persistent:
+                    # fork the completion downstream; generator re-arms
+                    tok, overflow, _dest = self._fork(
+                        tok, tok, fire, p + 1, deadline, overflow
+                    )
+                    tok = self._clear_slot_caps(tok, fire, slot, ts=deadline)
                 else:
-                    tok = self._advance_rows(
-                        tok, fire, slot, tok["entry_ts"] + atom.waiting_ms
-                    )
+                    tok = self._advance_rows(tok, fire, slot, deadline)
                 touched = touched | fire
+            elif slot.logical is not None and all(
+                a.absent and a.waiting_ms is not None for a in slot.atoms
+            ):
+                # both sides absent (`not A for t1 and/or not B for t2`) —
+                # reference: AbsentLogicalPreStateProcessor with two absent
+                # partners (LogicalAbsentPatternTestCase 25-40, 46-50).
+                # AND completes at the LATER deadline iff neither side
+                # arrived inside its window; OR completes at each side's own
+                # deadline iff that side never arrived (an `every` generator
+                # fires once per side — two pendings when both are clean;
+                # a non-every element completes once, at the earliest).
+                a1, a2 = slot.atoms[0], slot.atoms[1]
+                at_p = tok["active"] & (tok["slot"] == p)
+                arr1 = tok["caps"][a1.ref_idx]["n"] > 0
+                arr2 = tok["caps"][a2.ref_idx]["n"] > 0
+                if p == 0:
+                    # start-of-pattern: an arrival re-arms that side's
+                    # window from the arrival (marker ts lane), it does not
+                    # block completion forever
+                    last1 = tok["caps"][a1.ref_idx]["ts"][:, 0]
+                    last2 = tok["caps"][a2.ref_idx]["ts"][:, 0]
+                    dl1 = jnp.maximum(tok["entry_ts"], last1) + a1.waiting_ms
+                    dl2 = jnp.maximum(tok["entry_ts"], last2) + a2.waiting_ms
+                    arr1 = jnp.zeros_like(arr1)
+                    arr2 = jnp.zeros_like(arr2)
+                else:
+                    dl1 = tok["entry_ts"] + a1.waiting_ms
+                    dl2 = tok["entry_ts"] + a2.waiting_ms
+                if slot.logical is LogicalType.AND:
+                    both_dl = jnp.maximum(dl1, dl2)
+                    fires = [
+                        (
+                            at_p & can_fire & ~arr1 & ~arr2 & (eff_now >= both_dl),
+                            both_dl,
+                        )
+                    ]
+                else:
+                    f1 = at_p & can_fire & ~arr1 & (eff_now >= dl1)
+                    f2 = at_p & can_fire & ~arr2 & (eff_now >= dl2)
+                    if slot.persistent:
+                        fires = [(f1, dl1), (f2, dl2)]
+                    else:
+                        fires = [(f1 | f2, jnp.where(f1, dl1, dl2))]
+                for fire, dts in fires:
+                    if p == last:
+                        out, out_n, overflow = self._write_emits(
+                            out, out_n, overflow, fire, tok, dts
+                        )
+                        if slot.persistent:
+                            # every-generator: window restarts at the fired
+                            # deadline
+                            tok = self._clear_slot_caps(
+                                tok, fire, slot, ts=dts
+                            )
+                        else:
+                            tok = self._consume(tok, fire, slot)
+                    elif slot.persistent:
+                        # fork the pending completion; the generator stays
+                        # armed with its window restarted at the deadline
+                        tok, overflow, _dest = self._fork(
+                            tok, tok, fire, p + 1, dts, overflow
+                        )
+                        tok = self._clear_slot_caps(tok, fire, slot, ts=dts)
+                    else:
+                        tok = self._advance_rows(tok, fire, slot, dts)
+                    touched = touched | fire
+                continue
             elif slot.logical is not None:
                 # `A and not B for t`: completes at the deadline once every
                 # present side has arrived. `A or not B for t`: completes at
@@ -563,7 +675,7 @@ class PatternProgram:
                     # B's arrival was recorded as a capture marker (it must
                     # not kill the token — A can still complete the or)
                     b_arrived = tok["caps"][ab.ref_idx]["n"] > 0
-                    fire = at_p & is_timer & ~b_arrived & (ts >= deadline)
+                    fire = at_p & can_fire & ~b_arrived & (eff_now >= deadline)
                 else:
                     arrived = jnp.ones((self.T,), dtype=jnp.bool_)
                     for a2 in slot.atoms:
@@ -571,7 +683,7 @@ class PatternProgram:
                             arrived = arrived & (
                                 tok["caps"][a2.ref_idx]["n"] > 0
                             )
-                    fire = at_p & is_timer & arrived & (ts >= deadline)
+                    fire = at_p & can_fire & arrived & (eff_now >= deadline)
                 if p == last:
                     out, out_n, overflow = self._write_emits(
                         out, out_n, overflow, fire, tok, deadline
@@ -581,6 +693,13 @@ class PatternProgram:
                         # surviving every-generator re-arms fresh, window
                         # restarting at the deadline
                         tok = self._clear_slot_caps(tok, fire, slot, ts=ts)
+                elif slot.persistent:
+                    # `every` generator: fork the completion downstream and
+                    # keep the generator armed with a fresh window
+                    tok, overflow, _dest = self._fork(
+                        tok, tok, fire, p + 1, deadline, overflow
+                    )
+                    tok = self._clear_slot_caps(tok, fire, slot, ts=deadline)
                 else:
                     tok = self._advance_rows(tok, fire, slot, deadline)
                 touched = touched | fire
@@ -612,24 +731,49 @@ class PatternProgram:
                 for c in self._conds[(p, atom.ref_idx)]:
                     match = match & c(env)
                 if atom.absent:
-                    if (
-                        slot.logical is LogicalType.OR
-                        and atom.waiting_ms is not None
+                    both_absent = slot.logical is not None and all(
+                        a2.absent for a2 in slot.atoms
+                    )
+                    if atom.waiting_ms is not None and (
+                        slot.logical is LogicalType.OR or both_absent
                     ):
-                        # `A or not B for t`: B's arrival inside the window
-                        # must not kill the token (A can still satisfy the
-                        # or) — record it as a capture marker so the TIMER
-                        # path knows the absent side can never fire
-                        # (reference: AbsentLogicalPreStateProcessor OR —
+                        # `A or not B for t` / `not A for t1 and not B for
+                        # t2`: an arrival inside the window must not kill the
+                        # token (the other side may still satisfy the element,
+                        # and an `every` generator must survive) — record it
+                        # as a capture marker so the TIMER path knows this
+                        # absent side can never fire
+                        # (reference: AbsentLogicalPreStateProcessor —
                         # the partner processor keeps waiting)
                         mark = match & (
                             ts <= tok["entry_ts"] + atom.waiting_ms
                         )
-                        new_caps = list(tok["caps"])
-                        new_caps[atom.ref_idx] = self._capture(
-                            tok["caps"][atom.ref_idx], atom, mark, ts, ev
-                        )
-                        tok = {**tok, "caps": new_caps}
+                        if p == 0 and both_absent:
+                            # start-of-pattern both-absent: an arrival
+                            # re-arms THAT SIDE's window from the arrival
+                            # (reference: the initial state always re-waits;
+                            # LogicalAbsentPatternTestCase 46, 34/35) — track
+                            # the latest arrival in the marker's ts lane
+                            c = dict(tok["caps"][atom.ref_idx])
+                            c["n"] = jnp.where(mark, 1, c["n"]).astype(
+                                c["n"].dtype
+                            )
+                            c["ts"] = c["ts"].at[:, 0].set(
+                                jnp.where(
+                                    mark,
+                                    jnp.maximum(c["ts"][:, 0], ts),
+                                    c["ts"][:, 0],
+                                )
+                            )
+                            new_caps = list(tok["caps"])
+                            new_caps[atom.ref_idx] = c
+                            tok = {**tok, "caps": new_caps}
+                        else:
+                            new_caps = list(tok["caps"])
+                            new_caps[atom.ref_idx] = self._capture(
+                                tok["caps"][atom.ref_idx], atom, mark, ts, ev
+                            )
+                            tok = {**tok, "caps": new_caps}
                         slot_touch = slot_touch | mark
                         continue
                     # arrival on an absent stream kills the token
@@ -639,7 +783,18 @@ class PatternProgram:
                         match = match & (
                             ts <= tok["entry_ts"] + atom.waiting_ms
                         )
-                    tok = {**tok, "active": tok["active"] & ~match}
+                    if p == 0 and atom.waiting_ms is not None:
+                        # start-of-pattern absent: the initial/generator
+                        # token RE-ARMS instead of dying — the reference's
+                        # init state always re-waits from the violating
+                        # arrival, captures cleared
+                        # (LogicalAbsentPatternTestCase testQueryAbsent10)
+                        rearm = match & (tok["start_ts"] < 0)
+                        kill = match & ~rearm
+                        tok = {**tok, "active": tok["active"] & ~kill}
+                        tok = self._clear_slot_caps(tok, rearm, slot, ts=ts)
+                    else:
+                        tok = {**tok, "active": tok["active"] & ~match}
                     slot_touch = slot_touch | match
                     continue
 
@@ -682,7 +837,8 @@ class PatternProgram:
                             # early present arrival stays captured and the
                             # TIMER path completes it
                             complete = complete & (
-                                ts >= tok["entry_ts"] + wait_ab.waiting_ms
+                                eff_now
+                                >= tok["entry_ts"] + wait_ab.waiting_ms
                             )
                     advance = complete
                 elif slot.is_count:
@@ -1693,18 +1849,33 @@ class PatternProgram:
         )
         return cols
 
-    def next_timer(self, tok) -> jnp.ndarray:
-        """Earliest absent-slot deadline over active tokens, NO_TIMER if none."""
+    def next_timer(self, tok, after=None) -> jnp.ndarray:
+        """Earliest absent-slot deadline over active tokens, NO_TIMER if none.
+
+        `after`: deadlines at or before this (the max timer timestamp already
+        processed) are excluded — they were handled by that timer pass, and
+        re-arming them would loop forever on a logical element whose absent
+        deadline passed while its present side is still pending."""
         t = NO_TIMER
         for slot in self.slots:
-            waits = [
-                a.waiting_ms
+            absents = [
+                a
                 for a in slot.atoms
                 if a.absent and a.waiting_ms is not None
             ]
-            if not waits or (len(slot.atoms) == 1 and not slot.is_absent):
+            if not absents or (len(slot.atoms) == 1 and not slot.is_absent):
                 continue
+            both_absent = len(absents) == len(slot.atoms) >= 2
             at_p = tok["active"] & (tok["slot"] == slot.index)
-            dl = jnp.where(at_p, tok["entry_ts"] + waits[0], NO_TIMER)
-            t = jnp.minimum(t, jnp.min(dl))
+            for a in absents:  # both-absent elements wait per side
+                base = tok["entry_ts"]
+                if slot.index == 0 and both_absent:
+                    # arrivals re-arm that side's window (see apply_event)
+                    base = jnp.maximum(
+                        base, tok["caps"][a.ref_idx]["ts"][:, 0]
+                    )
+                dl = jnp.where(at_p, base + a.waiting_ms, NO_TIMER)
+                if after is not None:
+                    dl = jnp.where(dl > after, dl, NO_TIMER)
+                t = jnp.minimum(t, jnp.min(dl))
         return t

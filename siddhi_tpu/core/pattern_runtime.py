@@ -101,6 +101,11 @@ class PatternQueryRuntime(BaseQueryRuntime):
         return {
             "tok": self.prog.init_state(now),
             "sel": self.selector.init_state(),
+            # max TIMER timestamp already processed: next_timer never re-arms
+            # a deadline at or before this (a logical-and element whose absent
+            # deadline passed but whose present side is still pending would
+            # otherwise re-arm the same past deadline forever)
+            "timer_ts": jnp.full((), -(1 << 62), jnp.int64),
         }
 
     def _make_step(self, stream_id: Optional[str]):
@@ -170,7 +175,10 @@ class PatternQueryRuntime(BaseQueryRuntime):
                     (state["tok"], out0, np.int32(0), np.bool_(False)),
                     xs,
                 )
-                return self._finish_step(state, tok, out, ovf, tstates, now)
+                # fast-path patterns have no waiting atoms -> no timers
+                return self._finish_step(
+                    state, tok, out, ovf, tstates, now, state["timer_ts"]
+                )
 
             return fast_step
 
@@ -209,15 +217,25 @@ class PatternQueryRuntime(BaseQueryRuntime):
                     out,
                     out_n,
                     ovf,
+                    timer_seen=state["timer_ts"],
                 )
                 return (tok, out, out_n, ovf), None
 
             (tok, out, _, ovf), _ = lax.scan(body, carry0, xs)
-            return self._finish_step(state, tok, out, ovf, tstates, now)
+            timer_rows = batch.valid & (batch.kind == KIND_TIMER)
+            timer_ts = jnp.maximum(
+                state["timer_ts"],
+                jnp.max(
+                    jnp.where(timer_rows, batch.ts, -(np.int64(1) << 62))
+                ),
+            )
+            return self._finish_step(
+                state, tok, out, ovf, tstates, now, timer_ts
+            )
 
         return step
 
-    def _finish_step(self, state, tok, out, ovf, tstates, now):
+    def _finish_step(self, state, tok, out, ovf, tstates, now, timer_ts):
         """Shared step tail: emission buffer -> selector -> table op -> aux."""
         prog = self.prog
         emit_batch = EventBatch(
@@ -238,8 +256,13 @@ class PatternQueryRuntime(BaseQueryRuntime):
             tstates = self.table_op(tstates, out_batch, now, flow.aux)
         aux = dict(flow.aux)
         aux["pattern_overflow"] = ovf
-        aux["next_timer"] = prog.next_timer(tok)
-        return {"tok": tok, "sel": sel_state}, tstates, out_batch, aux
+        aux["next_timer"] = prog.next_timer(tok, after=timer_ts)
+        return (
+            {"tok": tok, "sel": sel_state, "timer_ts": timer_ts},
+            tstates,
+            out_batch,
+            aux,
+        )
 
     # ---- host side -------------------------------------------------------
 
@@ -275,5 +298,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
         with self._receive_lock:
             if self.state is None:
                 self.state = self._fresh(self.init_state(now))
-            t = self.prog.next_timer(self.state["tok"])
+            t = self.prog.next_timer(
+                self.state["tok"], after=self.state["timer_ts"]
+            )
         return {"next_timer": t}

@@ -84,6 +84,15 @@ class InMemoryTable:
             definition.annotations, "IndexBy"
         )
         self.indexes: list[str] = [v for _, v in idx.elements] if idx else []
+        for k in self.indexes:
+            if k not in self.schema.attr_names:
+                raise SiddhiAppCreationError(
+                    f"table '{self.table_id}': @Index attribute '{k}' undefined"
+                )
+        # declared @Index columns are maintained from creation (reference:
+        # IndexEventHolder builds declared indexes eagerly); equality-probed
+        # columns additionally auto-index at query-compile time
+        self._indexed_cols = tuple(dict.fromkeys(self.indexes))
 
         self.lock = threading.RLock()
         self.state = self.init_state()
@@ -186,25 +195,39 @@ class InMemoryTable:
 
     # ---- state ------------------------------------------------------------
 
+    # columns carrying a sorted index in state (set at query-compile time by
+    # enable_index; a table never probed through an index must not pay an
+    # O(C log C) sort per ingest batch). Reference analog: the
+    # IndexEventHolder's per-column TreeMap/HashMap indexes
+    # (table/holder/IndexEventHolder.java:59-110), here one sorted
+    # permutation per column + a duplicate flag (the probe path requires
+    # currently-unique keys; duplicates fall back to the dense compare).
+    _indexed_cols: tuple = ()
+
     @property
     def _pk_indexed(self) -> bool:
-        """True once some compiled query actually uses the PK probe path:
-        only then does state carry (and inserts maintain) the sorted-key
-        index — a @PrimaryKey table used purely for overwrite semantics
-        must not pay an O(C log C) sort per ingest batch."""
-        return len(self.primary_keys) == 1 and self._pk_index_used
+        return (
+            len(self.primary_keys) == 1
+            and self.primary_keys[0] in self._indexed_cols
+        )
 
-    _pk_index_used = False
+    def enable_index(self, col: str) -> None:
+        """Called at query-compile time when an equality probe on `col`
+        compiles (PK update, @Index column, or auto-indexed equality update
+        probe); upgrades live state in place."""
+        if col in self._indexed_cols:
+            return
+        if col not in self.schema.attr_names:
+            raise SiddhiAppCreationError(
+                f"table '{self.table_id}': cannot index undefined column '{col}'"
+            )
+        self._indexed_cols = tuple(self._indexed_cols) + (col,)
+        with self.lock:
+            self.state = self._rebuild_index(dict(self.state), col)
 
     def enable_pk_index(self) -> None:
-        """Called at query-compile time by compile_table_output when a
-        `T.pk == probe` update compiles; upgrades live state in place."""
-        if self._pk_index_used or len(self.primary_keys) != 1:
-            self._pk_index_used = True
-            return
-        self._pk_index_used = True
-        with self.lock:
-            self.state = self._rebuild_pk_index(dict(self.state))
+        if len(self.primary_keys) == 1:
+            self.enable_index(self.primary_keys[0])
 
     def init_state(self):
         c = self.capacity
@@ -218,22 +241,33 @@ class InMemoryTable:
             "seq": jnp.full((c,), jnp.iinfo(jnp.int64).max, jnp.int64),
             "next": jnp.zeros((), jnp.int64),
         }
-        if self._pk_indexed:
-            kd = st["cols"][self.primary_keys[0]].dtype
-            st["pk_order"] = jnp.arange(c, dtype=jnp.int32)
-            st["pk_sorted"] = jnp.full((c,), _sort_sentinel(kd), kd)
+        for col in self._indexed_cols:
+            kd = st["cols"][col].dtype
+            st[f"ix_order.{col}"] = jnp.arange(c, dtype=jnp.int32)
+            st[f"ix_sorted.{col}"] = jnp.full((c,), _sort_sentinel(kd), kd)
+            st[f"ix_dups.{col}"] = jnp.zeros((), jnp.bool_)
         return st
 
-    def _rebuild_pk_index(self, state):
-        if not self._pk_indexed:
-            return state
-        keys = state["cols"][self.primary_keys[0]]
+    def _rebuild_index(self, state, col: str):
+        keys = state["cols"][col]
         sent = _sort_sentinel(keys.dtype)
         # valid rows first then keys ascending: a genuine max-valued key
         # still sorts before the invalid tail, so it remains findable
         order = jnp.lexsort((keys, ~state["valid"])).astype(jnp.int32)
         sk = jnp.where(state["valid"][order], keys[order], sent)
-        return {**state, "pk_order": order, "pk_sorted": sk}
+        svalid = state["valid"][order]
+        dups = ((sk[1:] == sk[:-1]) & svalid[1:] & svalid[:-1]).any()
+        return {
+            **state,
+            f"ix_order.{col}": order,
+            f"ix_sorted.{col}": sk,
+            f"ix_dups.{col}": dups,
+        }
+
+    def _rebuild_pk_index(self, state):
+        for col in self._indexed_cols:
+            state = self._rebuild_index(dict(state), col)
+        return state
 
     def view(self, state):
         """(cols, ts, mask) — probe view, same contract as WindowStage.view."""
@@ -354,7 +388,11 @@ class InMemoryTable:
         rows = batch.valid & (batch.kind == KIND_CURRENT)
         pair = self.match(state, batch.cols, batch.ts, probe_ref, on, now)
         doomed = (pair & rows[:, None]).any(axis=0)
-        return {**state, "valid": state["valid"] & ~doomed}
+        # rebuild indexes: a deleted row that shadowed a same-key duplicate
+        # would otherwise make the sorted probe miss the surviving row
+        return self._rebuild_pk_index(
+            {**state, "valid": state["valid"] & ~doomed}
+        )
 
     def update(
         self,
@@ -380,30 +418,33 @@ class InMemoryTable:
         reproduces InMemoryTable.update's row-at-a-time semantics exactly."""
         rows = batch.valid & (batch.kind == KIND_CURRENT)
         if parallel_ok and pk_probe is not None:
-            return self._update_pk(
-                state, batch, pk_probe, set_fns, probe_ref, now, rows
-            )
+            col, probe_fn, unique = pk_probe
+            if unique:
+                out = self._update_indexed(
+                    state, batch, col, probe_fn, set_fns, probe_ref, now, rows
+                )
+            else:
+                # the sorted probe is exact only while the indexed column is
+                # duplicate-free; tables holding duplicates of the probed key
+                # fall back to the dense all-matches compare
+                def fast(st):
+                    return self._update_indexed(
+                        st, batch, col, probe_fn, set_fns, probe_ref, now,
+                        rows,
+                    )
+
+                def dense(st):
+                    return self._update_dense(
+                        st, batch, on, set_fns, probe_ref, now, rows
+                    )
+
+                out = lax.cond(
+                    state[f"ix_dups.{col}"], dense, fast, state
+                )
+            return self._rebuild_pk_index(out) if reindex_after else out
         if parallel_ok:
-            b = rows.shape[0]
-            c = self.capacity
-            pair = self.match(
-                state, batch.cols, batch.ts, probe_ref, on, now
-            ) & rows[:, None]
-            # keep every [C]-sized intermediate 2D ([C/128, 128]): 1D
-            # reductions/selects of this shape get placed in TPU scalar
-            # space (S(1)) and run ~1000x slower (profiled at C=1M)
-            two_d = c % 128 == 0 and c >= 128
-            if two_d:
-                pair = pair.reshape(b, c // 128, 128)
-            writer = jnp.where(
-                pair,
-                jnp.arange(b, dtype=jnp.int32).reshape(
-                    (b, 1, 1) if two_d else (b, 1)
-                ),
-                -1,
-            ).max(axis=0)  # last matching probe row per slot, -1 if none
-            out = self._apply_winner(
-                state, batch, writer, two_d, set_fns, probe_ref, now
+            out = self._update_dense(
+                state, batch, on, set_fns, probe_ref, now, rows
             )
             return self._rebuild_pk_index(out) if reindex_after else out
 
@@ -431,19 +472,45 @@ class InMemoryTable:
         out = {**state, "cols": new_cols}
         return self._rebuild_pk_index(out) if reindex_after else out
 
-    def _update_pk(self, state, batch, pk_probe, set_fns, probe_ref, now, rows):
-        """O(B log C) primary-key update: sort the key column once per batch
-        and binary-search each probe key instead of the O(B*C) dense compare
-        (reference: IndexEventHolder primary-key HashMap put/get,
-        table/holder/IndexEventHolder.java:59-110). Taken when the condition
-        is exactly `T.pk == <probe expr>` for the table's sole @PrimaryKey —
-        uniqueness makes one candidate row per probe exact."""
-        pk_col, probe_fn = pk_probe
+    def _update_dense(self, state, batch, on, set_fns, probe_ref, now, rows):
+        """Vectorized last-writer-wins update via the dense [B, C] match."""
         b = rows.shape[0]
         c = self.capacity
-        keys = state["cols"][pk_col]
-        order = state["pk_order"]
-        sk = state["pk_sorted"]
+        pair = self.match(
+            state, batch.cols, batch.ts, probe_ref, on, now
+        ) & rows[:, None]
+        # keep every [C]-sized intermediate 2D ([C/128, 128]): 1D
+        # reductions/selects of this shape get placed in TPU scalar
+        # space (S(1)) and run ~1000x slower (profiled at C=1M)
+        two_d = c % 128 == 0 and c >= 128
+        if two_d:
+            pair = pair.reshape(b, c // 128, 128)
+        writer = jnp.where(
+            pair,
+            jnp.arange(b, dtype=jnp.int32).reshape(
+                (b, 1, 1) if two_d else (b, 1)
+            ),
+            -1,
+        ).max(axis=0)  # last matching probe row per slot, -1 if none
+        return self._apply_winner(
+            state, batch, writer, two_d, set_fns, probe_ref, now
+        )
+
+    def _update_indexed(
+        self, state, batch, col, probe_fn, set_fns, probe_ref, now, rows
+    ):
+        """O(B log C + B log B) indexed update: binary-search each probe key
+        in the column's sorted index, dedupe writers with a [B] sort, and
+        scatter the B set-values — everything is [B]-sized except the final
+        column scatters (reference: IndexEventHolder key get/put,
+        table/holder/IndexEventHolder.java:59-110). Exact when the indexed
+        column is currently duplicate-free (PK uniqueness, or the caller's
+        ix_dups cond guard)."""
+        b = rows.shape[0]
+        c = self.capacity
+        keys = state["cols"][col]
+        order = state[f"ix_order.{col}"]
+        sk = state[f"ix_sorted.{col}"]
 
         env_cols = {(probe_ref, None, n): v for n, v in batch.cols.items()}
         env_cols[(probe_ref, None, TS_ATTR)] = batch.ts
@@ -458,28 +525,46 @@ class InMemoryTable:
         cand = order[pos]
         from siddhi_tpu.core.executor import _notnull
 
-        probe_t = getattr(probe_fn, "type", self.schema.attr_types[pk_col])
+        probe_t = getattr(probe_fn, "type", self.schema.attr_types[col])
         hit = (
             rows
             & (keys[cand] == probe_raw)
             & state["valid"][cand]
             & _notnull(probe_raw, probe_t)
         )
-        # last duplicate probe key wins, like the sequential iteration
-        writer_slot = jnp.where(hit, cand, c)
-        winner = (
-            jnp.full((c + 1,), -1, jnp.int32)
-            .at[writer_slot]
-            .max(jnp.arange(b, dtype=jnp.int32))[:c]
+        # last duplicate probe key wins, like the sequential iteration:
+        # group probes by candidate slot (misses sort before hits), the
+        # segment end is the winning probe
+        idx = jnp.arange(b, dtype=jnp.int32)
+        perm = jnp.lexsort((idx, hit.astype(jnp.int32), cand)).astype(
+            jnp.int32
         )
-        two_d = c % 128 == 0 and c >= 128
-        if two_d:  # keep [C] intermediates out of TPU scalar space
-            winner = winner.reshape(c // 128, 128)
-        # the PK value never changes on this path (the match pins it), so
-        # no reindex is needed afterwards
-        return self._apply_winner(
-            state, batch, winner, two_d, set_fns, probe_ref, now
+        sc = cand[perm]
+        seg_end = jnp.concatenate(
+            [sc[1:] != sc[:-1], jnp.ones((1,), jnp.bool_)]
         )
+        win_sorted = hit[perm] & seg_end
+        win = jnp.zeros((b,), jnp.bool_).at[perm].set(win_sorted)
+
+        # per-probe env: probe row beside ITS candidate table row — all [B]
+        env_cols.update(
+            {
+                (self.table_id, None, n): v[cand]
+                for n, v in state["cols"].items()
+            }
+        )
+        env_cols[(self.table_id, None, TS_ATTR)] = state["ts"][cand]
+        env = Env(env_cols, now=now)
+        target = jnp.where(win, cand, c)
+        new_cols = dict(state["cols"])
+        from siddhi_tpu.ops.scatter import set_at
+
+        for name, fn in set_fns:
+            new_cols[name] = set_at(
+                state["cols"][name], target,
+                fn(env).astype(state["cols"][name].dtype),
+            )
+        return {**state, "cols": new_cols}
 
     def _apply_winner(
         self, state, batch, winner, two_d, set_fns, probe_ref, now
@@ -713,21 +798,27 @@ def compile_table_output(
                 )
                 pk_probe = None
                 if par_ok:
-                    p_side = _pk_probe_expr(output_stream.on, table, out_schema)
-                    if p_side is not None:
+                    found = _eq_probe_expr(output_stream.on, table, out_schema)
+                    if found is not None:
+                        col, p_side = found
+                        # planner decision (reference: util/collection
+                        # CollectionExecutors choosing an indexed lookup):
+                        # a single-column equality probe auto-indexes that
+                        # column; @PrimaryKey uniqueness skips the dup guard
+                        unique = table.primary_keys == [col]
                         pk_probe = (
-                            table.primary_keys[0],
-                            compile_expression(p_side, scope),
+                            col, compile_expression(p_side, scope), unique
                         )
-                        table.enable_pk_index()
-                # an update that can rewrite the PK to a value the match does
-                # not pin must rebuild the sorted index afterwards
-                reindex = _pk_written_unpinned(
-                    output_stream.on, output_stream.set_attributes,
-                    table, out_schema,
-                )
-
+                        table.enable_index(col)
                 def op(tstates, out_batch, now, aux, _t=table, _tid=target):
+                    # reindex decided at TRACE time (not compile time): later
+                    # queries may have enabled more indexes by then, and an
+                    # update that can rewrite an indexed column to a value
+                    # the match does not pin must rebuild its sorted index
+                    reindex = _index_written_unpinned(
+                        output_stream.on, output_stream.set_attributes,
+                        _t, out_schema,
+                    )
                     tstates = dict(tstates)
                     tstates[_tid] = _t.update(
                         tstates[_tid], out_batch, on, set_fns, "__out__", now,
@@ -760,12 +851,12 @@ def _conjuncts(e):
         yield e
 
 
-def _pk_probe_expr(on_expr, table: InMemoryTable, out_schema: StreamSchema):
-    """The probe expression when the condition is exactly
-    `T.pk == <probe expr>` over the table's single @PrimaryKey, else None."""
+def _eq_probe_expr(on_expr, table: InMemoryTable, out_schema: StreamSchema):
+    """(column, probe expression) when the condition is exactly
+    `T.col == <probe expr>` over one table column, else None."""
     from siddhi_tpu.query_api.expression import Compare, CompareOp, Variable
 
-    if on_expr is None or len(table.primary_keys) != 1:
+    if on_expr is None:
         return None
     conj = list(_conjuncts(on_expr))
     if len(conj) != 1 or not (
@@ -777,10 +868,10 @@ def _pk_probe_expr(on_expr, table: InMemoryTable, out_schema: StreamSchema):
         if (
             isinstance(t_side, Variable)
             and _reads_table(t_side, table, out_schema)
-            and t_side.attribute == table.primary_keys[0]
+            and t_side.attribute in table.schema.attr_names
             and not _reads_table(p_side, table, out_schema)
         ):
-            return p_side
+            return t_side.attribute, p_side
     return None
 
 
@@ -816,17 +907,16 @@ def _eq_sources(on_expr, table, out_schema):
     return out
 
 
-def _pk_written_unpinned(on_expr, set_attributes, table, out_schema) -> bool:
-    """True when an update's set clause may change the @PrimaryKey column
-    to a value the on-condition does not pin to its current value — the
-    sorted PK index must be rebuilt after such an update."""
-    if len(table.primary_keys) != 1:
-        return False
-    pk = table.primary_keys[0]
+def _index_written_unpinned(on_expr, set_attributes, table, out_schema) -> bool:
+    """True when an update's set clause may change ANY indexed column to a
+    value the on-condition does not pin to its current value — the sorted
+    indexes must be rebuilt after such an update."""
     sm = _set_map(set_attributes, table, out_schema)
-    if pk not in sm:
-        return False
-    return _eq_sources(on_expr, table, out_schema).get(pk) != sm[pk]
+    eq = _eq_sources(on_expr, table, out_schema)
+    return any(
+        col in sm and eq.get(col) != sm[col]
+        for col in table._indexed_cols
+    )
 
 
 def _reads_table(expr, table: InMemoryTable, out_schema: StreamSchema) -> bool:

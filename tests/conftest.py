@@ -22,4 +22,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# persistent compilation cache: pattern/window programs take O(minutes) to
+# compile on CPU; cached across test runs they load in milliseconds
+_cache_dir = os.environ.get(
+    "SIDDHI_TPU_TEST_CACHE", os.path.expanduser("~/.cache/siddhi_tpu_jax")
+)
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+except Exception:
+    pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -210,10 +210,12 @@ class TestLogicalAbsentPatternGolden:
         assert got == [], got
 
     def test_every_logical_absent_rearm_restarts_window(self):
-        # regression: the re-armed generator's absence window must measure
-        # from the re-arm (stale entry_ts made later cycles complete with
-        # the ORIGINAL window). After each B-free window, the next e1
-        # completes; a B arriving inside the CURRENT window kills that cycle.
+        # the re-armed generator's absence window must measure from the
+        # re-arm; a B arriving at the START-of-pattern element does not kill
+        # the cycle — it restarts the wait (reference:
+        # LogicalAbsentPatternTestCase testQueryAbsent10 — a violating
+        # arrival at the initial state re-waits and the pattern still
+        # completes once a clean window elapses)
         ql = S123 + """
         @info(name = 'query1')
         from every (e1=Stream1[price>10] and not Stream2[price>20] for 150 milliseconds)
@@ -224,13 +226,13 @@ class TestLogicalAbsentPatternGolden:
             ("send", "Stream1", ("A1", 15.0, 100)),
             ("sleep", 0.4),          # window B-free -> (A1,) at its deadline
             ("send", "Stream1", ("A2", 16.0, 100)),  # window already elapsed
-            ("send", "Stream2", ("B", 25.0, 100)),   # kills the A2-cycle arm
-            ("send", "Stream1", ("A3", 17.0, 100)),  # its cycle was killed
-            ("sleep", 0.4),
+            ("send", "Stream2", ("B", 25.0, 100)),   # re-arms the A3 cycle
+            ("send", "Stream1", ("A3", 17.0, 100)),  # captured after re-arm
+            ("sleep", 0.4),          # clean window -> A3 completes too
         ], settle=0.3, warm=[
             ("Stream1", ("W", 5.0, 1)), ("Stream2", ("W", 5.0, 1)),
         ])
-        assert got == [("A1",), ("A2",)], got
+        assert got == [("A1",), ("A2",), ("A3",)], got
 
 
 class TestOrAbsentWithWaitingGolden:
