@@ -68,10 +68,23 @@ class ShardedPartitionedQuery:
 
 
 def shard_partitioned_query(
-    qr, mesh, axis: Optional[str] = None
+    qr, mesh, axis: Optional[str] = None, routed: bool = True
 ) -> ShardedPartitionedQuery:
     """Jit a PartitionedQueryRuntime's outer step with its [P] partition axis
-    sharded over `mesh` and its key table / inputs replicated.
+    sharded over `mesh`.
+
+    routed=True (default): the BATCH AXIS is sharded too. A replicated
+    routing pre-pass (key extraction + slot assignment over the small [B]
+    batch) computes each event's owning device (slot // per-device-slots),
+    packs per-device sub-batches [D, B] sharded on the mesh axis, and a
+    shard_map advances each device's LOCAL partition slice against only its
+    own events — each chip decodes B rows, not D*B (the TPU-native analog of
+    the reference's per-key routing, PartitionStreamReceiver.java:81-140).
+    Timer rows are broadcast to every device, interleaved at their original
+    row positions so time-driven operators fire in the unsharded order.
+
+    routed=False replicates the batch to every device (the r3 behavior;
+    correctness baseline).
 
     The partition capacity (@app:partitionCapacity) must be divisible by the
     mesh size so every device holds an equal slice of partition slots.
@@ -98,9 +111,133 @@ def shard_partitioned_query(
         },
         repl,
     )
+    if not routed:
+        fn = jax.jit(
+            qr._pstep_outer_impl,
+            in_shardings=(repl, shard, repl, repl),
+            out_shardings=(repl, shard, shard, repl),
+        )
+        return ShardedPartitionedQuery(qr, mesh, axis, fn, ptable0, state0)
+
     fn = jax.jit(
-        qr._pstep_outer_impl,
+        _make_routed_step(qr, mesh, axis, n_dev),
         in_shardings=(repl, shard, repl, repl),
         out_shardings=(repl, shard, shard, repl),
     )
     return ShardedPartitionedQuery(qr, mesh, axis, fn, ptable0, state0)
+
+
+def _make_routed_step(qr, mesh, axis: str, n_dev: int):
+    """Build the routed sharded step (see shard_partitioned_query)."""
+    from functools import partial
+
+    from jax import lax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from siddhi_tpu.core.event import (
+        EventBatch,
+        KIND_CURRENT,
+        KIND_TIMER,
+    )
+    from siddhi_tpu.core.executor import Env, TS_ATTR
+    from siddhi_tpu.ops.group import assign_slots
+
+    D = n_dev
+    PL = qr.p // D  # local partition slots per device
+
+    def routed_step(ptable, states, batch: EventBatch, now):
+        B = batch.ts.shape[0]
+        cols = {(qr.ref, None, n): c for n, c in batch.cols.items()}
+        cols[(qr.ref, None, TS_ATTR)] = batch.ts
+        env = Env(cols, now=now)
+        keys, matched = qr.key_of(env)
+        active = batch.valid & (batch.kind == KIND_CURRENT) & matched
+        pk, pu, pn, slot, _grp, povf = assign_slots(
+            ptable["keys"], ptable["used"], ptable["n"], keys, active
+        )
+        is_timer = batch.valid & (batch.kind == KIND_TIMER)
+
+        # ---- route the batch axis: device d owns slots [d*PL, (d+1)*PL).
+        # Each device's sub-batch = its own active rows UNION all timer rows,
+        # kept in ORIGINAL row order (a [D, B] mask + per-row cumsum), so
+        # timer-driven operators see timers interleaved exactly as the
+        # unsharded path does. |actives_d ∪ timers| <= B always, so the
+        # sub-batch capacity B can never overflow.
+        idx = jnp.arange(B, dtype=jnp.int32)
+        dev_of = jnp.where(active & (slot < qr.p), slot // PL, D)
+        take = (dev_of[None, :] == jnp.arange(D)[:, None]) | is_timer[None, :]
+        rank = jnp.cumsum(take.astype(jnp.int32), axis=1) - 1  # [D, B]
+        dst = jnp.where(take, jnp.arange(D)[:, None] * B + rank, D * B)
+        routed = (
+            jnp.full((D * B,), B, jnp.int32)
+            .at[dst.reshape(-1)]
+            .set(jnp.broadcast_to(idx[None, :], (D, B)).reshape(-1),
+                 mode="drop")
+            .reshape(D, B)
+        )
+        pad = routed >= B
+        ri = jnp.clip(routed, 0, B - 1)
+
+        def lane(x, fill=0):
+            return jnp.where(pad, np.asarray(fill, x.dtype), x[ri])
+
+        r_ts = lane(batch.ts)
+        r_kind = lane(batch.kind)
+        r_valid = ~pad
+        r_cols = {n: lane(c) for n, c in batch.cols.items()}
+        r_slot = lane(jnp.where(active, slot, qr.p), fill=qr.p)
+
+        # ---- per-device local advance over its own sub-batch
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(),
+            ),
+            out_specs=(P(axis), P(axis), P()),
+            check_rep=False,
+        )
+        def local(states_sl, ts_sl, kind_sl, valid_sl, cols_sl, slot_sl, now_):
+            d = lax.axis_index(axis)
+            ts1 = ts_sl[0]
+            kind1 = kind_sl[0]
+            valid1 = valid_sl[0]
+            cols1 = {n: c[0] for n, c in cols_sl.items()}
+            slot1 = slot_sl[0]
+            is_t = valid1 & (kind1 == KIND_TIMER)
+
+            def one(state, p_local):
+                gp = d * PL + p_local
+                v = (valid1 & (slot1 == gp)) | is_t
+                b2 = EventBatch(ts1, kind1, v, cols1)
+                st, _ts, out, aux = qr._step_impl(state, {}, b2, now_)
+                return st, out, aux
+
+            states2, outs, auxs = jax.vmap(one)(
+                states_sl, jnp.arange(PL)
+            )
+            aux_red = {
+                k: lax.psum(
+                    jnp.asarray(v).astype(jnp.int32).sum(), axis
+                )
+                > 0
+                for k, v in auxs.items()
+                if k != "next_timer"
+            }
+            if "next_timer" in auxs:
+                aux_red["next_timer"] = lax.pmin(
+                    jnp.min(auxs["next_timer"]), axis
+                )
+            return states2, outs, aux_red
+
+        states2, outs, aux = local(
+            states, r_ts, r_kind, r_valid, r_cols, r_slot, now
+        )
+        aux = dict(aux)
+        aux["partition_overflow"] = (
+            jnp.asarray(aux.get("partition_overflow", False)) | povf
+        )
+        return {"keys": pk, "used": pu, "n": pn}, states2, outs, aux
+
+    return routed_step
