@@ -251,12 +251,31 @@ class SiddhiAppRuntime:
         from siddhi_tpu.core.partition import PartitionRuntime
 
         self.partitions: list[PartitionRuntime] = []
+        # auto-ids must not collide with explicit @info names elsewhere in
+        # the app (e.g. two unnamed queries before one named 'query1'),
+        # including names on queries INSIDE partitions
+        taken = set()
+        for elem in app.execution_elements:
+            inner = (
+                [elem]
+                if isinstance(elem, Query)
+                else list(getattr(elem, "queries", []) or [])
+            )
+            for q in inner:
+                info = find_annotation(q.annotations, "info")
+                name = info.element("name") if info else None
+                if name:
+                    taken.add(name)
         unnamed = 0
         for elem in app.execution_elements:
             if isinstance(elem, Query):
                 info = find_annotation(elem.annotations, "info")
-                qid = (info.element("name") if info else None) or f"query{unnamed}"
-                unnamed += 1
+                qid = info.element("name") if info else None
+                if not qid:
+                    while f"query{unnamed}" in taken:
+                        unnamed += 1
+                    qid = f"query{unnamed}"
+                    unnamed += 1
                 self._add_query(qid, elem)
             elif isinstance(elem, Partition):
                 self.partitions.append(

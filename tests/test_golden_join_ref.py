@@ -1,0 +1,202 @@
+"""Golden corpus: reference query/join/JoinTestCase.java (data-level
+translation; wall-clock sleeps become @app:playback timestamps). Tests with
+no count assertions in the reference (5-9, 13-17: parse/validation smokes)
+are not translated; OuterJoinTestCase 1-2 live in test_golden_windows_ref.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+D2 = """@app:playback @app:batch(size='8')
+define stream cseEventStream (symbol string, price float, volume int);
+define stream twitterStream (user string, tweet string, company string);
+"""
+
+
+def run_pb(ql, steps, query_name="query1"):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    ins, rem = [], []
+    rt.add_callback(
+        query_name,
+        lambda ts, i, r: (
+            ins.extend(tuple(e.data) for e in i or []),
+            rem.extend(tuple(e.data) for e in r or []),
+        ),
+    )
+    rt.start()
+    hs = {}
+    for ts, stream, row in steps:
+        hs.setdefault(stream, rt.get_input_handler(stream)).send(
+            row, timestamp=ts
+        )
+    rt.shutdown()
+    mgr.shutdown()
+    return ins, rem
+
+
+class TestJoinGolden:
+    def test1_time_window_join_all_events(self):
+        ql = D2 + """@info(name = 'query1')
+        from cseEventStream#window.time(1 sec) join twitterStream#window.time(1 sec)
+        on cseEventStream.symbol== twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert all events into outputStream ;"""
+        ins, rem = run_pb(ql, [
+            (0, "cseEventStream", ("WSO2", 55.6, 100)),
+            (10, "twitterStream", ("User1", "Hello World", "WSO2")),
+            (20, "cseEventStream", ("IBM", 75.6, 100)),
+            (520, "cseEventStream", ("WSO2", 57.6, 100)),
+            (2000, "cseEventStream", ("ZZZ", 1.0, 0)),  # clock advance
+        ])
+        assert len(ins) == 2, ins
+        assert len(rem) == 2, rem
+        assert ins[0][:2] == ("WSO2", "Hello World") and abs(ins[0][2] - 55.6) < 1e-3, ins
+
+    def test2_aliased_time_window_join(self):
+        ql = D2 + """@info(name = 'query1')
+        from cseEventStream#window.time(1 sec) as a join twitterStream#window.time(1 sec) as b
+        on a.symbol== b.company
+        select a.symbol as symbol, b.tweet, a.price
+        insert all events into outputStream ;"""
+        ins, rem = run_pb(ql, [
+            (0, "cseEventStream", ("WSO2", 55.6, 100)),
+            (10, "twitterStream", ("User1", "Hello World", "WSO2")),
+            (20, "cseEventStream", ("IBM", 75.6, 100)),
+            (520, "cseEventStream", ("WSO2", 57.6, 100)),
+            (2000, "cseEventStream", ("ZZZ", 1.0, 0)),
+        ])
+        assert len(ins) == 2 and len(rem) == 2, (ins, rem)
+
+    def test3_self_join(self):
+        ql = """@app:playback @app:batch(size='8')
+        define stream cseEventStream (symbol string, price float, volume int);
+        @info(name = 'query1')
+        from cseEventStream#window.time(500 milliseconds) as a
+        join cseEventStream#window.time(500 milliseconds) as b
+        on a.symbol== b.symbol
+        select a.symbol as symbol, a.price as priceA, b.price as priceB
+        insert all events into outputStream ;"""
+        ins, rem = run_pb(ql, [
+            (0, "cseEventStream", ("IBM", 75.6, 100)),
+            (10, "cseEventStream", ("WSO2", 57.6, 100)),
+            (2000, "cseEventStream", ("ZZZ", 1.0, 0)),
+        ])
+        # each event self-joins once (the trailing clock-advance row also
+        # self-joins; exclude it)
+        real = [r for r in ins if r[0] != "ZZZ"]
+        assert len(real) == 2, ins
+        syms = sorted((s, round(a, 2), round(b, 2)) for s, a, b in real)
+        assert syms == [("IBM", 75.6, 75.6), ("WSO2", 57.6, 57.6)], ins
+
+    def test4_longer_window_join(self):
+        ql = D2 + """@info(name = 'query1')
+        from cseEventStream#window.time(2 sec) join twitterStream#window.time(2 sec)
+        on cseEventStream.symbol== twitterStream.company
+        select cseEventStream.symbol as symbol, twitterStream.tweet, cseEventStream.price
+        insert all events into outputStream ;"""
+        ins, rem = run_pb(ql, [
+            (0, "cseEventStream", ("WSO2", 55.6, 100)),
+            (10, "twitterStream", ("User1", "Hello World", "WSO2")),
+            (20, "cseEventStream", ("IBM", 75.6, 100)),
+            (1020, "cseEventStream", ("WSO2", 57.6, 100)),
+            (4000, "cseEventStream", ("ZZZ", 1.0, 0)),
+        ])
+        assert len(ins) == 2 and len(rem) == 2, (ins, rem)
+
+    def test10_windowless_side_joins_length1(self):
+        ql = D2 + """@info(name = 'query1')
+        from cseEventStream join twitterStream#window.length(1)
+        select count() as events, symbol
+        insert into outputStream ;"""
+        ins, rem = run_pb(ql, [
+            (0, "cseEventStream", ("WSO2", 55.6, 100)),
+            (10, "twitterStream", ("User1", "Hello World", "WSO2")),
+            (20, "cseEventStream", ("IBM", 75.6, 100)),
+            (30, "cseEventStream", ("WSO2", 57.6, 100)),
+        ])
+        assert len(ins) == 2, ins
+        assert len(rem) == 0, rem
+
+    def test11_unidirectional_join(self):
+        ql = D2 + """@info(name = 'query1')
+        from cseEventStream unidirectional join twitterStream#window.length(1)
+        select count() as events, symbol, tweet
+        insert all events into outputStream ;"""
+        ins, rem = run_pb(ql, [
+            (0, "cseEventStream", ("WSO2", 55.6, 100)),
+            (10, "twitterStream", ("User1", "Hello World", "WSO2")),
+            (20, "cseEventStream", ("IBM", 75.6, 100)),
+            (30, "cseEventStream", ("WSO2", 57.6, 100)),
+        ])
+        assert len(ins) == 2, ins
+
+    def test12_select_star_join(self):
+        ql = D2 + """@info(name = 'query1')
+        from cseEventStream#window.time(1 sec) join twitterStream#window.time(1 sec)
+        on cseEventStream.symbol== twitterStream.company
+        select *
+        insert into outputStream ;"""
+        ins, rem = run_pb(ql, [
+            (0, "cseEventStream", ("WSO2", 55.6, 100)),
+            (10, "twitterStream", ("User1", "Hello World", "WSO2")),
+        ])
+        assert len(ins) == 1, ins
+        assert len(rem) == 0, rem
+
+    @pytest.mark.xfail(
+        reason="deviation: the reference aggregates a windowless table join "
+        "per TRIGGER chunk (count()==matched rows, reset each trigger); this "
+        "engine keeps the running aggregate across triggers (1..N). "
+        "Recorded in PARITY.md.", strict=True)
+    def test19_stream_table_join_count(self):
+        ql = """@app:playback @app:batch(size='8')
+        define stream dataIn (id int, data string);
+        define stream countIn (id int);
+        define stream deleteIn (id int);
+        define table dataTable (id int, data string);
+        from dataIn insert into dataTable;
+        from deleteIn delete dataTable on dataTable.id == id;
+        @info(name = 'query1')
+        from countIn as c join dataTable as d
+        select count() as count
+        insert into countOut;"""
+        ins, rem = run_pb(ql, [
+            (0, "dataIn", (1, "item1")),
+            (10, "dataIn", (2, "item2")),
+            (20, "dataIn", (3, "item3")),
+            (30, "countIn", (1,)),
+            (40, "deleteIn", (1,)),
+            (50, "countIn", (1,)),
+        ])
+        # first count sees 3 rows, second (after delete) sees 2
+        assert [r[0] for r in ins] == [3, 2], ins
+
+    @pytest.mark.xfail(
+        reason="same per-trigger-chunk aggregation deviation as test19",
+        strict=True)
+    def test20_left_outer_table_join_count(self):
+        ql = """@app:playback @app:batch(size='8')
+        define stream dataIn (id int, data string);
+        define stream countIn (id int);
+        define stream deleteIn (id int);
+        define table dataTable (id int, data string);
+        from dataIn insert into dataTable;
+        from deleteIn delete dataTable on dataTable.id == id;
+        @info(name = 'query1')
+        from countIn as c left outer join dataTable as d
+        on d.data == 'abc'
+        select count() as count
+        insert into countOut;"""
+        ins, rem = run_pb(ql, [
+            (0, "dataIn", (1, "abc")),
+            (10, "dataIn", (2, "abc")),
+            (20, "dataIn", (3, "abc")),
+            (30, "countIn", (1,)),
+            (40, "deleteIn", (1,)),
+            (50, "countIn", (1,)),
+        ])
+        assert [r[0] for r in ins] == [3, 2], ins
