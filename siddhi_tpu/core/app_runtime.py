@@ -448,6 +448,7 @@ class SiddhiAppRuntime:
 
         token_capacity = self._capacity_annotation("app:patternCapacity", 128)
         count_capacity = self._capacity_annotation("app:countCapacity", 8)
+        pattern_chunk = self._capacity_annotation("app:patternChunk", 0)
         qr = PatternQueryRuntime(
             query,
             qid,
@@ -458,6 +459,7 @@ class SiddhiAppRuntime:
             count_capacity=count_capacity,
             batch_size=self.batch_size,
             tables=self.tables,
+            pattern_chunk=pattern_chunk or None,
         )
         self.queries[qid] = qr
         self._wire_insert(qr)

@@ -56,6 +56,7 @@ import dataclasses
 from typing import Optional
 
 import jax
+import jax.lax as lax
 import jax.numpy as jnp
 import numpy as np
 
@@ -319,16 +320,79 @@ class PatternProgram:
         self.needs_scheduler = any(
             a.waiting_ms is not None for a in self.refs
         )
+        # keys read from the EMISSION buffer (selector/having/order-by) —
+        # set by the owning runtime from the selector's child scope; None
+        # means unknown, which disables projection (keep everything).
+        # capture_keep() combines these with indexed keys and cross-ref
+        # condition reads to project the token capture lanes (TPU gathers
+        # and scatters run near one element per scalar-core cycle, so every
+        # unread [T, cap] lane is pure wall-clock)
+        self._capture_readers: Optional[frozenset] = None
+        self._keep_cache = None
         # sequences with count slots carry an explicit per-token forwarding
         # lane (reference: SEQUENCE addState accepts ONE new state per event,
         # so next-slot pending membership is a contended, per-event win —
         # SequenceTestCase testQuery6/11). Patterns keep implicit count-skip.
         self._use_fwd = self.sequence and any(s.is_count for s in self.slots)
 
+    # ---- capture projection ---------------------------------------------
+
+    def capture_keep(self):
+        """Per-ref projection of the capture lanes: (keep_cols, ts_used).
+
+        keep_cols[ref_idx] — attribute names whose captured values some
+        compiled expression actually reads; every other attribute lane is
+        never materialized in the token table or the emission buffer.
+        ts_used[ref_idx] — whether the ref's captured-timestamp lane is read
+        (selector/conditions) or structurally required (absent deadlines,
+        next_timer reads caps ts[:, 0]).
+
+        A key counts as a CAPTURE read when it is indexed (e1[2].price /
+        e1[last]), recorded after pattern construction (selector / having /
+        order-by resolve against the emission buffer), or recorded by a
+        condition of a DIFFERENT ref (cross-ref reads see the partner's
+        captures); an atom's own un-indexed keys read the live event, which
+        the env builders substitute directly. Reference analog: every
+        StateEvent carries all captured StreamEvents
+        (event/state/StateEvent.java) — here only the read subset exists.
+        """
+        if self._keep_cache is not None:
+            return self._keep_cache
+        used = set(self.scope.root_used_keys())
+        by_ref = {a.ref: a for a in self.refs}
+        if self._capture_readers is None:
+            needed = used  # owner never told us — keep everything
+        else:
+            cross = set()
+            for (_slot_idx, ref_idx), keys in self._cond_keys.items():
+                me = self.refs[ref_idx].ref
+                cross |= {k for k in keys if k[0] != me}
+            needed = (
+                {k for k in used if k[1] is not None}
+                | set(self._capture_readers)
+                | cross
+            )
+        keep_cols = {a.ref_idx: set() for a in self.refs}
+        ts_used = {
+            a.ref_idx: bool(a.absent and a.waiting_ms is not None)
+            for a in self.refs
+        }
+        for ref, _k, attr in needed:
+            a = by_ref.get(ref)
+            if a is None:
+                continue
+            if attr == TS_ATTR:
+                ts_used[a.ref_idx] = True
+            elif attr in self.schemas[a.stream_id].attr_types:
+                keep_cols[a.ref_idx].add(attr)
+        self._keep_cache = (keep_cols, ts_used)
+        return self._keep_cache
+
     # ---- token table ----------------------------------------------------
 
     def init_state(self, now: int = 0):
         T = self.T
+        keep_cols, _ts_used = self.capture_keep()
         caps = []
         for a in self.refs:
             schema = self.schemas[a.stream_id]
@@ -337,6 +401,7 @@ class PatternProgram:
                     (T, a.cap), null_value(t), dtype=PHYSICAL_DTYPE[t]
                 )
                 for name, t in schema.attrs
+                if name in keep_cols[a.ref_idx]
             }
             caps.append(
                 {
@@ -1305,6 +1370,7 @@ class PatternProgram:
         S = len(self.slots)
         slot0, slot1 = self.slots[0], self.slots[1]
         atom0, atom1 = slot0.atoms[0], slot1.atoms[0]
+        _keep_cols, _ts_used = self.capture_keep()
         K = atom0.cap
         m = slot0.min_count
         # occurrence COUNTING runs to the true max (unbounded -> huge), while
@@ -1349,20 +1415,27 @@ class PatternProgram:
         # m - n0, since room = M - n0 with M >= m never blocks reaching m
         # (midx_excl: the reference forwards at min via newAndEvery, pending
         # only from the NEXT event, and checks the next state first — so the
-        # row that reaches min is itself not advance-eligible). ONE dense
-        # [T, B] pred compare on purpose: count arithmetic in [T, B] s32
-        # materialized ~20 int matrices (HLO-verified, ~1.5 GB/chunk), and a
-        # searchsorted form serializes into scalar-space gathers — this
-        # threshold compare fuses to a couple of pred buffers.
+        # row that reaches min is itself not advance-eligible).
+        # midx_excl is NON-DECREASING, so "first b with Madv[b] and
+        # midx_excl[b] >= v" factors into two [B]/[T] primitives: a suffix-min
+        # scan (madv_next[b] = first advance row at or after b) and a
+        # searchsorted for the threshold crossing. This replaces the r4 dense
+        # [T, B] pred compare + argmax, whose HLO materialized ~750 MB of
+        # [T, B] s32/u32/pred per chunk (the whole kernel's wall — 7.7 ms vs
+        # ~0.6 ms of everything else). method='sort' keeps searchsorted
+        # vectorized (one bitonic sort of T+B keys); the default 'scan'
+        # serializes into scalar-space gathers.
         room = (M - jnp.clip(n0, 0, M)).astype(jnp.int32)
         thresh = (m - jnp.clip(n0, 0, m)).astype(midx_excl.dtype)
-        adv_ok = (
-            at0[:, None]
-            & Madv[None, :]
-            & (midx_excl[None, :] >= thresh[:, None])
+        madv_next = lax.cummin(
+            jnp.where(Madv, rows, B).astype(jnp.int32), reverse=True
         )
-        has_adv = adv_ok.any(axis=1)
-        j = jnp.argmax(adv_ok, axis=1).astype(jnp.int32)
+        b0_t = jnp.searchsorted(
+            midx_excl, thresh, side="left", method="sort"
+        ).astype(jnp.int32)
+        jt = jnp.where(b0_t < B, madv_next[jnp.clip(b0_t, 0, B - 1)], B)
+        has_adv = at0 & (jt < B)
+        j = jt.astype(jnp.int32)
         jc = jnp.clip(j, 0, B - 1)
 
         # absorption span: stops at the advance row (reference:
@@ -1378,7 +1451,8 @@ class PatternProgram:
         srcc = jnp.clip(src, 0, B - 1)
         cr = dict(caps[atom0.ref_idx])
         cr["n"] = jnp.where(at0, n0 + A, n0).astype(cr["n"].dtype)
-        cr["ts"] = jnp.where(wmask, mts[srcc], cr["ts"])
+        if _ts_used[atom0.ref_idx]:
+            cr["ts"] = jnp.where(wmask, mts[srcc], cr["ts"])
         if ev0 is not None:
             cr["cols"] = {
                 name: jnp.where(wmask, ev0[name][mrow_c].astype(arr.dtype)[srcc], arr)
@@ -1395,9 +1469,10 @@ class PatternProgram:
             c1 = dict(caps[atom1.ref_idx])
             c1["n"] = jnp.where(advD, 1, c1["n"]).astype(c1["n"].dtype)
             # column-0 writes via static slice update, not arange scatter
-            c1["ts"] = c1["ts"].at[:, 0].set(
-                jnp.where(advD, batch_ts[jc], c1["ts"][:, 0])
-            )
+            if _ts_used[atom1.ref_idx]:
+                c1["ts"] = c1["ts"].at[:, 0].set(
+                    jnp.where(advD, batch_ts[jc], c1["ts"][:, 0])
+                )
             c1["cols"] = {
                 name: arr.at[:, 0].set(
                     jnp.where(advD, ev1[name][jc].astype(arr.dtype), arr[:, 0])
@@ -1419,22 +1494,33 @@ class PatternProgram:
             tail = at0 & (n0 < m)
             tail_exists = tail.any()
             ny = jnp.min(jnp.where(tail, n0, m)).astype(jnp.int32)
-            Gmax = B // max(m, 1) + 1
+            # generations beyond the token-lane count T can never be armed
+            # (they overflow either way), so the generation axis is capped at
+            # T — [G]-shaped gathers/scatters cost ~1 element/cycle on the
+            # TPU scalar core, and modeling unarmable generations is pure
+            # waste; the cap's dropped generations raise the same overflow
+            # flag lane exhaustion would have
+            Gmax = min(B // max(m, 1) + 1, T)
             g = jnp.arange(Gmax, dtype=jnp.int32)
             s_g = (m - ny) + g * m
             valid_g = tail_exists & (s_g <= k_total)
-            # generation g advances at the first row b with Madv[b] and
-            # midx_excl[b] >= s_g + m (room never blocks, see adv_ok above).
-            # ONE [G, B] pred compare — count arithmetic in s32 matrices and
-            # a searchsorted loop both measured slower (the former
-            # materializes ~GBs, the latter serializes in scalar space).
-            advg_ok = (
-                valid_g[:, None]
-                & Madv[None, :]
-                & (midx_excl[None, :] >= (s_g + m)[:, None])
+            overflow = overflow | (
+                tail_exists & ((m - ny) + Gmax * m <= k_total)
             )
-            has_advg = advg_ok.any(axis=1)
-            jg = jnp.argmax(advg_ok, axis=1).astype(jnp.int32)
+            # generation g advances at the first row b with Madv[b] and
+            # midx_excl[b] >= s_g + m (room never blocks, see above). Same
+            # suffix-min + sorted-searchsorted factoring as the per-token
+            # advance: s_g is increasing and midx_excl non-decreasing, so
+            # this is a sorted-sorted merge — no [G, B] matrix.
+            b0_g = jnp.searchsorted(
+                midx_excl, (s_g + m).astype(midx_excl.dtype),
+                side="left", method="sort",
+            ).astype(jnp.int32)
+            jg_row = jnp.where(
+                b0_g < B, madv_next[jnp.clip(b0_g, 0, B - 1)], B
+            )
+            has_advg = valid_g & (jg_row < B)
+            jg = jg_row.astype(jnp.int32)
             jgc = jnp.clip(jg, 0, B - 1)
             Ag = jnp.clip(
                 jnp.where(has_advg, midx_excl[jgc], k_total) - s_g, 0, M
@@ -1456,9 +1542,10 @@ class PatternProgram:
             caps = [dict(c) for c in tok["caps"]]
             cr = dict(caps[atom0.ref_idx])
             cr["n"] = cr["n"].at[dst].set(Ag, mode="drop")
-            cr["ts"] = _set_at(
-                cr["ts"], dst, jnp.where(wm_g, mts[src_gc], np.int64(0))
-            )
+            if _ts_used[atom0.ref_idx]:
+                cr["ts"] = _set_at(
+                    cr["ts"], dst, jnp.where(wm_g, mts[src_gc], np.int64(0))
+                )
             if ev0 is not None:
                 new_cols = {}
                 for name, arr in cr["cols"].items():
@@ -1473,12 +1560,13 @@ class PatternProgram:
                 c1["n"] = c1["n"].at[dst].set(
                     has_advg.astype(c1["n"].dtype), mode="drop"
                 )
-                c1["ts"] = c1["ts"].at[:, 0].set(
-                    _set_at(
-                        c1["ts"][:, 0], dst,
-                        jnp.where(has_advg, batch_ts[jgc], np.int64(0)),
+                if _ts_used[atom1.ref_idx]:
+                    c1["ts"] = c1["ts"].at[:, 0].set(
+                        _set_at(
+                            c1["ts"][:, 0], dst,
+                            jnp.where(has_advg, batch_ts[jgc], np.int64(0)),
+                        )
                     )
-                )
                 new_cols = {}
                 for name, arr in c1["cols"].items():
                     t = self.schemas[atom1.stream_id].attr_types[name]
@@ -1496,9 +1584,11 @@ class PatternProgram:
                     continue
                 c = dict(caps[ridx])
                 c["n"] = c["n"].at[dst].set(0, mode="drop")
-                c["ts"] = _set_at(
-                    c["ts"], dst, jnp.zeros(dst.shape + c["ts"].shape[1:], c["ts"].dtype)
-                )
+                if _ts_used[ridx]:
+                    c["ts"] = _set_at(
+                        c["ts"], dst,
+                        jnp.zeros(dst.shape + c["ts"].shape[1:], c["ts"].dtype),
+                    )
                 c["cols"] = {
                     name: _set_at(
                         arr, dst,
@@ -1549,9 +1639,10 @@ class PatternProgram:
             caps = [dict(c) for c in tok["caps"]]
             crp = dict(caps[atom.ref_idx])
             crp["n"] = jnp.where(has, 1, crp["n"]).astype(crp["n"].dtype)
-            crp["ts"] = crp["ts"].at[:, 0].set(
-                jnp.where(has, batch_ts[jjc], crp["ts"][:, 0])
-            )
+            if _ts_used[atom.ref_idx]:
+                crp["ts"] = crp["ts"].at[:, 0].set(
+                    jnp.where(has, batch_ts[jjc], crp["ts"][:, 0])
+                )
             crp["cols"] = {
                 name: arr.at[:, 0].set(
                     jnp.where(has, ev[name][jjc].astype(arr.dtype), arr[:, 0])
@@ -1593,9 +1684,10 @@ class PatternProgram:
             out[f"n{a.ref_idx}"] = out[f"n{a.ref_idx}"].at[dest].set(
                 c["n"][src_t], mode="drop"
             )
-            out[f"ts{a.ref_idx}"] = _set_at(
-                out[f"ts{a.ref_idx}"], dest, c["ts"][src_t]
-            )
+            if f"ts{a.ref_idx}" in out:
+                out[f"ts{a.ref_idx}"] = _set_at(
+                    out[f"ts{a.ref_idx}"], dest, c["ts"][src_t]
+                )
             for name in c["cols"]:
                 out[f"c{a.ref_idx}.{name}"] = _set_at(
                     out[f"c{a.ref_idx}.{name}"], dest, c["cols"][name][src_t]
@@ -1642,6 +1734,7 @@ class PatternProgram:
         T = self.T
         B = batch_ts.shape[0]
         S = len(self.slots)
+        _keep_cols, _ts_used = self.capture_keep()
         rows = jnp.arange(B, dtype=jnp.int32)
         toks = jnp.arange(T, dtype=jnp.int32)
         v = batch_valid & (batch_kind == KIND_CURRENT)
@@ -1695,9 +1788,10 @@ class PatternProgram:
                 caps = [dict(c) for c in tok["caps"]]
                 cr = dict(caps[atom.ref_idx])
                 cr["n"] = cr["n"].at[dstc].set(1, mode="drop")
-                cr["ts"] = cr["ts"].at[:, 0].set(
-                    _set_at(cr["ts"][:, 0], dstc, batch_ts)
-                )
+                if _ts_used[atom.ref_idx]:
+                    cr["ts"] = cr["ts"].at[:, 0].set(
+                        _set_at(cr["ts"][:, 0], dstc, batch_ts)
+                    )
                 cr["cols"] = {
                     name: arr.at[:, 0].set(
                         _set_at(arr[:, 0], dstc, ev[name].astype(arr.dtype))
@@ -1719,9 +1813,10 @@ class PatternProgram:
                 cr = dict(caps[atom.ref_idx])
                 cr["n"] = jnp.where(adv, 1, cr["n"])
                 # column-0 writes via static slice update, not arange scatter
-                cr["ts"] = cr["ts"].at[:, 0].set(
-                    jnp.where(adv, mts, cr["ts"][:, 0])
-                )
+                if _ts_used[atom.ref_idx]:
+                    cr["ts"] = cr["ts"].at[:, 0].set(
+                        jnp.where(adv, mts, cr["ts"][:, 0])
+                    )
                 cr["cols"] = {
                     name: arr.at[:, 0].set(
                         jnp.where(adv, ev[name][jc].astype(arr.dtype), arr[:, 0])
@@ -1760,7 +1855,8 @@ class PatternProgram:
         for a in self.refs:
             c = tok["caps"][a.ref_idx]
             out[f"n{a.ref_idx}"] = out[f"n{a.ref_idx}"].at[dest].set(c["n"][src], mode="drop")
-            out[f"ts{a.ref_idx}"] = _set_at(out[f"ts{a.ref_idx}"], dest, c["ts"][src])
+            if f"ts{a.ref_idx}" in out:
+                out[f"ts{a.ref_idx}"] = _set_at(out[f"ts{a.ref_idx}"], dest, c["ts"][src])
             for name in c["cols"]:
                 out[f"c{a.ref_idx}.{name}"] = _set_at(
                     out[f"c{a.ref_idx}.{name}"], dest, c["cols"][name][src]
@@ -1790,6 +1886,7 @@ class PatternProgram:
         return tok, out, out_n, overflow
 
     def init_out(self, cap: int):
+        keep_cols, ts_used = self.capture_keep()
         out = {
             "ts": jnp.zeros((cap,), dtype=jnp.int64),
             "valid": jnp.zeros((cap,), dtype=jnp.bool_),
@@ -1797,11 +1894,15 @@ class PatternProgram:
         for a in self.refs:
             schema = self.schemas[a.stream_id]
             out[f"n{a.ref_idx}"] = jnp.zeros((cap,), dtype=jnp.int32)
-            out[f"ts{a.ref_idx}"] = jnp.zeros((cap, a.cap), dtype=jnp.int64)
-            for name, t in schema.attrs:
-                out[f"c{a.ref_idx}.{name}"] = jnp.full(
-                    (cap, a.cap), null_value(t), dtype=PHYSICAL_DTYPE[t]
+            if ts_used[a.ref_idx]:
+                out[f"ts{a.ref_idx}"] = jnp.zeros(
+                    (cap, a.cap), dtype=jnp.int64
                 )
+            for name, t in schema.attrs:
+                if name in keep_cols[a.ref_idx]:
+                    out[f"c{a.ref_idx}.{name}"] = jnp.full(
+                        (cap, a.cap), null_value(t), dtype=PHYSICAL_DTYPE[t]
+                    )
         return out
 
     def _write_emits(self, out, out_n, overflow, emit, tok, ts):
@@ -1817,7 +1918,8 @@ class PatternProgram:
         for a in self.refs:
             c = tok["caps"][a.ref_idx]
             out[f"n{a.ref_idx}"] = out[f"n{a.ref_idx}"].at[dest].set(c["n"], mode="drop")
-            out[f"ts{a.ref_idx}"] = out[f"ts{a.ref_idx}"].at[dest].set(c["ts"], mode="drop")
+            if f"ts{a.ref_idx}" in out:
+                out[f"ts{a.ref_idx}"] = out[f"ts{a.ref_idx}"].at[dest].set(c["ts"], mode="drop")
             for name in c["cols"]:
                 key = f"c{a.ref_idx}.{name}"
                 out[key] = out[key].at[dest].set(c["cols"][name], mode="drop")
@@ -1828,18 +1930,23 @@ class PatternProgram:
         )
 
     def out_env_cols(self, out) -> dict:
-        """VarKeys for the selector over the emission buffer."""
+        """VarKeys for the selector over the emission buffer (projected: only
+        lanes capture_keep() retained exist — every key the selector resolves
+        is in the kept set by construction)."""
         cols = {}
         for a in self.refs:
             for name in self.schemas[a.stream_id].attr_names:
-                arr = out[f"c{a.ref_idx}.{name}"]
+                arr = out.get(f"c{a.ref_idx}.{name}")
+                if arr is None:
+                    continue
                 cols[(a.ref, None, name)] = arr[:, 0]
                 for k in range(a.cap):
                     cols[(a.ref, k, name)] = arr[:, k]
-            tsr = out[f"ts{a.ref_idx}"]
-            cols[(a.ref, None, TS_ATTR)] = tsr[:, 0]
-            for k in range(a.cap):
-                cols[(a.ref, k, TS_ATTR)] = tsr[:, k]
+            tsr = out.get(f"ts{a.ref_idx}")
+            if tsr is not None:
+                cols[(a.ref, None, TS_ATTR)] = tsr[:, 0]
+                for k in range(a.cap):
+                    cols[(a.ref, k, TS_ATTR)] = tsr[:, k]
             cols[(a.ref, None, "__arrived__")] = out[f"n{a.ref_idx}"] > 0
         self._synth_capture_cols(
             cols,

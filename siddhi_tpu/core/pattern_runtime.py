@@ -27,6 +27,10 @@ from siddhi_tpu.core.types import InternTable
 from siddhi_tpu.query_api.execution import Query, StateInputStream
 
 
+# tuning hook (tools/exp_count.py): overrides the count-kernel chunk size
+COUNT_CHUNK_OVERRIDE: Optional[int] = None
+
+
 class PatternQueryRuntime(BaseQueryRuntime):
     def __init__(
         self,
@@ -39,7 +43,9 @@ class PatternQueryRuntime(BaseQueryRuntime):
         count_capacity: int = 8,
         batch_size: int = 64,
         tables: Optional[dict] = None,
+        pattern_chunk: Optional[int] = None,
     ):
+        self._pattern_chunk = pattern_chunk
         self.query = query
         self.query_id = query_id
         state_stream = query.input_stream
@@ -77,13 +83,19 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 f"select * over this pattern is ambiguous for {sorted(dup)}; "
                 "project explicitly"
             )
+        # the selector resolves against a CHILD scope so its key set is known
+        # exactly — those keys (plus cross-ref condition reads) are the only
+        # capture lanes the token table / emission buffer materialize
+        # (PatternProgram.capture_keep)
+        sel_scope = self.prog.scope.child()
         self.selector = CompiledSelector(
             query.selector,
-            self.prog.scope,
+            sel_scope,
             flat_attrs,
             batch_mode=False,
             group_capacity=group_capacity,
         )
+        self.prog._capture_readers = frozenset(sel_scope.used_keys)
         self._setup_output(query, query_id)
         self._attach_tables(tables, interner)
         self._scope = self.prog.scope
@@ -120,10 +132,20 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 # fork demand can always be met by lanes freed previously
                 kernel, chunk = prog.apply_batch_fast, max(1, prog.T // 2)
             elif prog.count_fast_ok:
-                # generation-arming demand per chunk is ~matches/min; a full
-                # token-table chunk keeps that bounded while amortizing the
-                # per-chunk fixed cost over many rows
-                kernel, chunk = prog.apply_batch_count, max(1, prog.T)
+                # chunk = T*min_count keeps the no-spurious-overflow bound
+                # (arming demand per chunk <= chunk/min <= T lanes) while
+                # amortizing the per-chunk [B]-shaped fixed cost — bigger
+                # chunks cut the kernel's gather/scatter element traffic per
+                # event, the TPU wall (scalar-core, ~1 element/cycle).
+                # @app:patternChunk overrides for workloads whose match rate
+                # is known to be low (overflow still detected + warned).
+                m0 = max(1, prog.slots[0].min_count)
+                kernel = prog.apply_batch_count
+                chunk = (
+                    COUNT_CHUNK_OVERRIDE
+                    or self._pattern_chunk
+                    or max(1, prog.T * m0)
+                )
 
         if kernel is not None:
             ker, C0 = kernel, chunk

@@ -131,8 +131,7 @@ def _make_routed_step(qr, mesh, axis: str, n_dev: int):
     """Build the routed sharded step (see shard_partitioned_query)."""
     from functools import partial
 
-    from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from jax import lax, shard_map
     from jax.sharding import PartitionSpec as P
 
     from siddhi_tpu.core.event import (
@@ -158,14 +157,21 @@ def _make_routed_step(qr, mesh, axis: str, n_dev: int):
         )
         is_timer = batch.valid & (batch.kind == KIND_TIMER)
 
-        # ---- route the batch axis: device d owns slots [d*PL, (d+1)*PL).
+        # ---- route the batch axis: device d owns slots {s : s % D == d}
+        # (STRIPED, not blocked — first-seen slot allocation hands out low
+        # slot numbers first, so a block map slot//PL leaves high devices
+        # idle until >PL live keys exist; striping spreads the first D keys
+        # across all D devices, the analog of key-hash routing in the
+        # reference's PartitionStreamReceiver.java:81-140). Slot s's state
+        # lives at block-sharded state row (s % D)*PL + s//D, i.e. device
+        # s % D, local row s // D.
         # Each device's sub-batch = its own active rows UNION all timer rows,
         # kept in ORIGINAL row order (a [D, B] mask + per-row cumsum), so
         # timer-driven operators see timers interleaved exactly as the
         # unsharded path does. |actives_d ∪ timers| <= B always, so the
         # sub-batch capacity B can never overflow.
         idx = jnp.arange(B, dtype=jnp.int32)
-        dev_of = jnp.where(active & (slot < qr.p), slot // PL, D)
+        dev_of = jnp.where(active & (slot < qr.p), slot % D, D)
         take = (dev_of[None, :] == jnp.arange(D)[:, None]) | is_timer[None, :]
         rank = jnp.cumsum(take.astype(jnp.int32), axis=1) - 1  # [D, B]
         dst = jnp.where(take, jnp.arange(D)[:, None] * B + rank, D * B)
@@ -196,7 +202,7 @@ def _make_routed_step(qr, mesh, axis: str, n_dev: int):
                 P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(),
             ),
             out_specs=(P(axis), P(axis), P()),
-            check_rep=False,
+            check_vma=False,
         )
         def local(states_sl, ts_sl, kind_sl, valid_sl, cols_sl, slot_sl, now_):
             d = lax.axis_index(axis)
@@ -208,7 +214,7 @@ def _make_routed_step(qr, mesh, axis: str, n_dev: int):
             is_t = valid1 & (kind1 == KIND_TIMER)
 
             def one(state, p_local):
-                gp = d * PL + p_local
+                gp = p_local * D + d
                 v = (valid1 & (slot1 == gp)) | is_t
                 b2 = EventBatch(ts1, kind1, v, cols1)
                 st, _ts, out, aux = qr._step_impl(state, {}, b2, now_)

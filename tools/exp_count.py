@@ -1,0 +1,89 @@
+"""Experiment: count_sequence device rate vs (patternCapacity T, chunk C).
+
+Times ONLY the fused device program (pre-staged wire) like profile_legs.
+Usage: python tools/exp_count.py [T:C ...]   e.g. 4096:4096 1024:4096 512:2048
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)) + "/..")
+
+import bench as B  # noqa: E402
+
+
+def run(T: int, C: int, bsz=32768, reps=3):
+    import jax
+
+    from siddhi_tpu import SiddhiManager
+    import siddhi_tpu.core.pattern_runtime as prtm
+
+    ql = f"""@app:batch(size='{bsz}')
+    @app:patternCapacity(size='{T}')
+    define stream StockStream (symbol string, price float, volume long);
+    @info(name='q')
+    from every a1=StockStream[price > 90]<2:4> -> a2=StockStream[price < 10]
+    select a2.symbol as s2
+    insert into Out;
+    """
+    prtm.COUNT_CHUNK_OVERRIDE = C  # pin the chunk exactly as labeled
+
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    B._prime_interner(mgr, B._make_stock_data(8)["names"])
+    rt.start()
+    j = rt.junctions["StockStream"]
+    fi = j.fused_ingest
+    assert fi is not None and fi.eligible()
+    fi._build()
+    Kf = fi.K
+    data = B._make_stock_data(bsz * Kf)
+    cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
+    encode, _d, _nb = j.schema.wire_codec(bsz, fi._keep)
+    bufs, counts, bases = [], np.full((Kf,), bsz, np.int32), np.zeros((Kf,), np.int64)
+    for k in range(Kf):
+        lo = k * bsz
+        buf, base = encode(data["ts"][lo:lo + bsz], {kk: v[lo:lo + bsz] for kk, v in cols.items()}, bsz)
+        bufs.append(buf)
+        bases[k] = base
+    wire = np.stack(bufs)
+    ev = Kf * bsz
+
+    def run_once(w):
+        states = []
+        for ep in fi.endpoints:
+            if ep.qr.state is None:
+                ep.qr.state = ep.qr._fresh(ep.init_state(0))
+            states.append(ep.qr.state)
+        tstates = {}
+        for ep in fi.endpoints:
+            tstates.update(ep.qr._collect_table_states())
+        ns, _t, _a, _p = fi._fused(tuple(states), tstates, w, counts, bases, np.int64(1_700_000_000_000))
+        for ep, st in zip(fi.endpoints, ns):
+            ep.qr.state = st
+        return ns
+
+    ns = run_once(wire)
+    np.asarray(jax.tree_util.tree_leaves(ns)[0].ravel()[:1])
+    dw = jax.device_put(wire)
+    np.asarray(dw.ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ns = run_once(dw)
+    np.asarray(jax.tree_util.tree_leaves(ns)[0].ravel()[:1])
+    t_dev = (time.perf_counter() - t0) / reps
+    print(f"T={T} C={C}: device={t_dev*1e3:.1f}ms ({ev/t_dev/1e6:.2f} Mev/s)")
+    rt.shutdown()
+    mgr.shutdown()
+    prtm.COUNT_CHUNK_OVERRIDE = None
+
+
+if __name__ == "__main__":
+    specs = sys.argv[1:] or ["4096:4096"]
+    for s in specs:
+        t, c = map(int, s.split(":"))
+        run(t, c)
