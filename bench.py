@@ -391,12 +391,12 @@ def _leg_timebudget(batch=32768) -> dict:
             out[f"{name}_budget"] = "fused-ineligible"
             rt.shutdown(); mgr.shutdown()
             continue
-        fi._build()
         K = fi.K
         data = _make_stock_data(bsz * K)
         cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
-        encode, _d, wire_bytes = rt.junctions[stream].schema.wire_codec(
-            bsz, fi._keep
+        # same narrow wire the engine would sample from this data
+        encode, wire_bytes = fi.staged_codec(
+            data["ts"][:bsz], {k: v[:bsz] for k, v in cols.items()}
         )
         t0 = time.perf_counter()
         bufs, counts, bases = [], np.full((K,), bsz, np.int32), np.zeros((K,), np.int64)

@@ -35,6 +35,11 @@ KIND_RESET = 3
 Event = collections.namedtuple("Event", ["timestamp", "data"])
 
 
+class WireNarrowMisfit(ValueError):
+    """A value in this batch does not fit the chosen narrow wire dtype; the
+    sender must rebuild with the full-width wire and retry."""
+
+
 def _bitcast_split(buf, offset: int, cap: int, dt: np.dtype):
     """Slice one column section out of a packed uint8 buffer and bitcast it
     to its dtype — shared by packed_codec and wire_codec so the 1-byte-wide
@@ -269,22 +274,83 @@ class StreamSchema:
         cache[capacity] = codec
         return codec
 
-    def wire_codec(self, capacity: int, keep: frozenset | None = None):
+    def propose_narrow(
+        self,
+        timestamps: np.ndarray,
+        cols: dict,
+        keep: frozenset | None = None,
+        margin: int = 4,
+    ) -> dict:
+        """Sample-driven narrow wire dtypes: for each integer lane (and the
+        ts-delta lane), the smallest dtype whose range covers `margin`x the
+        sample's extremes. Used once at fused-ingest engagement; a later
+        batch that does not fit raises WireNarrowMisfit and the caller falls
+        back to the full-width wire (one rebuild, then permanent)."""
+        narrow: dict[str, np.dtype] = {}
+
+        def pick(lo: int, hi: int, wide: np.dtype) -> np.dtype | None:
+            for nd in (np.int16, np.int32):
+                dt = np.dtype(nd)
+                if dt.itemsize >= wide.itemsize:
+                    return None
+                info = np.iinfo(dt)
+                if lo * margin >= info.min and hi * margin <= info.max:
+                    return dt
+            return None
+
+        n = len(timestamps)
+        if n:
+            # tsd rides as CONSECUTIVE diffs (decode reconstructs with a
+            # device cumsum), so steady event streams narrow to int8/int16
+            # even when the whole batch spans more than the dtype's range
+            d = np.diff(timestamps[:n].astype(np.int64), prepend=timestamps[0])
+            lo, hi = int(d.min()), int(d.max())
+            for nd in (np.int8, np.int16):
+                info = np.iinfo(nd)
+                if lo * margin >= info.min and hi * margin <= info.max:
+                    narrow["__tsd__"] = np.dtype(nd)
+                    break
+        for name, t in self.attrs:
+            if keep is not None and name not in keep:
+                continue
+            wide = np.dtype(PHYSICAL_DTYPE[t])
+            if wide.kind != "i" or name not in cols or n == 0:
+                continue
+            src = np.asarray(cols[name])[:n]
+            if src.dtype.kind not in "iu":
+                continue  # un-interned strings etc. — leave wide
+            got = pick(int(src.min()), int(src.max()), wide)
+            if got is not None:
+                narrow[name] = got
+        return narrow
+
+    def wire_codec(
+        self,
+        capacity: int,
+        keep: frozenset | None = None,
+        narrow: dict | None = None,
+    ):
         """Projected/narrowed single-transfer codec for fused ingest.
 
         Cuts wire bytes/event — the dominant cost through a bandwidth-limited
-        tunnel — two ways vs `packed_codec`:
-        - timestamps ride as int32 deltas from a per-batch int64 base (the
-          caller guarantees the span fits; a micro-batch spanning >24 days of
-          millis falls back to the wide path);
+        tunnel — three ways vs `packed_codec`:
+        - timestamps ride as int32 (or int16, see below) deltas from a
+          per-batch int64 base (the caller guarantees the span fits; a
+          micro-batch spanning >24 days of millis falls back to the wide
+          path);
         - columns not in `keep` (attributes no subscriber of the junction
           ever reads, from Scope.used_keys) are not shipped at all; decode
-          fills them with the null sentinel so schema shape is preserved.
+          fills them with the null sentinel so schema shape is preserved;
+        - `narrow` maps lane names ("__tsd__" or attribute names) to smaller
+          integer dtypes chosen from a data sample (propose_narrow); encode
+          verifies every value fits and raises WireNarrowMisfit otherwise,
+          decode upcasts back to the physical dtype.
 
         encode(ts, cols, n) -> (buf uint8[total], base int64)
         decode(buf, n, base) -> EventBatch
         """
-        key = (capacity, keep)
+        narrow = narrow or {}
+        key = (capacity, keep, tuple(sorted(narrow.items())))
         cache = self.__dict__.setdefault("_wire_codecs", {})
         cached = cache.get(key)
         if cached is not None:
@@ -300,45 +366,66 @@ class StreamSchema:
             (name, t) for name, t in self.attrs
             if not (keep is None or name in keep)
         ]
-        sections: list[tuple[str, np.dtype]] = [("__tsd__", np.dtype(np.int32))]
+        # (lane, wire dtype, decoded dtype)
+        sections: list[tuple[str, np.dtype, np.dtype]] = [(
+            "__tsd__",
+            np.dtype(narrow.get("__tsd__", np.int32)),
+            np.dtype(np.int32),
+        )]
         for name, t in kept:
-            sections.append((name, np.dtype(PHYSICAL_DTYPE[t])))
+            wide = np.dtype(PHYSICAL_DTYPE[t])
+            sections.append((name, np.dtype(narrow.get(name, wide)), wide))
         offsets = []
         off = 0
-        for _name, dt in sections:
+        for _name, dt, _w in sections:
             offsets.append(off)
             off += cap * dt.itemsize
         total = off
 
+        tsd_diff = sections[0][1].itemsize < 4  # narrow tsd = diff-coded
+
         def encode(timestamps: np.ndarray, cols: dict, n: int):
             base = np.int64(timestamps[0]) if n > 0 else np.int64(0)
             buf = np.zeros((total,), dtype=np.uint8)
-            for (name, dt), o in zip(sections, offsets):
+            for (name, dt, wide), o in zip(sections, offsets):
                 dst = buf[o : o + cap * dt.itemsize].view(dt)
                 if name == "__tsd__":
-                    deltas = timestamps[:n] - base
+                    ts64 = timestamps[:n].astype(np.int64, copy=False)
                     if n > 0 and (
-                        int(deltas.max(initial=0)) >= (1 << 31)
-                        or int(deltas.min(initial=0)) < -(1 << 31)
+                        int(ts64.max()) - int(base) >= (1 << 31)
+                        or int(ts64.min()) - int(base) < -(1 << 31)
                     ):
                         raise ValueError(
                             "wire_codec: timestamp span exceeds int32 deltas "
                             "(>~24.8 days per batch); use packed_codec"
                         )
-                    dst[:n] = deltas.astype(np.int32)
+                    src = (
+                        np.diff(ts64, prepend=base) if tsd_diff
+                        else ts64 - base
+                    )
                 else:
-                    dst[:n] = cols[name][:n].astype(dt, copy=False)
+                    src = cols[name][:n]
+                if dt.itemsize < wide.itemsize and n > 0:
+                    info = np.iinfo(dt)
+                    if (
+                        int(src.min(initial=0)) < info.min
+                        or int(src.max(initial=0)) > info.max
+                    ):
+                        raise WireNarrowMisfit(name)
+                dst[:n] = src.astype(dt, copy=False)
             return buf, base
 
         def decode(buf, n, base):
             cols_out = {}
             ts = None
-            for (name, dt), o in zip(sections, offsets):
+            for (name, dt, wide), o in zip(sections, offsets):
                 arr = _bitcast_split(buf, o, cap, dt)
                 if name == "__tsd__":
+                    if tsd_diff:
+                        arr = jnp.cumsum(arr.astype(jnp.int32))
                     ts = base + arr.astype(jnp.int64)
                 else:
-                    cols_out[name] = arr
+                    cols_out[name] = arr.astype(jnp.dtype(wide))
             for name, t in dropped:
                 nv = null_value(t)
                 cols_out[name] = jnp.full(

@@ -32,6 +32,8 @@ import jax.lax as lax
 import jax.numpy as jnp
 import numpy as np
 
+from siddhi_tpu.core.event import WireNarrowMisfit
+
 
 class FuseEndpoint:
     """One junction subscriber in fused form.
@@ -72,7 +74,31 @@ class FusedJunctionIngest:
         self._fused = None
         self._fused_deliver = None
         self._disabled = False
+        # narrow wire dtypes: None = not chosen yet (sampled from the first
+        # engaged send); {} = full width (permanent after any misfit)
+        self._narrow = None
         self._lock = threading.Lock()
+
+    def wire_params(self):
+        """(capacity, keep, narrow) — the exact wire codec the built fused
+        program decodes; tools/bench must encode with the same triple."""
+        return self.junction.batch_size, self._keep, (self._narrow or {})
+
+    def staged_codec(self, ts_sample, cols_sample):
+        """Bench/tool entry: sample the narrow wire (if unchosen), build the
+        non-delivery fused program, and return (encode, wire_bytes) matching
+        the program exactly — the one place the staging handshake lives."""
+        with self._lock:
+            if self._narrow is None:
+                self._narrow = self.junction.schema.propose_narrow(
+                    ts_sample, cols_sample, self._compute_keep()
+                )
+            if self._fused is None:
+                self._build()
+            encode, _d, nb = self.junction.schema.wire_codec(
+                *self.wire_params()
+            )
+        return encode, nb
 
     # ---- eligibility (cheap dynamic checks, every send) ------------------
 
@@ -112,11 +138,9 @@ class FusedJunctionIngest:
 
     # ---- device program --------------------------------------------------
 
-    def _build(self, deliver_set: Optional[frozenset] = None):
-        deliver = deliver_set is not None
-        B = self.junction.batch_size
+    def _compute_keep(self) -> frozenset | None:
+        """Projected wire: ship only attributes some subscriber reads."""
         schema = self.junction.schema
-        # projected wire: ship only attributes some subscriber reads
         used: set | None = set()
         for ep in self.endpoints:
             ua = getattr(ep.qr, "used_attrs", None)
@@ -128,7 +152,16 @@ class FusedJunctionIngest:
             None if used is None
             else frozenset(n for n in schema.attr_names if n in used)
         )
-        _encode, decode, self._wire_bytes = schema.wire_codec(B, self._keep)
+        return self._keep
+
+    def _build(self, deliver_set: Optional[frozenset] = None):
+        deliver = deliver_set is not None
+        B = self.junction.batch_size
+        schema = self.junction.schema
+        self._compute_keep()
+        _encode, decode, self._wire_bytes = schema.wire_codec(
+            B, self._keep, self._narrow or {}
+        )
         impls = [ep.impl_factory() for ep in self.endpoints]
         impls_want = [ep.qr.output_events for ep in self.endpoints]
 
@@ -277,11 +310,23 @@ class FusedJunctionIngest:
             return False
         dset = self._delivery_set()
         deliver = bool(dset)
+        ts_arr = np.asarray(timestamps)
+        if n and int(ts_arr.max()) - int(ts_arr.min()) >= (1 << 31):
+            return False  # int32 ts-delta wire can't span >24 days per call
         with self._lock:
             if deliver and getattr(self, "_deliver_set", None) != dset:
                 self._fused_deliver = None  # callback set changed: rebuild
             if (self._fused_deliver if deliver else self._fused) is None:
                 try:
+                    if self._narrow is None:
+                        # sample the first micro-batch of the first engaged
+                        # send: smallest int dtypes with 4x headroom; any
+                        # later misfit rebuilds full-width (once)
+                        self._narrow = self.junction.schema.propose_narrow(
+                            ts_arr[:B],
+                            {k: np.asarray(v)[:B] for k, v in cols.items()},
+                            self._compute_keep(),
+                        )
                     self._build(deliver_set=dset if deliver else None)
                 except Exception:
                     import logging
@@ -292,36 +337,40 @@ class FusedJunctionIngest:
                     )
                     self._disabled = True
                     return False
-        prog = self._fused_deliver if deliver else self._fused
-        ts_arr = np.asarray(timestamps)
-        if n and int(ts_arr.max()) - int(ts_arr.min()) >= (1 << 31):
-            return False  # int32 ts-delta wire can't span >24 days per call
-        encode, _decode, _nb = self.junction.schema.wire_codec(B, self._keep)
+            # snapshot the (program, encode) PAIR under the lock: a misfit
+            # rebuild in another thread swaps both _narrow and the programs,
+            # and an unlocked read could pair a full-width encode with the
+            # old narrow-decoding program (silent corruption)
+            prog = self._fused_deliver if deliver else self._fused
+            encode, _decode, _nb = self.junction.schema.wire_codec(
+                B, self._keep, self._narrow or {}
+            )
 
         app_lock = self.app._process_lock
         K = self.K
         pending_drain = None  # previous chunk's packs, drained one chunk late
         for c_off in range(0, n, K * B):
             c_end = min(c_off + K * B, n)
-            bufs = []
-            counts = np.zeros((K,), dtype=np.int32)
-            bases = np.zeros((K,), dtype=np.int64)
-            for k in range(K):
-                lo = c_off + k * B
-                hi = min(lo + B, c_end)
-                m = max(hi - lo, 0)
-                counts[k] = m
-                if m > 0:
-                    buf, base = encode(
-                        ts_arr[lo:hi],
-                        {kk: v[lo:hi] for kk, v in cols.items()},
-                        m,
+            try:
+                wire, counts, bases = self._encode_chunk(
+                    encode, ts_arr, cols, c_off, c_end, B
+                )
+            except WireNarrowMisfit:
+                # a value outgrew the sampled narrow wire: rebuild the fused
+                # program full-width (once, permanent) and re-encode —
+                # program and encode re-snapshotted under the same lock
+                with self._lock:
+                    self._narrow = {}
+                    self._fused = None
+                    self._fused_deliver = None
+                    self._build(deliver_set=dset if deliver else None)
+                    prog = self._fused_deliver if deliver else self._fused
+                    encode, _decode, _nb = self.junction.schema.wire_codec(
+                        B, self._keep, {}
                     )
-                    bufs.append(buf)
-                    bases[k] = base
-                else:
-                    bufs.append(np.zeros_like(bufs[0]))
-            wire = np.stack(bufs)  # [K, bytes]
+                wire, counts, bases = self._encode_chunk(
+                    encode, ts_arr, cols, c_off, c_end, B
+                )
 
             with app_lock:
                 states = []
@@ -376,6 +425,29 @@ class FusedJunctionIngest:
         if pending_drain is not None:
             self._drain(pending_drain)
         return True
+
+    def _encode_chunk(self, encode, ts_arr, cols, c_off, c_end, B):
+        """Encode one K-batch chunk into the [K, bytes] wire stack."""
+        K = self.K
+        bufs = []
+        counts = np.zeros((K,), dtype=np.int32)
+        bases = np.zeros((K,), dtype=np.int64)
+        for k in range(K):
+            lo = c_off + k * B
+            hi = min(lo + B, c_end)
+            m = max(hi - lo, 0)
+            counts[k] = m
+            if m > 0:
+                buf, base = encode(
+                    ts_arr[lo:hi],
+                    {kk: v[lo:hi] for kk, v in cols.items()},
+                    m,
+                )
+                bufs.append(buf)
+                bases[k] = base
+            else:
+                bufs.append(np.zeros_like(bufs[0]))
+        return np.stack(bufs), counts, bases  # [K, bytes]
 
     def _drain(self, packs) -> None:
         """Deliver one chunk's packed outputs to query callbacks: one counts

@@ -337,6 +337,24 @@ class PatternProgram:
 
     # ---- capture projection ---------------------------------------------
 
+    def set_capture_readers(self, keys: frozenset) -> None:
+        """Declare the emission-buffer reader keys (selector/having/order-by).
+
+        Must run before any state/kernel builder calls capture_keep(): a
+        keep-set memoized earlier is left in place (state shapes must stay
+        consistent across traces) and the missed projection is logged loudly
+        instead of silently vanishing."""
+        if self._keep_cache is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pattern capture projection disabled: capture_keep() was "
+                "memoized before set_capture_readers() — a state or kernel "
+                "builder ran too early; all capture lanes stay materialized"
+            )
+            return
+        self._capture_readers = frozenset(keys)
+
     def capture_keep(self):
         """Per-ref projection of the capture lanes: (keep_cols, ts_used).
 

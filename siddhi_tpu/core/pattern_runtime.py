@@ -95,7 +95,7 @@ class PatternQueryRuntime(BaseQueryRuntime):
             batch_mode=False,
             group_capacity=group_capacity,
         )
-        self.prog._capture_readers = frozenset(sel_scope.used_keys)
+        self.prog.set_capture_readers(frozenset(sel_scope.used_keys))
         self._setup_output(query, query_id)
         self._attach_tables(tables, interner)
         self._scope = self.prog.scope

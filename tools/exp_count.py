@@ -39,11 +39,11 @@ def run(T: int, C: int, bsz=32768, reps=3):
     j = rt.junctions["StockStream"]
     fi = j.fused_ingest
     assert fi is not None and fi.eligible()
-    fi._build()
     Kf = fi.K
     data = B._make_stock_data(bsz * Kf)
     cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
-    encode, _d, _nb = j.schema.wire_codec(bsz, fi._keep)
+    encode, _nb = fi.staged_codec(
+        data["ts"][:bsz], {k: v[:bsz] for k, v in cols.items()})
     bufs, counts, bases = [], np.full((Kf,), bsz, np.int32), np.zeros((Kf,), np.int64)
     for k in range(Kf):
         lo = k * bsz

@@ -37,11 +37,11 @@ def profile_leg(name: str, batch=32768, reps=4):
     if fi is None or not fi.eligible():
         print(f"{name}: fused path NOT eligible")
         return
-    fi._build()
     K = fi.K
     data = B._make_stock_data(bsz * K)  # sized from the engine's real K
     cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
-    encode, _d, wire_bytes = j.schema.wire_codec(bsz, fi._keep)
+    encode, wire_bytes = fi.staged_codec(
+        data["ts"][:bsz], {k: v[:bsz] for k, v in cols.items()})
 
     # ---- host encode of one K-batch chunk
     t0 = time.perf_counter()
