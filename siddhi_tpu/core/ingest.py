@@ -359,15 +359,34 @@ class FusedJunctionIngest:
                 # a value outgrew the sampled narrow wire: rebuild the fused
                 # program full-width (once, permanent) and re-encode —
                 # program and encode re-snapshotted under the same lock
-                with self._lock:
-                    self._narrow = {}
-                    self._fused = None
-                    self._fused_deliver = None
-                    self._build(deliver_set=dset if deliver else None)
-                    prog = self._fused_deliver if deliver else self._fused
-                    encode, _decode, _nb = self.junction.schema.wire_codec(
-                        B, self._keep, {}
+                try:
+                    with self._lock:
+                        self._narrow = {}
+                        self._fused = None
+                        self._fused_deliver = None
+                        self._build(deliver_set=dset if deliver else None)
+                        prog = self._fused_deliver if deliver else self._fused
+                        encode, _decode, _nb = self.junction.schema.wire_codec(
+                            B, self._keep, {}
+                        )
+                except Exception as e:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "fused ingest disabled for stream '%s' (full-width "
+                        "rebuild failed)", self.junction.schema.stream_id,
+                        exc_info=True,
                     )
+                    self._disabled = True
+                    if c_off == 0:
+                        return False  # nothing ingested: per-batch fallback
+                    # earlier chunks are committed — honor the junction's
+                    # failure policy for the remainder (like a failing batch)
+                    handler = self.junction.exception_handler
+                    if handler is None:
+                        raise
+                    handler(e)
+                    return True
                 wire, counts, bases = self._encode_chunk(
                     encode, ts_arr, cols, c_off, c_end, B
                 )

@@ -37,7 +37,8 @@ from siddhi_tpu.core.event import (
 )
 from siddhi_tpu.core.executor import Env, Scope, TS_ATTR, compile_expression
 from siddhi_tpu.ops.prefix import cummax as _cummax
-from siddhi_tpu.ops.scatter import set_at as _set_at
+from siddhi_tpu.ops.group import permute_by as _permute_by
+from siddhi_tpu.ops.scatter import compact_set_at as _compact_set_at, set_at as _set_at
 from siddhi_tpu.core.flow import Flow
 from siddhi_tpu.core.types import AttrType
 from siddhi_tpu.query_api.definition import WindowSpec
@@ -545,6 +546,10 @@ class BatchWindow(WindowStage):
         rs_key = jnp.where(flush_exists, row_of_flush * 4 + 1, BIG)
 
         # element table: [0,w) carried-cur, [w,2w) prev, [2w,2w+bsz) batch
+        # (used by the membership env only; the candidate VALUE lanes below
+        # are built by concatenating the same slices, so the big sort carries
+        # them as payloads instead of per-lane [order] gathers — gathers
+        # serialize on the TPU scalar core, sort payloads ride the VPU)
         elem_cols = {
             nm: jnp.concatenate([state["cur_cols"][nm], state["prev_cols"][nm], b.cols[nm]])
             for nm in b.cols
@@ -553,7 +558,9 @@ class BatchWindow(WindowStage):
 
         if self.emit_expired:
             cand_key = jnp.concatenate([cc_cur_key, cc_exp_key, pv_exp_key, bt_cur_key, bt_exp_key, rs_key])
-            cand_elem = jnp.concatenate([cw, cw, cw + w, rows + 2 * w, rows + 2 * w, jnp.zeros((F,), jnp.int32)])
+            lanes = lambda cur, prev, bat: jnp.concatenate(  # noqa: E731
+                [cur, cur, prev, bat, bat, jnp.broadcast_to(cur[0], (F,))]
+            )
             cand_kind = jnp.concatenate(
                 [
                     jnp.full((w,), KIND_CURRENT, jnp.int8),
@@ -569,7 +576,9 @@ class BatchWindow(WindowStage):
         else:
             # CURRENT-only consumers: drop the three expired lanes
             cand_key = jnp.concatenate([cc_cur_key, bt_cur_key, rs_key])
-            cand_elem = jnp.concatenate([cw, rows + 2 * w, jnp.zeros((F,), jnp.int32)])
+            lanes = lambda cur, prev, bat: jnp.concatenate(  # noqa: E731
+                [cur, bat, jnp.broadcast_to(cur[0], (F,))]
+            )
             cand_kind = jnp.concatenate(
                 [
                     jnp.full((w,), KIND_CURRENT, jnp.int8),
@@ -580,18 +589,37 @@ class BatchWindow(WindowStage):
             tie = jnp.concatenate([cw, rows + w, jnp.arange(F, dtype=jnp.int32)])
             bt_cur_off = w
         cand_valid = cand_key < BIG
-        order = jnp.lexsort((tie, jnp.where(cand_valid, cand_key, BIG)))
-
-        o_elem = cand_elem[order]
-        o_kind = cand_kind[order]
-        o_valid = cand_valid[order]
-        o_key = jnp.where(o_valid, cand_key[order], BIG)
-        trig_ts = b.ts[jnp.clip(o_key // 4, 0, bsz - 1)]
+        # ONE payload sort orders the candidates AND carries kind/valid/ts and
+        # every attribute value lane
+        ncand_i = cand_key.shape[0]
+        cidx = jnp.arange(ncand_i, dtype=jnp.int32)
+        col_names = list(b.cols)
+        sorted_ops = jax.lax.sort(
+            (
+                jnp.where(cand_valid, cand_key, BIG), tie, cidx,
+                cand_kind, cand_valid, cand_key,
+                lanes(state["cur_ts"], state["prev_ts"], b.ts),
+                *(
+                    lanes(state["cur_cols"][nm], state["prev_cols"][nm], b.cols[nm])
+                    for nm in col_names
+                ),
+            ),
+            num_keys=2, is_stable=False,
+        )
+        (_, _, order, o_kind, o_valid, o_key_raw, o_ts) = sorted_ops[:7]
+        o_cols = dict(zip(col_names, sorted_ops[7:]))
+        o_key = jnp.where(o_valid, o_key_raw, BIG)
+        if self.emit_expired:
+            # EXPIRED rows carry their flush trigger's timestamp
+            trig_ts = b.ts[jnp.clip(o_key // 4, 0, bsz - 1)]
+            out_ts = jnp.where(o_kind == KIND_EXPIRED, trig_ts, o_ts)
+        else:
+            out_ts = o_ts
         out = EventBatch(
-            ts=jnp.where(o_kind == KIND_EXPIRED, trig_ts, elem_ts[o_elem]),
+            ts=out_ts,
             kind=o_kind,
             valid=o_valid,
-            cols={nm: elem_cols[nm][o_elem] for nm in elem_cols},
+            cols=o_cols,
         )
 
         # --- membership (bucket contents; position-based, see SlidingWindow) ---
@@ -601,17 +629,26 @@ class BatchWindow(WindowStage):
         # bucket's currents accumulate, the next flush's expireds remove.
         # Prev-bucket elements are never members (their bucket's reset already
         # cleared the deque; their EXPIRED events remove from empty — a no-op).
-        inv = jnp.argsort(order)  # candidate index -> sorted output position
-        ncand = cand_key.shape[0]
-        birth_cc = jnp.where(carried_valid & any_flush, inv[cw], BIG)
-        birth_bt = jnp.where(row_emit, inv[bt_cur_off + rows], BIG)
+        # candidate index -> sorted output position, via a payload sort; the
+        # per-lane reads below are SLICES of inv (cw/rows are aranges), not
+        # gathers
+        (inv,) = _permute_by(order, cidx)
+        ncand = ncand_i
+        birth_cc = jnp.where(carried_valid & any_flush, inv[:w], BIG)
+        birth_bt = jnp.where(
+            row_emit, inv[bt_cur_off : bt_cur_off + bsz], BIG
+        )
         # without expired lanes there are no death positions, so membership
         # cannot be expressed — hand downstream None and any (future) member
         # consumer degrades to its memberless path (`member is None` guards)
         if self.emit_expired:
-            death_cc = jnp.where(carried_valid & (n_flush > 1), inv[w + cw], BIG)
+            death_cc = jnp.where(
+                carried_valid & (n_flush > 1), inv[w : 2 * w], BIG
+            )
             death_bt = jnp.where(
-                row_emit & (e_row + 1 < n_flush), inv[3 * w + bsz + rows], BIG
+                row_emit & (e_row + 1 < n_flush),
+                inv[3 * w + bsz : 3 * w + 2 * bsz],
+                BIG,
             )
             e_birth = jnp.concatenate([birth_cc, jnp.full((w,), BIG, jnp.int32), birth_bt])
             e_death = jnp.concatenate([death_cc, jnp.full((w,), -1, jnp.int32), death_bt])
@@ -644,7 +681,7 @@ class BatchWindow(WindowStage):
 
         def place_cur(old, vals):
             kept = jnp.where(keep_carried, old, jnp.zeros_like(old))
-            return _set_at(kept, rem_slot, vals)
+            return _compact_set_at(kept, rem_slot, vals)
 
         new_cur_n = jnp.where(keep_carried, cur_n0, 0) + remaining.sum(dtype=jnp.int32)
 
@@ -659,7 +696,7 @@ class BatchWindow(WindowStage):
         def place_prev(old_prev, carried_vals, batch_vals):
             base = jnp.where(any_flush, jnp.zeros_like(old_prev), old_prev)
             base = _set_at(base, lb_slot_c, carried_vals)
-            return _set_at(base, lb_slot_b, batch_vals)
+            return _compact_set_at(base, lb_slot_b, batch_vals)
 
         new_prev_n = jnp.where(
             any_flush, n_carried_last + in_last.sum(dtype=jnp.int32), state["prev_n"]

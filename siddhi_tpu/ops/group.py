@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -26,7 +27,7 @@ from siddhi_tpu.ops.prefix import (
     segmented_cum_extreme,
     segmented_cumsum,
 )
-from siddhi_tpu.ops.scatter import set_at
+from siddhi_tpu.ops.scatter import compact_set_at, set_at
 
 # 64-bit mixing constants (splitmix64 finalizer) for combining composite keys.
 _MIX1 = np.int64(-7046029254386353131)  # 0x9E3779B97F4A7C15 as signed
@@ -49,6 +50,16 @@ def mix_keys(cols: list[jnp.ndarray]) -> jnp.ndarray:
     return h
 
 
+def permute_by(key: jnp.ndarray, *lanes: jnp.ndarray) -> tuple:
+    """Apply the permutation that sorts `key` ascending to every lane with ONE
+    multi-operand bitonic sort. XLA:TPU runs sorts on the vector units
+    (~1 ns/element) but gathers/scatters on the scalar core (~6.5 ns/element),
+    so `x[perm]` for a known permutation is ~6x cheaper as a payload sort.
+    `key` must be a permutation-ranking (all distinct); lanes ride along."""
+    res = jax.lax.sort((key, *lanes), num_keys=1, is_stable=False)
+    return res[1:]
+
+
 @dataclasses.dataclass
 class SortedGroups:
     """Sorted per-batch view: rows permuted by (active, reset-era, key, idx).
@@ -61,6 +72,14 @@ class SortedGroups:
     perm: jnp.ndarray
     inv: jnp.ndarray
     seg_start: jnp.ndarray
+
+    def to_sorted(self, *lanes):
+        """lanes[i][perm] for every lane — one payload sort, no gathers."""
+        return permute_by(self.inv, *lanes)
+
+    def from_sorted(self, *lanes):
+        """lanes[i][inv] (undo to_sorted) — one payload sort, no gathers."""
+        return permute_by(self.perm, *lanes)
 
 
 def assign_slots(
@@ -98,24 +117,28 @@ def assign_slots(
     post = idx > glr  # rows whose carry lives in the (possibly fresh) new table
     era = jnp.cumsum(rst.astype(jnp.int32))  # segments never span a reset
 
-    # ---- sorted view: actives first, grouped by (era, key), stable by idx
+    # ---- sorted view: actives first, grouped by (era, key), stable by idx.
+    # ONE multi-key payload sort replaces lexsort + per-lane [perm] gathers
+    # (sorts ride the vector units; gathers serialize on the scalar core),
+    # and the inverse permutation comes from a second payload sort instead
+    # of a [B]-update scatter.
     inact = (~active).astype(jnp.int32)
-    perm = jnp.lexsort((idx, batch_keys, era, inact)).astype(jnp.int32)
-    sk = batch_keys[perm]
-    se = era[perm]
-    sa = active[perm]
+    inact_s, se, sk, perm, sa = jax.lax.sort(
+        (inact, era, batch_keys, idx, active), num_keys=4, is_stable=False
+    )
+    del inact_s
     seg_start = jnp.concatenate(
         [
             jnp.ones((1,), jnp.bool_),
             (sk[1:] != sk[:-1]) | (se[1:] != se[:-1]) | (sa[1:] != sa[:-1]),
         ]
     )
-    inv = jnp.zeros((b,), jnp.int32).at[perm].set(idx)
+    (inv,) = permute_by(perm, idx)
     grp = SortedGroups(perm=perm, inv=inv, seg_start=seg_start)
 
     # first row (original index) holding each row's (era, key) — via the
     # segment head carried across its segment, inverse-permuted
-    first = segmented_carry(perm, seg_start)[inv]
+    (first,) = grp.from_sorted(segmented_carry(perm, seg_start))
 
     # ---- resolution against the old table (pre-reset gathers + no-reset case)
     # dense [B, G] eq matrix: at G <= ~1k this is a fully vectorized compare +
@@ -147,17 +170,19 @@ def assign_slots(
     slot = jnp.where(active, slot, np.int32(g))
     overflow = jnp.where(any_reset, fresh_overflow, old_overflow)
 
-    # ---- new table state (set_at: int64 key scatters ride the int32-pair
-    # path; a raw 64-bit scatter-set serializes on TPU, ops/scatter.py)
+    # ---- new table state (compact_set_at: sort the <=G live writers to the
+    # front so the scatter touches G updates, not B — and int64 key scatters
+    # ride the int32-pair path either way, ops/scatter.py)
+    ones_b = jnp.ones((b,), jnp.bool_)
     # no reset: old table + this batch's allocations
     scatter_old = jnp.where(is_alloc & (slot_new < g) & ~any_reset, slot_new, g)
-    keys_old = set_at(table_keys, scatter_old, batch_keys)
-    used_old = used.at[scatter_old].set(True, mode="drop")
+    keys_old = compact_set_at(table_keys, scatter_old, batch_keys)
+    used_old = compact_set_at(used, scatter_old, ones_b)
     n_old = jnp.minimum(n_used + is_alloc.sum(dtype=jnp.int32), g)
     # reset: fresh table from post-reset allocations only
     scatter_f = jnp.where(is_alloc_f & (rank_f < g) & any_reset, rank_f, g)
-    keys_f = set_at(jnp.zeros_like(table_keys), scatter_f, batch_keys)
-    used_f = jnp.zeros_like(used).at[scatter_f].set(True, mode="drop")
+    keys_f = compact_set_at(jnp.zeros_like(table_keys), scatter_f, batch_keys)
+    used_f = compact_set_at(jnp.zeros_like(used), scatter_f, ones_b)
     n_f = jnp.minimum(is_alloc_f.sum(dtype=jnp.int32), g)
 
     new_keys = jnp.where(any_reset, keys_f, keys_old)
@@ -173,8 +198,7 @@ def _final_segment_writers(grp: SortedGroups, slot, post):
     scatter-SET (int32-pair fast path) instead of a serialized 64-bit
     scatter reduction."""
     seg_end = jnp.concatenate([grp.seg_start[1:], jnp.ones((1,), jnp.bool_)])
-    slot_s = slot[grp.perm]
-    post_s = post[grp.perm]
+    slot_s, post_s = grp.to_sorted(slot, post)
     return seg_end & post_s, slot_s
 
 
@@ -191,8 +215,9 @@ def keyed_running_sum(
     with no reset in between — exactly the reference's per-key running state
     with RESET zeroing every group."""
     g = carry.shape[0]
-    run_s = segmented_cumsum(contrib[grp.perm], grp.seg_start)
-    run = run_s[grp.inv]
+    (contrib_s,) = grp.to_sorted(contrib)
+    run_s = segmented_cumsum(contrib_s, grp.seg_start)
+    (run,) = grp.from_sorted(run_s)
     lr = last_reset_index(reset)
     gathered = jnp.where(slot < g, carry[jnp.clip(slot, 0, g - 1)], 0)
     run = run + jnp.where(lr < 0, gathered, jnp.zeros_like(gathered))
@@ -200,20 +225,16 @@ def keyed_running_sum(
     glr = lr[-1]
     post = jnp.arange(contrib.shape[0], dtype=jnp.int32) > glr
     base = jnp.where(reset.any(), jnp.zeros_like(carry), carry)
-    if jnp.dtype(carry.dtype).itemsize >= 8:
-        # 64-bit scatter-add serializes on TPU; in the final era each live
-        # group is exactly one sorted segment, so its carry is base + the
-        # segment END's running sum — one unique-index scatter-set per group
-        writer, slot_s = _final_segment_writers(grp, slot, post)
-        writer = writer & (slot_s < g)
-        newval = (
-            jnp.where(slot_s < g, base[jnp.clip(slot_s, 0, g - 1)], 0) + run_s
-        )
-        new_carry = set_at(base, jnp.where(writer, slot_s, g), newval)
-    else:
-        new_carry = base.at[jnp.where(post, slot, g)].add(
-            jnp.where(post, contrib, 0), mode="drop"
-        )
+    # in the final era each live group is exactly one sorted segment, so its
+    # carry is base + the segment END's running sum — one unique writer per
+    # group, compacted so the scatter costs G updates (B-update scatters and
+    # 64-bit scatter reductions both serialize on the TPU scalar core)
+    writer, slot_s = _final_segment_writers(grp, slot, post)
+    writer = writer & (slot_s < g)
+    newval = (
+        jnp.where(slot_s < g, base[jnp.clip(slot_s, 0, g - 1)], 0) + run_s
+    ).astype(carry.dtype)
+    new_carry = compact_set_at(base, jnp.where(writer, slot_s, g), newval)
     return run, new_carry
 
 
@@ -231,8 +252,9 @@ def keyed_running_extreme(
     ident = extreme_identity(values.dtype, is_min)
     op = jnp.minimum if is_min else jnp.maximum
     masked = jnp.where(active, values, ident)
-    run_s = segmented_cum_extreme(masked[grp.perm], grp.seg_start, is_min)
-    run = run_s[grp.inv]
+    (masked_s,) = grp.to_sorted(masked)
+    run_s = segmented_cum_extreme(masked_s, grp.seg_start, is_min)
+    (run,) = grp.from_sorted(run_s)
     lr = last_reset_index(reset)
     gathered = jnp.where(
         (slot < g) & (lr < 0), carry[jnp.clip(slot, 0, g - 1)], ident
@@ -241,24 +263,15 @@ def keyed_running_extreme(
 
     post = jnp.arange(values.shape[0], dtype=jnp.int32) > lr[-1]
     base = jnp.where(reset.any(), jnp.full_like(carry, ident), carry)
-    if jnp.dtype(carry.dtype).itemsize >= 8:
-        # 64-bit scatter reductions serialize on TPU — write each live
-        # group's final-era segment extreme with one unique-index scatter-set
-        # (see keyed_running_sum)
-        writer, slot_s = _final_segment_writers(grp, slot, post)
-        writer = writer & (slot_s < g)
-        newval = op(
-            jnp.where(slot_s < g, base[jnp.clip(slot_s, 0, g - 1)], ident),
-            run_s,
-        )
-        new_carry = set_at(base, jnp.where(writer, slot_s, g), newval)
-    else:
-        scatter = jnp.where(post & active, slot, g)
-        vals_post = jnp.where(post & active, values, ident)
-        if is_min:
-            new_carry = base.at[scatter].min(vals_post, mode="drop")
-        else:
-            new_carry = base.at[scatter].max(vals_post, mode="drop")
+    # one unique writer per live group (its final-era segment end), compacted
+    # — see keyed_running_sum
+    writer, slot_s = _final_segment_writers(grp, slot, post)
+    writer = writer & (slot_s < g)
+    newval = op(
+        jnp.where(slot_s < g, base[jnp.clip(slot_s, 0, g - 1)], ident),
+        run_s,
+    ).astype(carry.dtype)
+    new_carry = compact_set_at(base, jnp.where(writer, slot_s, g), newval)
     return run, new_carry
 
 
@@ -277,8 +290,7 @@ def keep_last_in_sorted(
 
     b = valid.shape[0]
     idx = jnp.arange(b, dtype=jnp.int32)
-    sv = valid[grp.perm]
-    sk = kind[grp.perm].astype(jnp.int32)
+    sv, sk = grp.to_sorted(valid, kind.astype(jnp.int32))
     seg_end = jnp.concatenate([grp.seg_start[1:], jnp.ones((1,), jnp.bool_)])
     rev_start = seg_end[::-1]
 
@@ -289,7 +301,8 @@ def keep_last_in_sorted(
     last_cur = last_of(int(KIND_CURRENT))
     last_exp = last_of(int(KIND_EXPIRED))
     last_for_row = jnp.where(sk == int(KIND_CURRENT), last_cur, last_exp)
-    return valid & (last_for_row[grp.inv] == idx)
+    (lfr,) = grp.from_sorted(last_for_row)
+    return valid & (lfr == idx)
 
 
 def keep_last_per_group(cols: list[jnp.ndarray], valid: jnp.ndarray) -> jnp.ndarray:
@@ -299,9 +312,11 @@ def keep_last_per_group(cols: list[jnp.ndarray], valid: jnp.ndarray) -> jnp.ndar
     O(B log B): sort by group, find each group's last valid row index."""
     b = valid.shape[0]
     idx = jnp.arange(b, dtype=jnp.int32)
-    perm = jnp.lexsort((idx, *[c for c in cols])).astype(jnp.int32)
-    sv = valid[perm]
-    scols = [c[perm] for c in cols]
+    # one payload sort: cols as keys (idx last for a total order), valid rides
+    sorted_ops = jax.lax.sort(
+        (*cols, idx, valid), num_keys=len(cols) + 1, is_stable=False
+    )
+    scols, perm, sv = sorted_ops[: len(cols)], sorted_ops[-2], sorted_ops[-1]
     boundary = jnp.zeros((b,), jnp.bool_).at[0].set(True)
     for c in scols:
         boundary = boundary | jnp.concatenate(
@@ -315,5 +330,5 @@ def keep_last_per_group(cols: list[jnp.ndarray], valid: jnp.ndarray) -> jnp.ndar
     seg_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
     rev_start = seg_end[::-1]
     last_in_seg = segmented_cum_extreme(rev, rev_start, is_min=False)[::-1]
-    inv = jnp.zeros((b,), jnp.int32).at[perm].set(idx)
-    return valid & (last_in_seg[inv] == idx)
+    (last_back,) = permute_by(perm, last_in_seg)
+    return valid & (last_back == idx)

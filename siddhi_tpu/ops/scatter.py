@@ -53,3 +53,27 @@ def set_at(dst: jnp.ndarray, idx: jnp.ndarray, src: jnp.ndarray, *, mode: str = 
     )
 
 
+def compact_set_at(
+    dst: jnp.ndarray, idx: jnp.ndarray, src: jnp.ndarray
+) -> jnp.ndarray:
+    """Scatter-set with a LARGE sparse index vector into a SMALL target:
+    `dst[G].at[idx[B]].set(src[B])` where at most one live writer exists per
+    slot and dead lanes carry idx == G.
+
+    XLA:TPU executes scatter at ~one UPDATE per scalar-core step, so a [B]
+    index vector costs ~B regardless of how few writers are live. One
+    multi-operand bitonic sort (~1 ns/element, vectorized) moves the live
+    writers to the front, and the real scatter then touches only [G] updates.
+    Net: B-update scatter -> sort(B) + G-update scatter, ~4-6x faster for
+    B >> G. Falls back to the plain scatter when B <= G."""
+    g = dst.shape[0]
+    b = idx.shape[0]
+    if b <= g:
+        return set_at(dst, idx, src)
+    key = jnp.where(idx < g, idx, b).astype(jnp.int32)  # dead lanes sort last
+    key_s, src_s = jax.lax.sort(
+        (key, src), num_keys=1, is_stable=False
+    )
+    return set_at(dst, jnp.where(key_s[:g] < g, key_s[:g], g), src_s[:g])
+
+
