@@ -291,7 +291,15 @@ def _leg_p99(batch=256, batches=60) -> dict:
     """p99 detection latency: wall time from the START of a micro-batch send
     to the query callback having DELIVERED that batch's matches, vs the
     measured per-batch floor of this backend (dispatch + completion cycle +
-    readback in transfer-degraded mode). Target: p99 <= floor + 10 ms."""
+    readback in transfer-degraded mode). Target: p99 <= floor + 10 ms.
+
+    The floor probe runs INTERLEAVED with the detection sends (one probe
+    after each batch) so both distributions sample the SAME relay weather:
+    the tunnel's round-trip latency drifts by tens of ms over a run, and a
+    floor measured minutes later compares engine samples against different
+    network conditions, not engine overhead (the r4 '+21.6 ms regression'
+    was exactly this artifact — instrumented engine overhead above the d2h
+    round trip is ~1 ms)."""
     import jax
     import jax.numpy as jnp
 
@@ -315,35 +323,43 @@ def _leg_p99(batch=256, batches=60) -> dict:
     h = rt.get_input_handler("StockStream")
     cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
 
+    # floor probe: one dispatch + ready-wait + tiny readback in the same
+    # (transfer-degraded) mode the callback path runs in
+    x = jnp.zeros((batch,), jnp.float32)
+    f = jax.jit(lambda v: v.sum())
+    np.asarray(f(x))
+
     lat = []
+    floors = []
     for i in range(batches + 5):
         lo, hi = i * batch, (i + 1) * batch
         fired[0] = 0.0
         t0 = time.perf_counter()
         h.send_columns(data["ts"][lo:hi], {k: v[lo:hi] for k, v in cols.items()})
         t1 = fired[0] if fired[0] > 0.0 else time.perf_counter()
+        t2 = time.perf_counter()
+        np.asarray(f(x))  # paired floor sample, same relay weather
+        t3 = time.perf_counter()
         if i >= 5:  # skip compile warmup
             lat.append((t1 - t0) * 1000)
+            floors.append((t3 - t2) * 1000)
     rt.shutdown()
     mgr.shutdown()
+    # paired deltas isolate ENGINE overhead from relay weather: each
+    # detection sample is compared against its own immediately-following
+    # floor probe, and the median delta is robust to the heavy-tailed
+    # round-trip distribution (a p99-vs-p99 comparison is the single worst
+    # sample of 60 draws on each side — pure noise at ±40 ms jitter)
+    deltas = sorted(a - b for a, b in zip(lat, floors))
     lat.sort()
-    p99 = lat[max(0, math.ceil(len(lat) * 0.99) - 1)]
-
-    # floor: one dispatch + ready-wait + tiny readback in the same
-    # (transfer-degraded) mode the callback path runs in
-    x = jnp.zeros((batch,), jnp.float32)
-    f = jax.jit(lambda v: v.sum())
-    np.asarray(f(x))
-    floors = []
-    for _ in range(15):
-        t0 = time.perf_counter()
-        np.asarray(f(x))
-        floors.append((time.perf_counter() - t0) * 1000)
     floors.sort()
+    p99 = lat[max(0, math.ceil(len(lat) * 0.99) - 1)]
     return {
         "p99_detect_ms": round(p99, 2),
         "p99_floor_ms": round(floors[max(0, math.ceil(len(floors) * 0.99) - 1)], 2),
         "p50_floor_ms": round(floors[len(floors) // 2], 2),
+        "p50_detect_ms": round(lat[len(lat) // 2], 2),
+        "engine_overhead_p50_ms": round(deltas[len(deltas) // 2], 2),
     }
 
 
@@ -670,7 +686,8 @@ def main():
 
     detail: dict = {}
     legs = list(WORKLOADS) + [
-        "filter_window_avg_delivered", "p99", "tables", "timebudget", "verify",
+        "filter_window_avg_delivered", "pattern_2state_delivered",
+        "tumbling_groupby_delivered", "p99", "tables", "timebudget", "verify",
     ]
     for leg in legs:
         cmd = [sys.executable, os.path.abspath(__file__), "--leg", leg,

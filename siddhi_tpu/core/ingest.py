@@ -228,9 +228,9 @@ class FusedJunctionIngest:
             # (per-lane buffers would need one transfer each)
             from siddhi_tpu.ops.scatter import set_at
 
-            K = self.K
             packs = []
             for stacked, dv in out_stack:
+                K = dv.shape[0]  # shape-driven: one traced fn serves any K
                 cap = dv.shape[1]
                 R = K * cap
                 flat = dv.reshape(R)  # [K, cap] row-major = arrival order
@@ -298,15 +298,27 @@ class FusedJunctionIngest:
 
     # ---- host side -------------------------------------------------------
 
+    def _chunk_K(self, remaining_batches: int) -> int:
+        """Smallest K variant covering the remainder: full chunks use self.K;
+        a short tail picks the smallest power-of-two variant that holds it, so
+        chunk-granularity producers stay on the fused path without paying a
+        full K-iteration scan of empty batches. jax.jit retraces per wire
+        shape, so each variant compiles once and is cached."""
+        if remaining_batches >= self.K:
+            return self.K
+        k = 2
+        while k < remaining_batches:
+            k *= 2
+        return min(k, self.K)
+
     def try_send(self, timestamps, cols, now: int) -> bool:
         """Attempt fused ingest of the whole call. Returns False to make the
         caller fall back to the per-batch path."""
         n = len(timestamps)
         B = self.junction.batch_size
-        # engage only when the call fills a decent fraction of a chunk —
-        # shorter sends would pay a full K-iteration scan of mostly-empty
-        # batches, slower than the per-batch path off the tunnel
-        if n < max(2 * B, self.K * B // 2) or self._disabled or not self.eligible():
+        # engage for any call of at least two micro-batches: shorter tails
+        # ride a smaller-K variant of the same program (see _chunk_K)
+        if n < 2 * B or self._disabled or not self.eligible():
             return False
         dset = self._delivery_set()
         deliver = bool(dset)
@@ -347,13 +359,14 @@ class FusedJunctionIngest:
             )
 
         app_lock = self.app._process_lock
-        K = self.K
         pending_drain = None  # previous chunk's packs, drained one chunk late
-        for c_off in range(0, n, K * B):
+        c_off = 0
+        while c_off < n:
+            K = self._chunk_K(-(-(n - c_off) // B))
             c_end = min(c_off + K * B, n)
             try:
                 wire, counts, bases = self._encode_chunk(
-                    encode, ts_arr, cols, c_off, c_end, B
+                    encode, ts_arr, cols, c_off, c_end, B, K
                 )
             except WireNarrowMisfit:
                 # a value outgrew the sampled narrow wire: rebuild the fused
@@ -380,15 +393,18 @@ class FusedJunctionIngest:
                     self._disabled = True
                     if c_off == 0:
                         return False  # nothing ingested: per-batch fallback
-                    # earlier chunks are committed — honor the junction's
-                    # failure policy for the remainder (like a failing batch)
+                    # earlier chunks are committed: deliver their parked
+                    # outputs, then honor the junction's failure policy for
+                    # the remainder (like a failing batch)
+                    if pending_drain is not None:
+                        self._drain(*pending_drain)
                     handler = self.junction.exception_handler
                     if handler is None:
                         raise
                     handler(e)
                     return True
                 wire, counts, bases = self._encode_chunk(
-                    encode, ts_arr, cols, c_off, c_end, B
+                    encode, ts_arr, cols, c_off, c_end, B, K
                 )
 
             with app_lock:
@@ -421,6 +437,7 @@ class FusedJunctionIngest:
                     if handler is None:
                         raise
                     handler(e)
+                    c_off = c_end
                     continue  # next chunk, like per-batch send_columns would
                 for ep, st in zip(self.endpoints, new_states):
                     ep.qr.state = st
@@ -439,15 +456,15 @@ class FusedJunctionIngest:
                 # is launched: the host decode overlaps device compute, and
                 # callbacks still fire in order before send_columns returns
                 if pending_drain is not None:
-                    self._drain(pending_drain)
-                pending_drain = packs
+                    self._drain(*pending_drain)
+                pending_drain = (packs, K)
+            c_off = c_end
         if pending_drain is not None:
-            self._drain(pending_drain)
+            self._drain(*pending_drain)
         return True
 
-    def _encode_chunk(self, encode, ts_arr, cols, c_off, c_end, B):
+    def _encode_chunk(self, encode, ts_arr, cols, c_off, c_end, B, K):
         """Encode one K-batch chunk into the [K, bytes] wire stack."""
-        K = self.K
         bufs = []
         counts = np.zeros((K,), dtype=np.int32)
         bases = np.zeros((K,), dtype=np.int64)
@@ -468,12 +485,13 @@ class FusedJunctionIngest:
                 bufs.append(np.zeros_like(bufs[0]))
         return np.stack(bufs), counts, bases  # [K, bytes]
 
-    def _drain(self, packs) -> None:
+    def _drain(self, packs, K: int) -> None:
         """Deliver one chunk's packed outputs to query callbacks: one counts
         readback + one sliced transfer per endpoint-with-callbacks, then a
         vectorized host decode, preserving per-micro-batch callback grouping
         (reference: QueryCallback.receive per chunk,
-        query/output/callback/QueryCallback.java:52-105)."""
+        query/output/callback/QueryCallback.java:52-105). `K` is the chunk's
+        batch count (variable: short tails ride smaller-K programs)."""
         import jax
 
         from siddhi_tpu.core.event import (
@@ -491,7 +509,6 @@ class FusedJunctionIngest:
             if not getattr(qr, "query_callbacks", None):
                 continue
             layout, row_bytes = self._deliver_layout[i]
-            K = self.K
             hdr_rows = -(-4 * K // row_bytes)
             R = pack["buf"].shape[0] - hdr_rows
 
@@ -503,7 +520,10 @@ class FusedJunctionIngest:
             # the previous chunk's total; top up only when the guess
             # undershoots (workload rates are stable)
             guess = bucket(self._drain_guess.get(i, R))
-            head = np.asarray(
+            # ascontiguousarray: this backend's device_get can hand back a
+            # strided view of the device-layout buffer for some slice sizes,
+            # and the .view(dtype) reinterprets below require dense bytes
+            head = np.ascontiguousarray(
                 jax.device_get(pack["buf"][: hdr_rows + guess])
             )
             cnts = head[:hdr_rows].reshape(-1)[: 4 * K].view(np.int32)
@@ -515,7 +535,7 @@ class FusedJunctionIngest:
             if L <= guess:
                 host = head[hdr_rows:]
             else:
-                tail = np.asarray(
+                tail = np.ascontiguousarray(
                     jax.device_get(
                         pack["buf"][hdr_rows + guess : hdr_rows + L]
                     )
