@@ -458,6 +458,34 @@ class BaseQueryRuntime:
                 "dropped — raise it with @app:joinCapacity(size='N')",
                 self.query_id,
             )
+        if (
+            not getattr(self, "_warned_pk_duplicate", False)
+            and "table_pk_duplicate_dropped" in aux
+            and bool(aux["table_pk_duplicate_dropped"])
+        ):
+            self._warned_pk_duplicate = True
+            import logging
+
+            logging.getLogger(__name__).error(
+                "query '%s': dropping inserted event(s) — an event with the "
+                "same primary key is already stored (use `update or insert "
+                "into` to overwrite)",
+                self.query_id,
+            )
+        if (
+            not getattr(self, "_warned_pk_conflict", False)
+            and "table_pk_conflict" in aux
+            and bool(aux["table_pk_conflict"])
+        ):
+            self._warned_pk_conflict = True
+            import logging
+
+            logging.getLogger(__name__).error(
+                "query '%s': update failed — rekeying matched rows would "
+                "collide with an existing primary key; the update event was "
+                "skipped",
+                self.query_id,
+            )
 
     def route_output(self, out: EventBatch, now: int, decode) -> None:
         """Dispatch a step's output to query callbacks / downstream junction.

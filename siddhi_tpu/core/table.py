@@ -35,7 +35,7 @@ from siddhi_tpu.core.executor import (
     compile_expression,
 )
 from siddhi_tpu.core.types import AttrType
-from siddhi_tpu.query_api.annotation import find_annotation
+from siddhi_tpu.query_api.annotation import find_all, find_annotation
 from siddhi_tpu.query_api.definition import TableDefinition
 from siddhi_tpu.query_api.execution import UpdateSetAttribute
 
@@ -71,19 +71,38 @@ class InMemoryTable:
             if cap_ann
             else int(capacity)
         )
-        pk = find_annotation(definition.annotations, "PrimaryKey") or find_annotation(
-            definition.annotations, "primaryKey"
-        )
+        pks = find_all(definition.annotations or [], "PrimaryKey")
+        if len(pks) > 1:
+            # reference: DuplicateAnnotationException for repeated @PrimaryKey
+            raise SiddhiAppCreationError(
+                f"table '{self.table_id}': @PrimaryKey annotation is repeated"
+            )
+        pk = pks[0] if pks else None
         self.primary_keys: list[str] = [v for _, v in pk.elements] if pk else []
+        if pk is not None and not self.primary_keys:
+            raise SiddhiAppCreationError(
+                f"table '{self.table_id}': @PrimaryKey needs at least one "
+                "attribute"
+            )
         for k in self.primary_keys:
             if k not in self.schema.attr_names:
                 raise SiddhiAppCreationError(
                     f"table '{self.table_id}': @PrimaryKey attribute '{k}' undefined"
                 )
-        idx = find_annotation(definition.annotations, "Index") or find_annotation(
-            definition.annotations, "IndexBy"
+        idxs = find_all(definition.annotations or [], "Index") + find_all(
+            definition.annotations or [], "IndexBy"
         )
+        if len(idxs) > 1:
+            # reference: DuplicateAnnotationException for repeated @Index
+            raise SiddhiAppCreationError(
+                f"table '{self.table_id}': @Index annotation is repeated"
+            )
+        idx = idxs[0] if idxs else None
         self.indexes: list[str] = [v for _, v in idx.elements] if idx else []
+        if len(set(self.indexes)) != len(self.indexes):
+            raise SiddhiAppCreationError(
+                f"table '{self.table_id}': @Index lists an attribute twice"
+            )
         for k in self.indexes:
             if k not in self.schema.attr_names:
                 raise SiddhiAppCreationError(
@@ -276,8 +295,12 @@ class InMemoryTable:
     # ---- device ops (traced inside query steps) ---------------------------
 
     def insert(self, state, batch: EventBatch, aux: dict):
-        """Insert valid CURRENT rows. Primary-key conflicts overwrite the
-        existing row (reference: IndexEventHolder primary-key put)."""
+        """Insert valid CURRENT rows. Primary-key conflicts DROP the arriving
+        row — first writer wins, the duplicate is discarded with a warning
+        (reference: IndexEventHolder.add uses putIfAbsent and logs 'dropping
+        event ... already an event stored with primary key',
+        table/holder/IndexEventHolder.java:177-186). `update or insert into`
+        is the overwriting form."""
         rows = batch.valid & (batch.kind == KIND_CURRENT)
         b = rows.shape[0]
         c = self.capacity
@@ -288,38 +311,20 @@ class InMemoryTable:
             for k in self.primary_keys:
                 pk_match = pk_match & (batch.cols[k][:, None] == state["cols"][k][None, :])
             pk_match = pk_match & rows[:, None] & state["valid"][None, :]
-            # also dedupe within the arriving batch: a later row with the same
-            # key overwrites the earlier one's slot — keep only the LAST row
-            # per key as the writer of a fresh slot
+            # within-batch dedupe: the FIRST row per key wins the slot, later
+            # duplicates are dropped like table-resident conflicts
             same_key = jnp.ones((b, b), jnp.bool_)
             for k in self.primary_keys:
                 same_key = same_key & (batch.cols[k][:, None] == batch.cols[k][None, :])
-            later_dup = same_key & rows[None, :] & (
-                jnp.arange(b)[None, :] > jnp.arange(b)[:, None]
+            earlier_dup = same_key & rows[None, :] & (
+                jnp.arange(b)[None, :] < jnp.arange(b)[:, None]
             )
-            is_last = rows & ~later_dup.any(axis=1)
-            overwrites = pk_match.any(axis=1) & is_last  # rows that overwrite
-            fresh = is_last & ~overwrites                # rows taking free slots
-            # overwrite writes: for each table slot, the last arriving row that
-            # pk-matches it
-            writer = jnp.where(
-                pk_match & is_last[:, None], jnp.arange(b)[:, None], -1
-            ).max(axis=0)  # [C] index of writer row or -1
-            has_writer = writer >= 0
-            wi = jnp.clip(writer, 0, b - 1)
-            new_cols = {
-                n: jnp.where(has_writer, col_b[wi], state["cols"][n])
-                for n, col_b in batch.cols.items()
-            }
-            new_ts = jnp.where(has_writer, batch.ts[wi], state["ts"])
-            mid = {
-                "cols": new_cols,
-                "ts": new_ts,
-                "valid": state["valid"],
-                "seq": state["seq"],
-                "next": state["next"],
-            }
-            return self._append(mid, batch, fresh, aux)
+            is_first = rows & ~earlier_dup.any(axis=1)
+            fresh = is_first & ~pk_match.any(axis=1)
+            aux["table_pk_duplicate_dropped"] = jnp.asarray(
+                aux.get("table_pk_duplicate_dropped", False)
+            ) | jnp.any(rows & ~fresh)
+            return self._append(state, batch, fresh, aux)
         return self._append(state, batch, rows, aux)
 
     def _append(self, state, batch: EventBatch, rows, aux: dict):
@@ -406,6 +411,7 @@ class InMemoryTable:
         parallel_ok: bool = False,
         pk_probe=None,
         reindex_after: bool = False,
+        pk_guard: Optional[str] = None,
     ):
         """Update matching table rows from each probe row.
 
@@ -448,8 +454,10 @@ class InMemoryTable:
             )
             return self._rebuild_pk_index(out) if reindex_after else out
 
+        any_conflict0 = jnp.zeros((), jnp.bool_)
+
         def body(carry, xs):
-            cols = carry
+            cols, any_conflict = carry
             row_cols, row_ts, row_on = xs
             env_cols = {(probe_ref, None, n): v[None] for n, v in row_cols.items()}
             env_cols[(probe_ref, None, TS_ATTR)] = row_ts[None]
@@ -462,13 +470,45 @@ class InMemoryTable:
                 jnp.broadcast_to(on(env), (self.capacity,)) & state["valid"]
             )
             m = m & row_on
+            if pk_guard is not None:
+                # an update that REKEYS a row onto an existing primary key
+                # fails atomically for this update event (the matched set is
+                # left untouched) — reference: IndexOperator.update walks the
+                # current key set, removes each row's old key, and aborts the
+                # whole event on the first colliding add
+                # (util/collection/operator/IndexOperator.java:119-161)
+                kcol = cols[pk_guard]
+                fn = dict(set_fns)[pk_guard]
+                vals = jnp.broadcast_to(
+                    fn(env).astype(kcol.dtype), (self.capacity,)
+                )
+                changed = m & (vals != kcol)
+                n_changed = changed.sum(dtype=jnp.int32)
+                i0 = jnp.argmax(changed)
+                new0 = vals[i0]
+                exists_other = jnp.any(
+                    state["valid"] & (kcol == new0)
+                    & (jnp.arange(self.capacity) != i0)
+                )
+                # >=2 rekeys collide with each other in the reference's
+                # one-value-per-event model; per-row-varying values (our
+                # extension) conservatively fail the same way
+                fail = (n_changed >= 2) | ((n_changed == 1) & exists_other)
+                m = jnp.where(fail, jnp.zeros_like(m), m)
+                any_conflict = any_conflict | fail
             new_cols = dict(cols)
             for name, fn in set_fns:
                 new_cols[name] = jnp.where(m, fn(env).astype(cols[name].dtype), cols[name])
-            return new_cols, None
+            return (new_cols, any_conflict), None
 
         xs = (batch.cols, batch.ts, rows)
-        new_cols, _ = lax.scan(body, state["cols"], xs)
+        (new_cols, any_conflict), _ = lax.scan(
+            body, (state["cols"], any_conflict0), xs
+        )
+        if pk_guard is not None:
+            aux["table_pk_conflict"] = (
+                jnp.asarray(aux.get("table_pk_conflict", False)) | any_conflict
+            )
         out = {**state, "cols": new_cols}
         return self._rebuild_pk_index(out) if reindex_after else out
 
@@ -796,6 +836,31 @@ def compile_table_output(
                     output_stream.on, output_stream.set_attributes,
                     table, out_schema,
                 )
+                # single-@PrimaryKey tables whose update writes the key
+                # column take the sequential path with the atomic rekey-
+                # collision guard (reference: IndexOperator.update aborts an
+                # update event whose new key collides) — EXCEPT when the
+                # on-clause equality-pins the written key to the same
+                # expression (`on T.pk == e` with `set pk = e`): the key
+                # provably cannot change, so the vectorized fast path stays
+                pk_guard = None
+                if len(table.primary_keys) == 1:
+                    pk_col = table.primary_keys[0]
+                    if pk_col in {n for n, _ in set_fns}:
+                        found0 = _eq_probe_expr(
+                            output_stream.on, table, out_schema
+                        )
+                        smap = _set_map(
+                            output_stream.set_attributes, table, out_schema
+                        )
+                        pinned = (
+                            found0 is not None
+                            and found0[0] == pk_col
+                            and found0[1] == smap.get(pk_col)
+                        )
+                        if not pinned:
+                            pk_guard = pk_col
+                            par_ok = False
                 pk_probe = None
                 if par_ok:
                     found = _eq_probe_expr(output_stream.on, table, out_schema)
@@ -823,7 +888,7 @@ def compile_table_output(
                     tstates[_tid] = _t.update(
                         tstates[_tid], out_batch, on, set_fns, "__out__", now,
                         aux, parallel_ok=par_ok, pk_probe=pk_probe,
-                        reindex_after=reindex,
+                        reindex_after=reindex, pk_guard=pk_guard,
                     )
                     return tstates
 

@@ -1,0 +1,629 @@
+"""Golden corpus: reference query/table/IndexTableTestCase.java (data-level
+translation: queries, event sequences, expected rows). @Index tables keep
+duplicates (inserts never drop; updates/deletes hit every match), unlike
+@PrimaryKey tables. Test 34 (perf race asserting indexed sends are faster)
+is not a behavioral contract and is not translated."""
+
+from __future__ import annotations
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+from tests.test_golden_pktable_ref import eq, eq_unsorted, run
+
+S3 = (
+    "define stream StockStream (symbol string, price float, volume long); "
+    "define stream CheckStockStream (symbol string, volume long); "
+    "define stream UpdateStockStream (symbol string, price float, volume long);"
+)
+S3D = (
+    "define stream StockStream (symbol string, price float, volume long); "
+    "define stream CheckStockStream (symbol string, volume long); "
+    "define stream DeleteStockStream (symbol string, price float, volume long);"
+)
+
+
+class TestIndexTableGolden:
+    def test1_index_join_equality(self):
+        ql = S3 + """@Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, nrem = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq(ins, [("IBM", 100), ("WSO2", 100)])
+        assert nrem == 0
+
+    def test2_index_join_not_equal(self):
+        ql = S3 + """@Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol!=StockTable.symbol
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("GOOG", 100)),
+        ], "query2")
+        eq_unsorted(ins, [("GOOG", "IBM", 100), ("GOOG", "WSO2", 100)])
+
+    def test3_index_join_greater(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume > StockTable.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("FOO", 60)),
+        ], "query2")
+        eq_unsorted(ins[:2], [("IBM", "GOOG", 50), ("IBM", "ABC", 70)])
+        eq_unsorted(ins[2:], [("FOO", "GOOG", 50)])
+
+    def test4_index_join_less(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume < CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 200)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "ABC", 70), ("IBM", "GOOG", 50)])
+
+    def test5_index_join_less_equal(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume <= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 70)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "ABC", 70), ("IBM", "GOOG", 50)])
+
+    def test6_index_join_table_greater(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume > CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 50)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "WSO2", 200), ("IBM", "ABC", 70)])
+
+    def test7_index_join_table_greater_equal(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume >= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 70)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "ABC", 70), ("IBM", "WSO2", 200)])
+
+    def test8_index_insert_keeps_duplicates(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume >= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("FOO", 50.6, 200)),
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 70)),
+        ], "query2")
+        eq_unsorted(
+            ins,
+            [("IBM", "ABC", 70), ("IBM", "WSO2", 200), ("IBM", "FOO", 200)],
+        )
+
+    def test9_index_update_equality(self):
+        ql = S3 + """@Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        update StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("UpdateStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query3")
+        eq(ins, [("IBM", 100), ("WSO2", 100), ("IBM", 200), ("WSO2", 100)])
+
+    def test10_index_update_not_equal_rekeys(self):
+        ql = S3 + """@Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        update StockTable on StockTable.symbol!=symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol!=StockTable.symbol
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("UpdateStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query3")
+        # the WSO2 row is fully rewritten to (IBM, 77.6, 200) — no pk guard
+        eq(ins[:2], [("WSO2", 100), ("IBM", 100)])
+        eq_unsorted(ins[2:], [("IBM", 200), ("IBM", 100)])
+
+    def test11_index_update_le_applies_to_all(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume <= volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume >= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 200)),
+            ("UpdateStockStream", ("FOO", 77.6, 200)),
+            ("CheckStockStream", ("BAR", 200)),
+        ], "query3")
+        eq_unsorted(ins[:2], [(55.6, 200), (55.6, 100)])
+        eq_unsorted(ins[2:], [(77.6, 200), (77.6, 200)])
+
+    def test12_index_update_lt(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume < volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume >= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 200)),
+            ("UpdateStockStream", ("FOO", 77.6, 200)),
+            ("CheckStockStream", ("BAR", 200)),
+        ], "query3")
+        eq_unsorted(ins[:2], [(55.6, 200), (55.6, 100)])
+        eq_unsorted(ins[2:], [(77.6, 200), (55.6, 200)])
+
+    def test13_index_update_ge(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume >= volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume <= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 200)),
+            ("UpdateStockStream", ("FOO", 77.6, 200)),
+            ("CheckStockStream", ("BAR", 200)),
+        ], "query3")
+        eq(ins, [(55.6, 200), (77.6, 200)])
+
+    def test14_index_update_gt(self):
+        ql = S3 + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume > volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume <= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 150)),
+            ("UpdateStockStream", ("FOO", 77.6, 150)),
+            ("CheckStockStream", ("BAR", 150)),
+        ], "query3")
+        eq(ins, [(55.6, 200), (77.6, 150)])
+
+    def test15_index_delete_equality(self):
+        ql = S3D + """@Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 100)])
+        eq(ins[2:], [("WSO2", 100)])
+
+    def test16_index_delete_not_equal(self):
+        ql = S3D + """@Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.symbol!=symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 100)])
+        eq(ins[2:], [("IBM", 100)])
+
+    def test17_index_delete_table_gt(self):
+        ql = S3D + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume>volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 150)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 200)])
+        eq(ins[2:], [("IBM", 100)])
+
+    def test18_index_delete_table_ge(self):
+        ql = S3D + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume>=volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 200)])
+        eq(ins[2:], [("IBM", 100)])
+
+    def test19_index_delete_table_lt(self):
+        ql = S3D + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume < volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 150)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 200)])
+        eq(ins[2:], [("WSO2", 200)])
+
+    def test20_index_delete_table_le(self):
+        ql = S3D + """@Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume <= volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 150)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:3], [("IBM", 100), ("BAR", 150), ("WSO2", 200)])
+        eq(ins[3:], [("WSO2", 200)])
+
+    def test21_index_in_condition_eq(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(symbol==StockTable.symbol) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq_unsorted(ins, [("WSO2", 100)])
+
+    def test22_index_in_condition_ne(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(symbol!=StockTable.symbol) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 100), ("WSO2", 100)])
+
+    def test23_index_in_condition_gt(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(volume > StockTable.volume) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 170)),
+            ("CheckStockStream", ("FOO", 500)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 170), ("FOO", 500)])
+
+    def test24_index_in_condition_lt(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(volume < StockTable.volume) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 170)),
+            ("CheckStockStream", ("FOO", 500)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 170)])
+
+    def test25_index_in_condition_le(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(volume <= StockTable.volume) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 170)),
+            ("CheckStockStream", ("FOO", 200)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 170), ("FOO", 200)])
+
+    def test26_index_in_condition_ge(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(volume >= StockTable.volume) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 170)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 170), ("FOO", 100)])
+
+    def test27_index_left_outer_join_upsert(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long, price float);
+        define stream UpdateStockStream (comp string, vol long);
+        @Index('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from UpdateStockStream left outer join StockTable
+        on UpdateStockStream.comp == StockTable.symbol
+        select comp as symbol, ifThenElse(price is null,0f,price) as price, vol as volume
+        update or insert into StockTable
+        on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol==StockTable.symbol and volume==StockTable.volume
+         and price==StockTable.price) in StockTable]
+        insert into OutStream;"""
+        ins, nrem = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100, 155.6)),
+            ("CheckStockStream", ("WSO2", 100, 155.6)),
+            ("UpdateStockStream", ("IBM", 200)),
+            ("UpdateStockStream", ("WSO2", 300)),
+            ("CheckStockStream", ("IBM", 200, 0.0)),
+            ("CheckStockStream", ("WSO2", 300, 55.6)),
+        ], "query3")
+        eq(ins, [("IBM", 200, 0.0), ("WSO2", 300, 55.6)])
+        assert nrem == 0
+
+    def test28_multi_index_join(self):
+        ql = S3 + """@Index('price','volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq(ins, [("IBM", 100), ("WSO2", 100)])
+
+    def test29_multi_index_join_other_attr(self):
+        ql = S3 + """@Index('symbol', 'volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq(ins, [("IBM", 100), ("WSO2", 100)])
+
+    def test30_index_empty_attr_rejected(self):
+        with pytest.raises(SiddhiAppCreationError):
+            SiddhiManager().create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @Index('')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)
+
+    def test31_index_duplicate_attr_rejected(self):
+        with pytest.raises(SiddhiAppCreationError):
+            SiddhiManager().create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @Index('symbol', 'symbol')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)
+
+    def test32_index_duplicate_annotation_rejected(self):
+        with pytest.raises(SiddhiAppCreationError):
+            SiddhiManager().create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @Index('symbol')
+            @Index('volume')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)
+
+    def test33_index_unknown_attr_rejected(self):
+        with pytest.raises(SiddhiAppCreationError):
+            SiddhiManager().create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @Index('foo')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)

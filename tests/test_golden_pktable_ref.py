@@ -1,0 +1,766 @@
+"""Golden corpus: reference query/table/PrimaryKeyTableTestCase.java (data-level
+translation: queries, event sequences, expected rows). Tests 28/29/31/32/33 are
+definition-error tests (asserted as creation/parse errors here); test 30 does
+not exist in the reference; test 35 is a wall-clock performance race (asserts
+indexed sends are faster than unindexed — not a behavioral contract) and is
+not translated."""
+
+from __future__ import annotations
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.errors import SiddhiAppCreationError, SiddhiParserError
+
+S3 = (
+    "define stream StockStream (symbol string, price float, volume long); "
+    "define stream CheckStockStream (symbol string, volume long); "
+    "define stream UpdateStockStream (symbol string, price float, volume long);"
+)
+S3D = (
+    "define stream StockStream (symbol string, price float, volume long); "
+    "define stream CheckStockStream (symbol string, volume long); "
+    "define stream DeleteStockStream (symbol string, price float, volume long);"
+)
+
+
+def run(ql, sends, query_name):
+    """sends: [(stream, row), ...] in order; returns (ins, removed_count)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    ins, rem = [], []
+    rt.add_callback(
+        query_name,
+        lambda ts, i, r: (
+            ins.extend(tuple(e.data) for e in i or []),
+            rem.extend(tuple(e.data) for e in r or []),
+        ),
+    )
+    rt.start()
+    hs = {}
+    for stream, row in sends:
+        hs.setdefault(stream, rt.get_input_handler(stream)).send(row)
+    rt.shutdown()
+    mgr.shutdown()
+    return ins, len(rem)
+
+
+def eq(got, expected):
+    assert len(got) == len(expected), (got, expected)
+    for g, e in zip(got, expected):
+        assert len(g) == len(e), (g, e)
+        for a, b in zip(g, e):
+            if isinstance(b, float):
+                assert a is not None and abs(a - b) < 1e-3, (got, expected)
+            else:
+                assert a == b, (got, expected)
+
+
+def eq_unsorted(got, expected):
+    eq(sorted(got, key=str), sorted(expected, key=str))
+
+
+class TestPrimaryKeyTableGolden:
+    def test1_pk_join_equality(self):
+        ql = S3 + """@PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, nrem = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("StockStream", ("IBM", 56.6, 200)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq(ins, [("IBM", 100), ("WSO2", 100)])
+        assert nrem == 0
+
+    def test2_pk_join_not_equal(self):
+        ql = S3 + """@PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol!=StockTable.symbol
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, nrem = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("GOOG", 100)),
+        ], "query2")
+        eq_unsorted(ins, [("GOOG", "IBM", 100), ("GOOG", "WSO2", 100)])
+        assert nrem == 0
+
+    def test3_pk_join_greater(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume > StockTable.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, nrem = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("FOO", 60)),
+        ], "query2")
+        eq_unsorted(ins[:2], [("IBM", "GOOG", 50), ("IBM", "ABC", 70)])
+        eq_unsorted(ins[2:], [("FOO", "GOOG", 50)])
+        assert nrem == 0
+
+    def test4_pk_join_less(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume < CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 200)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "ABC", 70), ("IBM", "GOOG", 50)])
+
+    def test5_pk_join_less_equal(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume <= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 70)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "ABC", 70), ("IBM", "GOOG", 50)])
+
+    def test6_pk_join_table_greater(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume > CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 50)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "WSO2", 200), ("IBM", "ABC", 70)])
+
+    def test7_pk_join_table_greater_equal(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume >= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 70)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "ABC", 70), ("IBM", "WSO2", 200)])
+
+    def test8_pk_update_or_insert_overwrites(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream
+        update or insert into StockTable
+        on volume == StockTable.volume ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on StockTable.volume >= CheckStockStream.volume
+        select CheckStockStream.symbol, StockTable.symbol as tableSymbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("FOO", 50.6, 200)),
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("GOOG", 50.6, 50)),
+            ("StockStream", ("ABC", 5.6, 70)),
+            ("CheckStockStream", ("IBM", 70)),
+        ], "query2")
+        eq_unsorted(ins, [("IBM", "ABC", 70), ("IBM", "WSO2", 200)])
+
+    def test9_pk_update_equality(self):
+        ql = S3 + """@PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        update StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("UpdateStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query3")
+        eq(ins, [("IBM", 100), ("WSO2", 100), ("IBM", 200), ("WSO2", 100)])
+
+    def test10_pk_update_not_equal(self):
+        ql = S3 + """@PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        update StockTable on StockTable.symbol!=symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol!=StockTable.symbol
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("UpdateStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query3")
+        # update on symbol != "IBM" sets WSO2's row to (WSO2?, ...) — the
+        # update writes price/volume from the update stream; volume becomes
+        # 200 for WSO2. Reference expects [WSO2 100, IBM 100, IBM 100]:
+        # the first two from the pre-update checks, the last from the
+        # post-update check (WSO2's row was updated to volume 200? no — the
+        # reference updates ALL attrs incl. symbol=IBM: WSO2 row becomes IBM
+        # 200; check !=WSO2 then matches IBM rows only; order: IBM(orig).
+        eq(ins, [("WSO2", 100), ("IBM", 100), ("IBM", 100)])
+
+    def test11_pk_update_le_nonkey_select(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume <= volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume >= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 200)),
+            ("UpdateStockStream", ("FOO", 77.6, 200)),
+            ("CheckStockStream", ("BAR", 200)),
+        ], "query3")
+        # update selects only (price, volume): both rows get price 77.6?
+        # No — reference expected keeps 55.6 for both checks: the update's
+        # condition params are non-updatable (see reference //Todo) and the
+        # update matched rows get price 77.6 and volume 200 — but expected2
+        # still shows 55.6: the reference treats this shape as a no-op.
+        eq_unsorted(ins[:2], [(55.6, 200), (55.6, 100)])
+        eq_unsorted(ins[2:], [(55.6, 200), (55.6, 100)])
+
+    def test12_pk_update_lt_nonkey_select(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume < volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume >= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 200)),
+            ("UpdateStockStream", ("FOO", 77.6, 200)),
+            ("CheckStockStream", ("BAR", 200)),
+        ], "query3")
+        eq_unsorted(ins[:2], [(55.6, 200), (55.6, 100)])
+        eq_unsorted(ins[2:], [(55.6, 200), (55.6, 100)])
+
+    def test13_pk_update_ge(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume >= volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume <= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 200)),
+            ("UpdateStockStream", ("FOO", 77.6, 200)),
+            ("CheckStockStream", ("BAR", 200)),
+        ], "query3")
+        eq(ins, [(55.6, 200), (77.6, 200)])
+
+    def test14_pk_update_gt(self):
+        ql = S3 + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from UpdateStockStream
+        select price, volume
+        update StockTable on StockTable.volume > volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        on CheckStockStream.volume <= StockTable.volume
+        select StockTable.price, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 150)),
+            ("UpdateStockStream", ("FOO", 77.6, 150)),
+            ("CheckStockStream", ("BAR", 150)),
+        ], "query3")
+        eq(ins, [(55.6, 200), (77.6, 150)])
+
+    def test15_pk_delete_equality(self):
+        ql = S3D + """@PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 100)])
+        eq(ins[2:], [("WSO2", 100)])
+
+    def test16_pk_delete_not_equal(self):
+        ql = S3D + """@PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.symbol!=symbol;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 100)])
+        eq(ins[2:], [("IBM", 100)])
+
+    def test17_pk_delete_table_gt(self):
+        ql = S3D + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume>volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 150)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 200)])
+        eq(ins[2:], [("IBM", 100)])
+
+    def test18_pk_delete_table_ge(self):
+        ql = S3D + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume>=volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 200)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 200)])
+        eq(ins[2:], [("IBM", 100)])
+
+    def test19_pk_delete_table_lt(self):
+        ql = S3D + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume < volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 150)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:2], [("IBM", 100), ("WSO2", 200)])
+        eq(ins[2:], [("WSO2", 200)])
+
+    def test20_pk_delete_table_le(self):
+        ql = S3D + """@PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2') from DeleteStockStream
+        delete StockTable on StockTable.volume <= volume;
+        @info(name = 'query3')
+        from CheckStockStream join StockTable
+        select StockTable.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+            ("DeleteStockStream", ("IBM", 77.6, 150)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query3")
+        eq_unsorted(ins[:3], [("IBM", 100), ("BAR", 150), ("WSO2", 200)])
+        eq(ins[3:], [("WSO2", 200)])
+
+    def test21_pk_in_condition_eq(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(symbol==StockTable.symbol) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq_unsorted(ins, [("WSO2", 100)])
+
+    def test22_pk_in_condition_ne(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(symbol!=StockTable.symbol) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 100)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 100), ("WSO2", 100)])
+
+    def test23_pk_in_condition_gt(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(volume > StockTable.volume) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 170)),
+            ("CheckStockStream", ("FOO", 500)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 170), ("FOO", 500)])
+
+    def test24_pk_in_condition_lt(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(volume < StockTable.volume) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 170)),
+            ("CheckStockStream", ("FOO", 500)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 170)])
+
+    def test25_pk_in_condition_le(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(volume <= StockTable.volume) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 170)),
+            ("CheckStockStream", ("FOO", 200)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 170), ("FOO", 200)])
+
+    def test26_pk_in_condition_ge(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long);
+        @PrimaryKey('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream[(volume >= StockTable.volume) in StockTable]
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 200)),
+            ("StockStream", ("BAR", 55.6, 150)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("CheckStockStream", ("FOO", 170)),
+            ("CheckStockStream", ("FOO", 100)),
+        ], "query2")
+        eq_unsorted(ins, [("FOO", 170), ("FOO", 100)])
+
+    def test27_pk_left_outer_join_upsert(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, volume long, price float);
+        define stream UpdateStockStream (comp string, vol long);
+        @PrimaryKey('symbol')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from UpdateStockStream left outer join StockTable
+        on UpdateStockStream.comp == StockTable.symbol
+        select comp as symbol, ifThenElse(price is null,0f,price) as price, vol as volume
+        update or insert into StockTable
+        on StockTable.symbol==symbol;
+        @info(name = 'query3')
+        from CheckStockStream[(symbol==StockTable.symbol and volume==StockTable.volume
+         and price==StockTable.price) in StockTable]
+        insert into OutStream;"""
+        ins, nrem = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("CheckStockStream", ("IBM", 100, 155.6)),
+            ("CheckStockStream", ("WSO2", 100, 155.6)),
+            ("UpdateStockStream", ("IBM", 200)),
+            ("UpdateStockStream", ("WSO2", 300)),
+            ("CheckStockStream", ("IBM", 200, 0.0)),
+            ("CheckStockStream", ("WSO2", 300, 55.6)),
+        ], "query3")
+        eq(ins, [("IBM", 200, 0.0), ("WSO2", 300, 55.6)])
+        assert nrem == 0
+
+    def test28_pk_unknown_attribute_rejected(self):
+        with pytest.raises(SiddhiAppCreationError):
+            mgr = SiddhiManager()
+            mgr.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey('symbol1')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)
+
+    def test29_pk_empty_annotation_rejected(self):
+        with pytest.raises((SiddhiAppCreationError, SiddhiParserError)):
+            mgr = SiddhiManager()
+            mgr.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey()
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)
+
+    def test31_pk_duplicate_annotation_rejected(self):
+        with pytest.raises((SiddhiAppCreationError, SiddhiParserError)):
+            mgr = SiddhiManager()
+            mgr.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey('symbol')
+            @PrimaryKey('price')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)
+
+    def test32_pk_malformed_annotation_rejected(self):
+        with pytest.raises((SiddhiAppCreationError, SiddhiParserError)):
+            mgr = SiddhiManager()
+            mgr.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey'symbol'
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)
+
+    def test33_pk_case_sensitive_attribute_rejected(self):
+        with pytest.raises((SiddhiAppCreationError, SiddhiParserError)):
+            mgr = SiddhiManager()
+            mgr.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            @PrimaryKey ('Symbol')
+            define table StockTable (symbol string, price float, volume long);
+            @info(name = 'query1') from StockStream insert into StockTable ;
+            """)
+
+    def test36_composite_pk_join_both_keys(self):
+        ql = S3 + """@PrimaryKey('symbol','volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol and CheckStockStream.volume==StockTable.volume
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("StockStream", ("IBM", 56.6, 200)),
+            ("CheckStockStream", ("IBM", 200)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq(ins, [("IBM", 200), ("WSO2", 100)])
+
+    def test37_composite_pk_join_one_key(self):
+        ql = S3 + """@PrimaryKey('symbol','volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("StockStream", ("IBM", 56.6, 200)),
+            ("CheckStockStream", ("IBM", 200)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq(ins, [("IBM", 100), ("IBM", 200), ("WSO2", 100)])
+
+    def test38_composite_pk_join_with_constant(self):
+        ql = S3 + """@PrimaryKey('symbol','volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on (CheckStockStream.symbol==StockTable.symbol and CheckStockStream.volume==StockTable.volume) and
+         55.6f == StockTable.price
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 101)),
+            ("StockStream", ("IBM", 55.6, 102)),
+            ("StockStream", ("IBM", 55.6, 200)),
+            ("CheckStockStream", ("IBM", 200)),
+            ("CheckStockStream", ("WSO2", 100)),
+        ], "query2")
+        eq(ins, [("IBM", 200), ("WSO2", 100)])
+
+    def test39_composite_pk_join_three_conditions(self):
+        ql = """define stream StockStream (symbol string, price float, volume long);
+        define stream CheckStockStream (symbol string, price float, volume long);
+        @PrimaryKey('symbol','volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from CheckStockStream join StockTable
+        on CheckStockStream.symbol==StockTable.symbol and CheckStockStream.volume==StockTable.volume and
+         CheckStockStream.price == StockTable.price
+        select CheckStockStream.symbol, StockTable.volume
+        insert into OutStream;"""
+        ins, _ = run(ql, [
+            ("StockStream", ("WSO2", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 100)),
+            ("StockStream", ("IBM", 55.6, 101)),
+            ("StockStream", ("IBM", 55.6, 102)),
+            ("StockStream", ("IBM", 55.6, 200)),
+            ("CheckStockStream", ("IBM", 55.6, 200)),
+            ("CheckStockStream", ("WSO2", 55.6, 100)),
+        ], "query2")
+        eq(ins, [("IBM", 200), ("WSO2", 100)])
+
+    def test47_pk_table_side_join_group_by(self):
+        # reference persistenceTest47 (same file): table-side join driving a
+        # group-by with PK dedupe — WSO2-1/IBM-1 rows keep their PK'd values
+        ql = """define stream StockStream (symbol2 string, price float, volume long);
+        define stream CheckStockStream (symbol1 string);
+        @PrimaryKey('symbol2')
+        define table StockTable (symbol2 string, price float, volume long);
+        @info(name = 'query1') from StockStream insert into StockTable ;
+        @info(name = 'query2')
+        from StockTable join CheckStockStream
+        on symbol2 == symbol1
+        select symbol2 as symbol1, volume as TB
+        group by symbol2
+        insert all events into OutStream;"""
+        sends = []
+        for i in range(10):
+            sends.append(("StockStream", (f"WSO2-{i}", 55.6, 180 + i)))
+        for i in range(10):
+            sends.append(("StockStream", (f"IBM-{i}", 55.6, 100 + i)))
+        sends += [
+            ("StockStream", ("WSO2-11", 100.6, 180)),
+            ("StockStream", ("IBM-11", 100.6, 100)),
+            ("StockStream", ("WSO2-12", 8.6, 13)),
+            ("StockStream", ("IBM-12", 7.6, 14)),
+            ("CheckStockStream", ("IBM-1",)),
+            ("CheckStockStream", ("WSO2-1",)),
+        ]
+        ins, _ = run(ql, sends, "query2")
+        eq(ins, [("IBM-1", 101), ("WSO2-1", 181)])

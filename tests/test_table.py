@@ -222,7 +222,10 @@ class TestJoinTable:
 
 
 class TestPrimaryKey:
-    def test_primary_key_overwrites(self):
+    def test_primary_key_insert_drops_duplicates(self):
+        # insert keeps the FIRST row per key (reference:
+        # IndexEventHolder.add putIfAbsent drops + logs duplicates;
+        # `update or insert into` is the overwriting form)
         mgr, rt = build("""
         define stream StockStream (symbol string, price float, volume long);
         @PrimaryKey('symbol')
@@ -235,12 +238,12 @@ class TestPrimaryKey:
         h.send(("WSO2", 57.5, 200), timestamp=3)
         rows = rt.query("from StockTable select *")
         assert sorted(e.data for e in rows) == [
-            ("IBM", 75.5, 10), ("WSO2", 57.5, 200)
+            ("IBM", 75.5, 10), ("WSO2", 55.5, 100)
         ]
         rt.shutdown()
         mgr.shutdown()
 
-    def test_primary_key_same_batch_dedupe(self):
+    def test_primary_key_same_batch_dedupe_first_wins(self):
         mgr, rt = build("""
         @app:batch(size='8')
         define stream StockStream (symbol string, price float, volume long);
@@ -255,7 +258,7 @@ class TestPrimaryKey:
         )
         rows = rt.query("from StockTable select *")
         assert sorted(e.data for e in rows) == [
-            ("IBM", 75.5, 10), ("WSO2", 57.5, 200)
+            ("IBM", 75.5, 10), ("WSO2", 55.5, 100)
         ]
         rt.shutdown()
         mgr.shutdown()
