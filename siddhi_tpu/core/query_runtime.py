@@ -504,6 +504,19 @@ class BaseQueryRuntime:
                 (ts, kind, data, int(keys[i]) if keys is not None else None)
                 for i, (ts, kind, data) in enumerate(rows)
             ]
+            # only the kinds this query OUTPUTS enter the limiter — an
+            # un-requested EXPIRED row must not consume a chunk slot or
+            # shadow a group's held row (reference: the selector's
+            # currentOn/expiredOn gate sits before OutputRateLimiter)
+            want = self.output_events
+            if want is OutputEventsFor.CURRENT:
+                rows4 = [r for r in rows4 if r[1] == KIND_CURRENT]
+            elif want is OutputEventsFor.EXPIRED:
+                rows4 = [r for r in rows4 if r[1] == KIND_EXPIRED]
+            else:
+                rows4 = [
+                    r for r in rows4 if r[1] in (KIND_CURRENT, KIND_EXPIRED)
+                ]
             released = self.rate_limiter.process(rows4, now)
             self._deliver(released, now)
             return

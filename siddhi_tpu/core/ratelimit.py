@@ -69,20 +69,27 @@ class EventFirstLimiter(RateLimiter):
         self.grouped = grouped
         self.count = 0
         self.seen: set = set()
+        self.held: list = []  # grouped: firsts buffered until chunk close
 
     def process(self, rows, now):
         out = []
         for r in rows:
             if self.grouped:
+                # the grouped form BUFFERS each group's first and releases
+                # the batch when the chunk closes (reference:
+                # FirstGroupByPerEventOutputRateLimiter.process collects into
+                # allComplexEventChunk and sends at counter == value)
                 if r[3] not in self.seen:
                     self.seen.add(r[3])
-                    out.append(r)
+                    self.held.append(r)
             elif self.count == 0:
                 out.append(r)
             self.count += 1
             if self.count == self.n:
                 self.count = 0
                 self.seen.clear()
+                out.extend(self.held)
+                self.held.clear()
         return out
 
 

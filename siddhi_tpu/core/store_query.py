@@ -84,6 +84,12 @@ class StoreQueryRuntime:
                         parse_within_value(w1.value)[0],
                         parse_within_value(w2.value)[0],
                     )
+                if self.within[0] >= self.within[1]:
+                    # reference: StoreQueryCreationException when the within
+                    # range is empty/inverted
+                    raise SiddhiAppCreationError(
+                        "'within' start time must be before the end time"
+                    )
             source_schema = self.aggregation.out_schema
             table = self.aggregation
         elif self.no_from:

@@ -424,6 +424,7 @@ class BatchWindow(WindowStage):
         time_attr: Optional[str] = None,
         use_scheduler: bool = False,
         start_time: Optional[int] = None,
+        timeout_ms: Optional[int] = None,
     ):
         if (length is None) == (duration_ms is None):
             raise SiddhiAppCreationError("batch window needs length xor duration")
@@ -433,7 +434,12 @@ class BatchWindow(WindowStage):
         self.n = length
         self.t = duration_ms
         self.time_attr = time_attr
-        self.needs_scheduler = use_scheduler
+        # externalTimeBatch idle timeout: a WALL-CLOCK deadline re-armed on
+        # every event; a TIMER arriving with a nonempty open bucket force-
+        # closes it (reference: ExternalTimeBatchWindowProcessor timeout
+        # scheduling, lines 243-258)
+        self.timeout_ms = timeout_ms
+        self.needs_scheduler = use_scheduler or timeout_ms is not None
         self.start_time = start_time
 
     def init_state(self):
@@ -451,6 +457,11 @@ class BatchWindow(WindowStage):
             "prev_n": jnp.zeros((), jnp.int32),
             # open-bucket start time (timeBatch family); -1 = no bucket yet
             "bucket_start": jnp.full((), -1, jnp.int64),
+            # externalTimeBatch idle timeout: the latest armed WALL-CLOCK
+            # deadline; a TIMER flushes only when it has genuinely elapsed
+            # (the scheduler cannot extend a pending deadline, so stale
+            # early timers must be ignored here)
+            "timeout_deadline": jnp.full((), NO_TIMER, jnp.int64),
         }
 
     def apply(self, state, flow: Flow):
@@ -503,12 +514,35 @@ class BatchWindow(WindowStage):
             F = bsz  # time-driven flush count is bounded only by trigger rows
             rel = jnp.maximum(bwts - start0, 0)
             g = jnp.where(trigger_ok & (start0 >= 0), rel // self.t, np.int64(0))
-            open_g = _cummax(g)
-            prev_open = jnp.concatenate([jnp.zeros((1,), jnp.int64), open_g[:-1]])
+            # the open bucket's index carries ACROSS batches: with an
+            # explicit start time, start0 is a constant, so the first row of
+            # every batch would otherwise compare against bucket 0 and flush
+            # spuriously (for first-event starts, bucket_start == start0 and
+            # the carried index is 0 — unchanged)
+            carried_g = jnp.where(
+                state["bucket_start"] >= 0,
+                jnp.maximum(state["bucket_start"] - start0, 0) // self.t,
+                np.int64(0),
+            )
+            open_g = _cummax(jnp.maximum(g, carried_g))
+            prev_open = jnp.concatenate([carried_g[None], open_g[:-1]])
             had_bucket = (state["bucket_start"] >= 0) | (
                 jnp.cumsum(trigger_ok.astype(jnp.int32)) - trigger_ok.astype(jnp.int32) > 0
             )
             flush_here = trigger_ok & (g > prev_open) & had_bucket
+            if self.timeout_ms is not None:
+                # an ELAPSED idle-timeout TIMER force-closes a nonempty open
+                # bucket WITHOUT advancing the bucket grid: later events whose
+                # external time falls in the same grid bucket open a fresh
+                # bucket there (reference: ExternalTimeBatchWindowProcessor
+                # clears currentEventChunk but keeps endTime)
+                timeout_flush = (
+                    is_timer
+                    & (cur_n0 > 0)
+                    & (jnp.asarray(flow.now, jnp.int64)
+                       >= state["timeout_deadline"])
+                )
+                flush_here = flush_here | timeout_flush
             e_row = jnp.cumsum(flush_here.astype(jnp.int32))  # inclusive: flush at i precedes row i
             n_flush = flush_here.sum(dtype=jnp.int32)
             row_of_flush = jnp.where(
@@ -713,10 +747,31 @@ class BatchWindow(WindowStage):
             "prev_ts": place_prev(state["prev_ts"], state["cur_ts"], b.ts),
             "prev_n": new_prev_n,
             "bucket_start": new_bucket_start,
+            "timeout_deadline": state["timeout_deadline"],
         }
 
         aux = dict(flow.aux)
-        if self.needs_scheduler and self.t is not None:
+        if self.timeout_ms is not None:
+            # wall-clock idle deadline: every arriving CURRENT event pushes
+            # it forward; with an empty open bucket there is none. A stale
+            # timer (armed before the push) re-arms the true deadline via
+            # next_timer below.
+            now64 = jnp.asarray(flow.now, jnp.int64)
+            new_state["timeout_deadline"] = jnp.where(
+                valid_cur.any(),
+                now64 + self.timeout_ms,
+                jnp.where(
+                    new_state["cur_n"] > 0,
+                    state["timeout_deadline"],
+                    np.int64(NO_TIMER),
+                ),
+            )
+            aux["next_timer"] = jnp.where(
+                new_state["cur_n"] > 0,
+                new_state["timeout_deadline"],
+                np.int64(NO_TIMER),
+            )
+        elif self.needs_scheduler and self.t is not None:
             aux["next_timer"] = jnp.where(
                 new_state["bucket_start"] >= 0,
                 new_state["bucket_start"] + self.t,
@@ -792,9 +847,13 @@ def make_window(
         scope.record_key((ref, None, attr))
         t = _const_param(spec, 1, "duration")
         start = _const_param(spec, 2, "start time") if len(spec.parameters) > 2 else None
+        timeout = (
+            _const_param(spec, 3, "timeout")
+            if len(spec.parameters) > 3 else None
+        )
         return BatchWindow(
             schema, ref, capacity=time_capacity, duration_ms=t, time_attr=attr,
-            start_time=start,
+            start_time=start, timeout_ms=timeout,
         )
     if name == "sort":
         from siddhi_tpu.core.windows_special import SortWindow

@@ -118,3 +118,216 @@ def test_aggregation_minute_rollup():
     ])
     rt.shutdown()
     mgr.shutdown()
+
+
+# --- round-5 additions: AggregationTestCase 1-4, 20, 23-24, 26-35 ----------
+
+STOCK2 = (
+    "define stream stockStream (symbol string, price float, "
+    "lastClosingPrice float, volume long , quantity int, timestamp long);"
+)
+
+SENDS_CISCO = [
+    ("WSO2", 50.0, 60.0, 90, 6, 1496289950000),
+    ("WSO2", 70.0, None, 40, 10, 1496289950000),
+    ("WSO2", 60.0, 44.0, 200, 56, 1496289952000),
+    ("WSO2", 100.0, None, 200, 16, 1496289952000),
+    ("IBM", 100.0, None, 200, 26, 1496289954000),
+    ("IBM", 100.0, None, 200, 96, 1496289954000),
+    ("CISCO", 100.0, None, 200, 26, 1513578087000),
+    ("CISCO", 100.0, None, 200, 96, 1513578087000),
+]
+
+AGG_HOUR = STOCK2 + """
+define aggregation stockAggregation
+from stockStream
+select symbol, avg(price) as avgPrice, sum(price) as totalPrice,
+       (price * quantity) as lastTradeValue
+group by symbol
+aggregate by timestamp every sec...hour ;
+"""
+
+
+def _agg_runtime(ql=AGG_HOUR, sends=SENDS_CISCO):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("stockStream")
+    for row in sends:
+        h.send(row)
+    return mgr, rt
+
+
+def test_agg1_creation_arrival_range():
+    # incrementalStreamProcessorTest1: sec ... min by an explicit attribute
+    mgr = SiddhiManager()
+    mgr.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, price float,"
+        " volume int); define aggregation stockAggregation from stockStream"
+        " select sum(price) as sumPrice aggregate by arrival every sec ... min"
+    )
+
+
+def test_agg2_creation_event_time_range():
+    # test2: range form without an explicit timestamp attribute
+    mgr = SiddhiManager()
+    mgr.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, price float,"
+        " volume int); define aggregation stockAggregation from stockStream"
+        " select sum(price) as sumPrice aggregate every sec ... min"
+    )
+
+
+def test_agg3_creation_duration_list():
+    # test3: explicit duration list + group by
+    mgr = SiddhiManager()
+    mgr.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, price float,"
+        " volume int); define aggregation stockAggregation from stockStream"
+        " select sum(price) as sumPrice group by price"
+        " aggregate every sec, min, hour, day"
+    )
+
+
+def test_agg4_creation_composite_group():
+    # test4: composite group-by key
+    mgr = SiddhiManager()
+    mgr.create_siddhi_app_runtime(
+        "define stream stockStream (arrival long, symbol string, price float,"
+        " volume int); define aggregation stockAggregation from stockStream"
+        " select sum(price) as sumPrice group by price, volume"
+        " aggregate every sec, min, hour, day"
+    )
+
+
+def test_agg23_store_query_on_condition():
+    # test23: on-filter + within wildcard + projection
+    mgr, rt = _agg_runtime(sends=SENDS_CISCO[:6])
+    events = rt.query(
+        'from stockAggregation on symbol=="IBM" '
+        'within "2017-06-** **:**:**" per "seconds" select symbol, avgPrice'
+    )
+    rows = [tuple(e.data) for e in events]
+    assert rows == [("IBM", 100.0)], rows
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg24_store_query_all_groups():
+    # test24: three second-buckets across the two symbols
+    mgr, rt = _agg_runtime(sends=SENDS_CISCO[:6])
+    events = rt.query(
+        'from stockAggregation within "2017-06-** **:**:**" per "seconds"'
+    )
+    assert len(events) == 3, [tuple(e.data) for e in events]
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg27_numeric_per_rejected():
+    # test27: `per 1000` is not a duration string
+    import pytest
+
+    mgr, rt = _agg_runtime(sends=[])
+    with pytest.raises(Exception):
+        rt.query('from stockAggregation within "2017-06-** **:**:**" per 1000')
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg28_inverted_within_rejected():
+    # test28: start after end
+    import pytest
+
+    mgr, rt = _agg_runtime(sends=[])
+    with pytest.raises(Exception):
+        rt.query(
+            'from stockAggregation within "2017-06-02 00:00:00", '
+            '"2017-06-01 00:00:00" per "hours"'
+        )
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg29_malformed_within_rejected():
+    # test29: bad wildcard pattern
+    import pytest
+
+    mgr, rt = _agg_runtime(sends=[])
+    with pytest.raises(Exception):
+        rt.query(
+            'from stockAggregation within "2017-06-** **:**:**:1000" '
+            'per "hours"'
+        )
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg30_partial_wildcard_rejected():
+    # test30: wildcards below a fixed field
+    import pytest
+
+    mgr, rt = _agg_runtime(sends=[])
+    with pytest.raises(Exception):
+        rt.query(
+            'from stockAggregation within "2017-06-** 12:**:**" per "hours"'
+        )
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg31_select_star_four_buckets():
+    # test31: select * over every second bucket (4 across 3 symbols)
+    mgr, rt = _agg_runtime()
+    events = rt.query(
+        'from stockAggregation within "2017-**-** **:**:**" per "seconds" '
+        "select *"
+    )
+    rows = sorted(tuple(e.data) for e in events)
+    assert rows == sorted([
+        (1496289950000, "WSO2", 60.0, 120.0, 700.0),
+        (1496289952000, "WSO2", 80.0, 160.0, 1600.0),
+        (1496289954000, "IBM", 100.0, 200.0, 9600.0),
+        (1513578087000, "CISCO", 100.0, 200.0, 9600.0),
+    ]), rows
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg32_day_wildcard():
+    # test32: a whole-day wildcard matches only CISCO's bucket
+    mgr, rt = _agg_runtime()
+    events = rt.query(
+        'from stockAggregation within "2017-12-18 **:**:**" per "seconds" '
+        "select *"
+    )
+    rows = [tuple(e.data) for e in events]
+    assert rows == [(1513578087000, "CISCO", 100.0, 200.0, 9600.0)], rows
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg33_hour_wildcard():
+    # test33: hour-level wildcard (06 UTC == 11:51 +05:30)
+    mgr, rt = _agg_runtime()
+    events = rt.query(
+        'from stockAggregation within "2017-12-18 06:**:**" per "seconds" '
+        "select *"
+    )
+    rows = [tuple(e.data) for e in events]
+    assert rows == [(1513578087000, "CISCO", 100.0, 200.0, 9600.0)], rows
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_agg34_minute_wildcard():
+    # test34: minute-level wildcard
+    mgr, rt = _agg_runtime()
+    events = rt.query(
+        'from stockAggregation within "2017-12-18 06:21:**" per "seconds" '
+        "select *"
+    )
+    rows = [tuple(e.data) for e in events]
+    assert rows == [(1513578087000, "CISCO", 100.0, 200.0, 9600.0)], rows
+    rt.shutdown()
+    mgr.shutdown()

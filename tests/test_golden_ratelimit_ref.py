@@ -1,0 +1,235 @@
+"""Golden corpus: reference query/ratelimit/EventOutputRateLimitTestCase.java
+(all 16 @Test, data-level translation — event-count-driven limits are
+deterministic) plus deterministic shapes from TimeOutputRateLimitTestCase /
+SnapshotOutputRateLimitTestCase (time-driven limits poll wall clock with
+generous bounds, mirroring the reference's Thread.sleep + waitForEvents)."""
+
+from __future__ import annotations
+
+import time
+
+from siddhi_tpu import SiddhiManager
+
+LOGIN = "define stream LoginEvents (timestamp long, ip string);\n"
+
+
+def run_counts(ql, ips, query_name="query1"):
+    """Send one row per ip; return (in_rows, remove_rows)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    ins, rem = [], []
+    rt.add_callback(
+        query_name,
+        lambda ts, i, r: (
+            ins.extend(tuple(e.data) for e in i or []),
+            rem.extend(tuple(e.data) for e in r or []),
+        ),
+    )
+    rt.start()
+    h = rt.get_input_handler("LoginEvents")
+    for k, ip in enumerate(ips):
+        h.send((1_000_000 + k, ip))
+    rt.shutdown()
+    mgr.shutdown()
+    return ins, rem
+
+
+IPS5 = ["192.10.1.3", "192.10.1.3", "192.10.1.4", "192.10.1.3", "192.10.1.5"]
+IPS8 = ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+        "192.10.1.4", "192.10.1.4", "192.10.1.4", "192.10.1.30"]
+IPS12 = ["192.10.1.5", "192.10.1.3", "192.10.1.3", "192.10.1.9",
+         "192.10.1.3", "192.10.1.4", "192.10.1.4", "192.10.1.4",
+         "192.10.1.30", "192.10.1.31", "192.10.1.32", "192.10.1.33"]
+
+
+class TestEventOutputRateLimitGolden:
+    def test1_all_every_2(self):
+        ins, rem = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output all every 2 events
+        insert into uniqueIps ;""", IPS5)
+        assert len(ins) == 4 and not rem, (ins, rem)
+
+    def test2_default_every_2(self):
+        ins, rem = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output every 2 events
+        insert into uniqueIps ;""", IPS5)
+        assert len(ins) == 4 and not rem, (ins, rem)
+
+    def test3_every_5(self):
+        ins, rem = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output every 5 events
+        insert into uniqueIps ;""", IPS8)
+        assert len(ins) == 5 and not rem, (ins, rem)
+
+    def test4_first_every_2(self):
+        ins, rem = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output first every 2 events
+        insert into uniqueIps ;""", ["192.10.1.5", "192.10.1.3", "192.10.1.9",
+                                     "192.10.1.4", "192.10.1.3"])
+        assert len(ins) == 3 and not rem, (ins, rem)
+        assert all(r[0] in ("192.10.1.5", "192.10.1.9", "192.10.1.3")
+                   for r in ins), ins
+
+    def test5_first_every_3(self):
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output first every 3 events
+        insert into uniqueIps ;""", ["192.10.1.5", "192.10.1.3", "192.10.1.9",
+                                     "192.10.1.4", "192.10.1.3"])
+        assert len(ins) == 2, ins
+
+    def test6_last_every_2(self):
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output last every 2 events
+        insert into uniqueIps ;""", ["192.10.1.3", "192.10.1.5", "192.10.1.3",
+                                     "192.10.1.4", "192.10.1.3"])
+        assert len(ins) == 2, ins
+
+    def test7_last_every_4(self):
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output last every 4 events
+        insert into uniqueIps ;""", ["192.10.1.3", "192.10.1.5", "192.10.1.3",
+                                     "192.10.1.4", "192.10.1.3"])
+        assert len(ins) == 1 and ins[0][0] == "192.10.1.4", ins
+
+    def test8_group_by_first_every_5(self):
+        # per-group FIRST within each 5-event chunk
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip group by ip output first every 5 events
+        insert into uniqueIps ;""", IPS8)
+        assert len(ins) == 4, ins
+
+    def test9_group_by_last_every_5(self):
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip group by ip output last every 5 events
+        insert into uniqueIps ;""", IPS8)
+        assert len(ins) == 4, ins
+
+    def test10_group_by_first_every_5_ten_events(self):
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip group by ip output first every 5 events
+        insert into uniqueIps ;""",
+            ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+             "192.10.1.4", "192.10.1.4", "192.10.1.4", "192.10.1.4",
+             "192.10.1.4", "192.10.1.30"])
+        assert len(ins) == 6, ins
+
+    def test11_group_by_last_every_5_ten_events(self):
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip group by ip output last every 5 events
+        insert into uniqueIps ;""",
+            ["192.10.1.5", "192.10.1.5", "192.10.1.3", "192.10.1.9",
+             "192.10.1.4", "192.10.1.4", "192.10.1.4", "192.10.1.30",
+             "192.10.1.3", "192.10.1.30"])
+        assert len(ins) == 7, ins
+
+    def test12_window_group_by_last_every_5(self):
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents#window.lengthBatch(4)
+        select ip , count() as total group by ip
+        output last every 5 events
+        insert into uniqueIps ;""", IPS12)
+        assert len(ins) == 4, ins
+
+    def test13_window_last_every_2(self):
+        ins, _ = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents#window.lengthBatch(4)
+        select ip , count() as total
+        output last every 2 events
+        insert into uniqueIps ;""", IPS12)
+        assert len(ins) == 1, ins
+
+    def test14_window_last_every_2_expired(self):
+        ins, rem = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents#window.lengthBatch(4)
+        select ip , count() as total
+        output last every 2 events
+        insert expired events into uniqueIps ;""", IPS12)
+        assert not ins and len(rem) == 1, (ins, rem)
+
+    def test15_window_all_every_2_expired(self):
+        ins, rem = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents#window.lengthBatch(4)
+        select ip , count() as total
+        output all every 2 events
+        insert expired events into uniqueIps ;""", IPS12)
+        assert not ins and len(rem) == 2, (ins, rem)
+
+    def test16_window_group_by_all_every_2_expired(self):
+        ins, rem = run_counts(LOGIN + """@info(name = 'query1')
+        from LoginEvents#window.lengthBatch(4)
+        select ip , count() as total group by ip
+        output all every 2 events
+        insert expired events into uniqueIps ;""", IPS12)
+        assert not ins and len(rem) == 4, (ins, rem)
+
+
+class TestTimeSnapshotRateLimitGolden:
+    """Deterministic shapes of TimeOutputRateLimitTestCase /
+    SnapshotOutputRateLimitTestCase: wall-clock-driven flushes are polled
+    with generous bounds (the reference sleeps ~1.2 s and asserts counts)."""
+
+    def _run_timed(self, ql, sends, want, timeout=12.0):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        ins, rem = [], []
+        rt.add_callback(
+            "query1",
+            lambda ts, i, r: (
+                ins.extend(tuple(e.data) for e in i or []),
+                rem.extend(tuple(e.data) for e in r or []),
+            ),
+        )
+        rt.start()
+        h = rt.get_input_handler("LoginEvents")
+        for row in sends:
+            h.send(row)
+        t0 = time.time()
+        while len(ins) + len(rem) < want and time.time() - t0 < timeout:
+            time.sleep(0.05)
+        rt.shutdown()
+        mgr.shutdown()
+        return ins, rem
+
+    def test_time1_all_every_1sec(self):
+        # TimeOutputRateLimit test1: all buffered rows flush at the period
+        ins, _ = self._run_timed(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output all every 1 sec
+        insert into uniqueIps ;""",
+            [(1, "192.10.1.5"), (2, "192.10.1.3"), (3, "192.10.1.9")], 3)
+        assert sorted(r[0] for r in ins) == [
+            "192.10.1.3", "192.10.1.5", "192.10.1.9"
+        ], ins
+
+    def test_time2_first_every_1sec(self):
+        # TimeOutputRateLimit first-per-period: only the period's first row
+        ins, _ = self._run_timed(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output first every 1 sec
+        insert into uniqueIps ;""",
+            [(1, "192.10.1.5"), (2, "192.10.1.3"), (3, "192.10.1.9")], 1)
+        assert len(ins) >= 1 and ins[0][0] == "192.10.1.5", ins
+
+    def test_time3_last_every_1sec(self):
+        ins, _ = self._run_timed(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output last every 1 sec
+        insert into uniqueIps ;""",
+            [(1, "192.10.1.5"), (2, "192.10.1.3"), (3, "192.10.1.9")], 1)
+        assert len(ins) >= 1 and ins[-1][0] == "192.10.1.9", ins
+
+    def test_snapshot1_plain_stream(self):
+        # SnapshotOutputRateLimit over a plain stream: periodic re-emission
+        # of the latest row
+        ins, _ = self._run_timed(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip output snapshot every 1 sec
+        insert into uniqueIps ;""",
+            [(1, "192.10.1.5"), (2, "192.10.1.3")], 1)
+        assert len(ins) >= 1, ins
+
+    def test_snapshot2_aggregation(self):
+        # snapshot of a group-by aggregation re-emits every group's latest
+        ins, _ = self._run_timed(LOGIN + """@info(name = 'query1')
+        from LoginEvents select ip, count() as total group by ip
+        output snapshot every 1 sec
+        insert into uniqueIps ;""",
+            [(1, "192.10.1.5"), (2, "192.10.1.5"), (3, "192.10.1.3")], 2)
+        got = {tuple(r) for r in ins}
+        assert ("192.10.1.5", 2) in got and ("192.10.1.3", 1) in got, ins
