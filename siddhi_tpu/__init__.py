@@ -17,9 +17,10 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
+from siddhi_tpu.core.error_store import InMemoryErrorStore  # noqa: E402,F401
 from siddhi_tpu.core.manager import SiddhiManager  # noqa: E402,F401
 from siddhi_tpu.core.types import AttrType  # noqa: E402,F401
 
 __version__ = "0.1.0"
 
-__all__ = ["SiddhiManager", "AttrType", "__version__"]
+__all__ = ["SiddhiManager", "AttrType", "InMemoryErrorStore", "__version__"]

@@ -486,7 +486,13 @@ class Parser:
 
     def _source(self) -> SingleInputStream:
         inner = bool(self.accept("#"))
-        return SingleInputStream(self.name(), is_inner=inner)
+        # `!S` consumes S's fault stream (reference: SiddhiQL.g4 fault streams,
+        # keyed internally under the '!'-prefixed id)
+        fault = False if inner else bool(self.accept("!"))
+        name = self.name()
+        return SingleInputStream(
+            ("!" + name) if fault else name, is_inner=inner, is_fault=fault
+        )
 
     def _stream_handlers(self, s: SingleInputStream) -> None:
         while True:
@@ -757,7 +763,14 @@ class Parser:
                 self.next()
             self.expect_kw("into")
             inner = bool(self.accept("#"))
-            return InsertIntoStream(out_for, self.name(), is_inner=inner)
+            fault = False if inner else bool(self.accept("!"))
+            name = self.name()
+            return InsertIntoStream(
+                out_for,
+                ("!" + name) if fault else name,
+                is_inner=inner,
+                is_fault=fault,
+            )
         if self.accept_kw("delete"):
             target = self.name()
             out_for = OutputEventsFor.CURRENT

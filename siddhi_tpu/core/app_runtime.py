@@ -102,6 +102,36 @@ class SiddhiAppRuntime:
         # the reference's synchronous junction dispatch + ThreadBarrier)
         self._process_lock = threading.RLock()
 
+        # @OnError(action='LOG'|'STREAM'|'STORE') failure policies
+        # (reference: StreamJunction OnErrorAction + util/error/handler/*);
+        # STREAM auto-defines the fault stream `!S` = S's attributes + _error
+        from siddhi_tpu.core.types import AttrType as _AttrType
+
+        self.on_error_actions: dict[str, str] = {}
+        for sid, d in app.stream_definitions.items():
+            oe = find_annotation(d.annotations, "OnError")
+            if oe is None:
+                continue
+            action = (oe.element("action") or oe.element(None) or "LOG").upper()
+            if action not in ("LOG", "STREAM", "STORE"):
+                raise SiddhiAppCreationError(
+                    f"stream '{sid}': unknown @OnError action '{action}' "
+                    "(expected LOG, STREAM, or STORE)"
+                )
+            self.on_error_actions[sid] = action
+            if action == "STREAM":
+                if any(a.name == "_error" for a in d.attributes):
+                    raise SiddhiAppCreationError(
+                        f"stream '{sid}': @OnError(action='STREAM') reserves "
+                        "the attribute name '_error'"
+                    )
+                fid = "!" + sid
+                self.stream_schemas[fid] = StreamSchema(
+                    fid,
+                    [(a.name, a.type) for a in d.attributes]
+                    + [("_error", _AttrType.STRING)],
+                )
+
         for sid, d in app.stream_definitions.items():
             self.stream_schemas[sid] = StreamSchema(
                 sid, [(a.name, a.type) for a in d.attributes]
@@ -123,6 +153,18 @@ class SiddhiAppRuntime:
                 self._junction(sid).on_publish_stats = tracker.add
                 bt = self.statistics_manager.buffered_tracker(f"stream.{sid}")
                 bt.register(self._junction(sid).queued)
+                self._junction(sid).on_error_stats = (
+                    self.statistics_manager.error_tracker(f"stream.{sid}").add
+                )
+
+        for sid, action in self.on_error_actions.items():
+            j = self._junction(sid)
+            j.fault_policy = action
+            j.app_name = self.name
+            if action == "STREAM":
+                j.fault_junction = self._junction("!" + sid)
+            elif action == "STORE":
+                j.error_store_fn = lambda: self.manager.error_store
 
         # `define function f[python] ...` scripts register into the global
         # function registry (reference: script executors via @Extension SPI;
@@ -225,7 +267,11 @@ class SiddhiAppRuntime:
 
         # @source/@sink transports on stream definitions
         # (reference: DefinitionParserHelper.addEventSource/Sink :302,419)
-        from siddhi_tpu.core.io import build_sink, build_source
+        from siddhi_tpu.core.io import (
+            build_sink,
+            build_source,
+            wire_sink_error_handling,
+        )
         from siddhi_tpu.query_api.annotation import find_all
 
         self.sources: list = []
@@ -239,8 +285,17 @@ class SiddhiAppRuntime:
                 self.sources.append(
                     build_source(ann, sid, schema, self.get_input_handler(sid))
                 )
-            for ann in find_all(d.annotations, "sink"):
+            for n_sink, ann in enumerate(find_all(d.annotations, "sink")):
                 sink = build_sink(ann, sid, schema)
+                wire_sink_error_handling(
+                    sink,
+                    lambda: self.manager.error_store,
+                    self.name,
+                    f"{sid}[{n_sink}]",
+                    self.statistics_manager.error_tracker(f"sink.{sid}").add
+                    if self.statistics_manager is not None
+                    else None,
+                )
                 self.sinks.append(sink)
                 self._junction(sid).add_stream_callback(
                     lambda rows, _s=sink: _s.on_events(
@@ -313,6 +368,12 @@ class SiddhiAppRuntime:
         if not isinstance(out, InsertIntoStream):
             return
         target = out.target
+        if out.is_fault and target not in self.stream_schemas:
+            raise SiddhiAppCreationError(
+                f"insert into '{target}': fault streams exist only for "
+                f"streams declaring @OnError(action='STREAM') — add it to "
+                f"'{target[1:]}'"
+            )
         if target in self.tables:
             return  # table writes are compiled into the query step
         existing = self.stream_schemas.get(target)
@@ -688,6 +749,44 @@ class SiddhiAppRuntime:
         return h
 
     input_handler = get_input_handler
+
+    def replay_error(self, entry) -> bool:
+        """Re-drive one stored ErroneousEvent through its origin. Stream
+        entries re-enter the input handler (and re-run every downstream
+        query); sink entries re-publish their mapped payload under the sink's
+        on.error policy. Returns True when the replay was dispatched."""
+        from siddhi_tpu.core.error_store import ORIGIN_SINK, ORIGIN_STREAM
+
+        if entry.app_name != self.name:
+            return False
+        if entry.origin == ORIGIN_STREAM:
+            if entry.stream_id not in self.stream_schemas or not entry.events:
+                return False
+            h = self.get_input_handler(entry.stream_id)
+            h.send_many(
+                [row for _ts, row in entry.events],
+                timestamps=[ts for ts, _row in entry.events],
+            )
+            return True
+        if entry.origin == ORIGIN_SINK:
+            # target the exact sink that failed (by sink_ref); fall back to
+            # the first stream_id match for entries from older stores. True
+            # means "safe to purge": delivered, or the sink's own failure
+            # path re-captured the payload (STORE always re-stores; WAIT only
+            # drops at shutdown when no store is wired). A LOG/RETRY sink
+            # that fails again DROPS the payload, so the entry must survive.
+            for sink in self.sinks:
+                for s in getattr(sink, "sinks", None) or [sink]:
+                    if s.stream_id != entry.stream_id:
+                        continue
+                    if entry.sink_ref and s.sink_ref != entry.sink_ref:
+                        continue
+                    ok = s.publish_guarded(entry.payload)
+                    return ok or s.on_error == "STORE" or (
+                        s.on_error == "WAIT" and s.error_store_fn is not None
+                    )
+            return False
+        return False
 
     def set_exception_handler(self, handler) -> None:
         """Route subscriber-dispatch failures to `handler(exc)` instead of

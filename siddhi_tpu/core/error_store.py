@@ -1,0 +1,157 @@
+"""Error store — durable parking lot for events that failed processing.
+
+Reference: core/util/error/handler/ErrorStoreHelper.java +
+siddhi-distribution's DBErrorStore: events rejected by `@OnError(action='STORE')`
+streams and `on.error='STORE'` sinks are captured as ErroneousEvent records that
+can be queried, replayed into the originating stream/sink, and purged. The
+built-in implementation is an in-memory bounded ring; persistent backends plug
+in through the same three-method surface (`store` / `load` / `purge`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Any, Optional
+
+# ErroneousEvent.origin values
+ORIGIN_STREAM = "stream"
+ORIGIN_SINK = "sink"
+
+
+@dataclasses.dataclass
+class ErroneousEvent:
+    """One failed unit of work (reference: util/error/handler/ErroneousEvent).
+
+    Stream-origin entries carry the failing batch's decoded host rows in
+    `events` as `(timestamp_ms, data_tuple)` pairs; sink-origin entries carry
+    the already-mapped wire `payload` instead.
+    """
+
+    id: int
+    stored_at_ms: int
+    app_name: str
+    origin: str  # ORIGIN_STREAM | ORIGIN_SINK
+    stream_id: str
+    error: str
+    events: Optional[list[tuple[int, tuple]]] = None
+    payload: Any = None
+    cause: Optional[BaseException] = None
+    # identifies WHICH sink on stream_id failed (a stream can carry several
+    # @sink annotations / @distribution destinations); replay targets it
+    sink_ref: str = ""
+
+
+class ErrorStore:
+    """Pluggable SPI; implementations must be thread-safe (dispatch threads,
+    sink publish threads, and replay callers all touch the store)."""
+
+    def store(self, entry: ErroneousEvent) -> None:
+        raise NotImplementedError
+
+    def load(
+        self,
+        app_name: Optional[str] = None,
+        stream_id: Optional[str] = None,
+        origin: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> list[ErroneousEvent]:
+        raise NotImplementedError
+
+    def purge(self, ids: Optional[list[int]] = None) -> int:
+        raise NotImplementedError
+
+
+class InMemoryErrorStore(ErrorStore):
+    """Capacity-bounded FIFO store: when full, the OLDEST entries are evicted
+    (the newest failure is the one an operator most wants to see) and counted
+    in `dropped`."""
+
+    def __init__(self, capacity: int = 10_000):
+        if capacity <= 0:
+            raise ValueError("error store capacity must be positive")
+        self.capacity = int(capacity)
+        self.dropped = 0
+        self._entries: dict[int, ErroneousEvent] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def store(self, entry: ErroneousEvent) -> None:
+        with self._lock:
+            if entry.id == 0:
+                entry.id = next(self._ids)
+            if entry.stored_at_ms == 0:
+                entry.stored_at_ms = int(time.time() * 1000)
+            self._entries[entry.id] = entry
+            while len(self._entries) > self.capacity:
+                self._entries.pop(next(iter(self._entries)))
+                self.dropped += 1
+
+    def load(
+        self,
+        app_name: Optional[str] = None,
+        stream_id: Optional[str] = None,
+        origin: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> list[ErroneousEvent]:
+        with self._lock:
+            out = [
+                e
+                for e in self._entries.values()
+                if (app_name is None or e.app_name == app_name)
+                and (stream_id is None or e.stream_id == stream_id)
+                and (origin is None or e.origin == origin)
+            ]
+        return out[:limit] if limit is not None else out
+
+    def purge(self, ids: Optional[list[int]] = None) -> int:
+        with self._lock:
+            if ids is None:
+                n = len(self._entries)
+                self._entries.clear()
+                return n
+            n = 0
+            for i in ids:
+                if self._entries.pop(i, None) is not None:
+                    n += 1
+            return n
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+def make_entry(
+    app_name: str,
+    origin: str,
+    stream_id: str,
+    error: BaseException | str,
+    events: Optional[list[tuple[int, tuple]]] = None,
+    payload: Any = None,
+    sink_ref: str = "",
+) -> ErroneousEvent:
+    exc = error if isinstance(error, BaseException) else None
+    if exc is not None:
+        # drop the frame chains (including chained __cause__/__context__
+        # exceptions): a retained traceback pins every frame's locals
+        # (decoded events, device batches) for the life of the store
+        seen: set[int] = set()
+        e: Optional[BaseException] = exc
+        while e is not None and id(e) not in seen:
+            seen.add(id(e))
+            e.__traceback__ = None
+            e = e.__cause__ or e.__context__
+    return ErroneousEvent(
+        id=0,
+        stored_at_ms=0,
+        app_name=app_name,
+        origin=origin,
+        stream_id=stream_id,
+        error=f"{type(error).__name__}: {error}" if exc is not None else str(error),
+        events=events,
+        payload=payload,
+        cause=exc,
+        sink_ref=sink_ref,
+    )

@@ -14,17 +14,17 @@ columnar device batches); egress drains decoded events through mappers.
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 from typing import Any, Callable, Optional
 
-from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.errors import (
+    ConnectionUnavailableError,
+    SiddhiAppCreationError,
+)
 from siddhi_tpu.core.event import Event
 from siddhi_tpu.core.extension import lookup
-
-
-class ConnectionUnavailableError(Exception):
-    """reference: exception/ConnectionUnavailableException."""
 
 
 # ---------------------------------------------------------------------------
@@ -69,18 +69,61 @@ class _BrokerSubscriber:
 
 
 class BackoffRetryCounter:
+    """Exponential backoff ladder with an optional interval cap and bounded
+    jitter. Jitter de-synchronizes mass reconnects after a broker blip (every
+    disconnected transport would otherwise retry at the exact same instants —
+    a thundering herd against the recovering endpoint)."""
+
     INTERVALS_MS = [50, 100, 500, 1000, 5000, 10000, 30000, 60000]
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        max_interval_ms: int | None = None,
+        jitter: float = 0.0,
+        rand: random.Random | None = None,
+    ) -> None:
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
         self._i = 0
+        self.max_interval_ms = max_interval_ms
+        self.jitter = float(jitter)
+        self._rand = rand if rand is not None else random.Random()
 
     def reset(self) -> None:
         self._i = 0
 
+    @property
+    def attempts(self) -> int:
+        return self._i
+
     def next_interval_ms(self) -> int:
         iv = self.INTERVALS_MS[min(self._i, len(self.INTERVALS_MS) - 1)]
         self._i += 1
+        if self.jitter:
+            # additive bounded jitter: [iv, iv * (1 + jitter)] — never earlier
+            # than the base ladder, so backoff guarantees still hold
+            iv += int(self._rand.uniform(0.0, self.jitter * iv))
+        if self.max_interval_ms is not None:
+            # the cap is a HARD ceiling: jitter never pushes past it
+            iv = min(iv, int(self.max_interval_ms))
         return iv
+
+
+def _make_retry_counter(options: dict) -> BackoffRetryCounter:
+    """Per-transport counter from @source/@sink options:
+    retry.max.interval.ms caps the ladder, retry.jitter in [0,1] spreads it."""
+    try:
+        cap = options.get("retry.max.interval.ms")
+        return BackoffRetryCounter(
+            max_interval_ms=int(cap) if cap is not None else None,
+            jitter=float(options.get("retry.jitter", 0.0)),
+        )
+    except ValueError as e:
+        # annotation problems surface as app-creation errors like every
+        # other option-validation path
+        raise SiddhiAppCreationError(
+            f"invalid retry options (retry.max.interval.ms / retry.jitter): {e}"
+        ) from e
 
 
 def _connect_with_retry(transport) -> None:
@@ -93,9 +136,15 @@ def _connect_with_retry(transport) -> None:
         transport._reconnecting = True
     retry_scheduled = False
     try:
-        transport.connect()
-        transport.connected = True
-        transport._retry.reset()
+        # _conn_lock serializes every connect() on this transport — including
+        # a sink's in-line on.error='RETRY' loop racing this background chain;
+        # skip connect() when that loop already restored the link (a second
+        # connect would leak a connection on socket-style transports)
+        with transport._conn_lock:
+            if not transport.connected:
+                transport.connect()
+                transport.connected = True
+            transport._retry.reset()
     except ConnectionUnavailableError:
         iv = transport._retry.next_interval_ms()
         retry_scheduled = True
@@ -268,7 +317,7 @@ class Source:
         self.mapper = mapper
         self.input_handler = input_handler
         self.paused = False
-        self._retry = BackoffRetryCounter()
+        self._retry = _make_retry_counter(options)
         self.connected = False
         self._stopped = False
         self._reconnecting = False
@@ -325,19 +374,53 @@ class InMemorySource(Source):
 # ---------------------------------------------------------------------------
 
 
+ON_ERROR_ACTIONS = ("LOG", "RETRY", "WAIT", "STORE")
+
+
 class Sink:
     """reference: Sink.java:47-177 — publish with reconnect on
-    ConnectionUnavailableError."""
+    ConnectionUnavailableError, failure policy from `on.error`:
+
+    LOG    log + drop the payload, reconnect in the background (default)
+    RETRY  re-attempt connect+publish in the calling thread with backoff
+           (retry.count attempts, default 3); exhausted -> log + drop,
+           background reconnect
+    WAIT   block the calling thread until the transport reconnects, then
+           publish — back-pressures the sender; on shutdown the held payload
+           spills to the error store instead of silently dropping
+    STORE  spill the payload to the manager's ErrorStore for later replay
+    """
 
     def init(self, stream_id: str, options: dict, mapper: Optional[SinkMapper]) -> None:
         self.stream_id = stream_id
         self.options = options
         self.mapper = mapper
         self.connected = False
-        self._retry = BackoffRetryCounter()
+        self._retry = _make_retry_counter(options)
         self._stopped = False
         self._reconnecting = False
         self._conn_lock = threading.Lock()
+        self.on_error = str(options.get("on.error", "LOG")).upper()
+        if self.on_error not in ON_ERROR_ACTIONS:
+            raise SiddhiAppCreationError(
+                f"@sink on stream '{stream_id}': unknown on.error "
+                f"'{self.on_error}' (expected one of {ON_ERROR_ACTIONS})"
+            )
+        try:
+            # default bounded at 3 (650 ms worst case): the caller may hold
+            # the app-wide process lock, so a dead transport must not stall
+            # every stream for the full 106 s ladder
+            self._retry_count = int(options.get("retry.count", 3))
+        except ValueError as e:
+            raise SiddhiAppCreationError(
+                f"@sink on stream '{stream_id}': invalid retry.count "
+                f"'{options.get('retry.count')}'"
+            ) from e
+        # wired by the app runtime after build_sink
+        self.error_store_fn: Optional[Callable[[], object]] = None
+        self.app_name = ""
+        self.sink_ref = ""
+        self.on_error_stats: Optional[Callable[[int], None]] = None
 
     def connect(self) -> None:
         pass
@@ -357,12 +440,113 @@ class Sink:
 
     def on_events(self, events: list[Event]) -> None:
         payload = self.mapper.map(events) if self.mapper else events
+        self.publish_guarded(payload)
+
+    def publish_guarded(self, payload) -> bool:
+        """Publish under the sink's on.error policy; True when the payload was
+        delivered (reference: Sink.java:128-160 onError/connectAndPublish)."""
         try:
             self.publish(payload)
-        except ConnectionUnavailableError:
-            # reference: Sink.java:128-160 — reconnect, drop this payload
+            return True
+        except ConnectionUnavailableError as e:
             self.connected = False
+            if self.on_error_stats is not None:
+                self.on_error_stats(1)
+            return self._on_publish_failure(payload, e)
+
+    def _on_publish_failure(self, payload, exc: ConnectionUnavailableError) -> bool:
+        import logging
+
+        log = logging.getLogger(f"siddhi_tpu.sink.{self.stream_id}")
+        mode = self.on_error
+        if mode == "RETRY":
+            retry = _make_retry_counter(self.options)
+            # bounded in-line retries, in the CALLING thread: transient blips
+            # resolve in-line (and in-order); a dead transport falls back to
+            # LOG semantics with a background reconnect chain
+            while retry.attempts < self._retry_count:
+                if self._stopped:
+                    return False
+                time.sleep(retry.next_interval_ms() / 1000.0)
+                try:
+                    with self._conn_lock:
+                        # serialized with other in-line retriers; a transport
+                        # must never see two concurrent connect() calls
+                        if not self.connected:
+                            self.connect()
+                            self.connected = True
+                    self.publish(payload)
+                    return True
+                except ConnectionUnavailableError:
+                    self.connected = False
+            log.error(
+                "sink '%s': on.error='RETRY' exhausted its backoff ladder; "
+                "the payload was dropped", self.stream_id,
+            )
             self.connect_with_retry()
+            return False
+        if mode == "WAIT":
+            # block the sender until the background reconnect chain lands
+            # (reference: Sink connectWithRetry + isTryingToConnect spin)
+            self.connect_with_retry()
+            retry = _make_retry_counter(self.options)
+            while not self._stopped:
+                if self.connected:
+                    try:
+                        self.publish(payload)
+                        return True
+                    except ConnectionUnavailableError:
+                        self.connected = False
+                        self.connect_with_retry()
+                        # a half-up endpoint (connects fine, rejects publishes)
+                        # must see ladder-paced attempts, not a 2 ms hot spin
+                        time.sleep(retry.next_interval_ms() / 1000.0)
+                        continue
+                time.sleep(0.002)
+            # shutdown while blocked: WAIT promises no silent drops — spill
+            # to the error store when one is wired, and always say so
+            from siddhi_tpu.core.error_store import ORIGIN_SINK, make_entry
+
+            store = self.error_store_fn() if self.error_store_fn is not None else None
+            if store is not None:
+                store.store(make_entry(
+                    self.app_name, ORIGIN_SINK, self.stream_id, exc,
+                    payload=payload, sink_ref=self.sink_ref,
+                ))
+                log.error(
+                    "sink '%s': shut down while on.error='WAIT' was holding a "
+                    "payload; it was spilled to the error store", self.stream_id,
+                )
+            else:
+                log.error(
+                    "sink '%s': shut down while on.error='WAIT' was holding a "
+                    "payload and no error store is wired; it was dropped",
+                    self.stream_id,
+                )
+            return False
+        if mode == "STORE":
+            from siddhi_tpu.core.error_store import ORIGIN_SINK, make_entry
+
+            store = self.error_store_fn() if self.error_store_fn is not None else None
+            if store is None:
+                log.error(
+                    "sink '%s': on.error='STORE' but no error store is "
+                    "available; the payload was dropped", self.stream_id,
+                )
+            else:
+                store.store(make_entry(
+                    self.app_name, ORIGIN_SINK, self.stream_id, exc,
+                    payload=payload, sink_ref=self.sink_ref,
+                ))
+            self.connect_with_retry()
+            return False
+        # LOG (default; previous behavior + an explicit error line)
+        log.error(
+            "sink '%s': publish failed (%s); the payload was dropped and a "
+            "background reconnect was started", self.stream_id, exc,
+        )
+        self.connect_with_retry()
+        return False
 
 
 class InMemorySink(Sink):
@@ -447,6 +631,25 @@ class DistributedSink:
                 buckets.setdefault(h % n, []).append(e)
             for i, evs in buckets.items():
                 self.sinks[i].on_events(evs)
+
+
+def wire_sink_error_handling(
+    sink, error_store_fn: Callable[[], object], app_name: str,
+    sink_ref: str, on_error_stats: Optional[Callable[[int], None]] = None,
+) -> None:
+    """Attach app-level error plumbing to a (possibly distributed) sink.
+    `sink_ref` uniquely names this @sink within the app; distributed
+    destinations get `.0`, `.1`, ... suffixes so STORE entries identify the
+    exact failing destination for replay."""
+    if isinstance(sink, DistributedSink):
+        targets = [(s, f"{sink_ref}.{i}") for i, s in enumerate(sink.sinks)]
+    else:
+        targets = [(sink, sink_ref)]
+    for s, ref in targets:
+        s.error_store_fn = error_store_fn
+        s.app_name = app_name
+        s.sink_ref = ref
+        s.on_error_stats = on_error_stats
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,7 @@ class SiddhiManager:
     def __init__(self) -> None:
         self.interner = InternTable()
         self.persistence_store = None
+        self._error_store = None
         self._runtimes: dict[str, object] = {}
 
     # app: SiddhiQL source text or a programmatic SiddhiApp AST
@@ -53,6 +54,47 @@ class SiddhiManager:
 
     def set_persistence_store(self, store) -> None:
         self.persistence_store = store
+
+    # ---- error store (reference: SiddhiManager.setErrorStore) -------------
+
+    @property
+    def error_store(self):
+        """The shared ErrorStore. Lazily defaults to a bounded in-memory store
+        the first time an @OnError(action='STORE') stream or on.error='STORE'
+        sink needs one; call set_error_store() to plug a custom backend."""
+        if self._error_store is None:
+            from siddhi_tpu.core.error_store import InMemoryErrorStore
+
+            self._error_store = InMemoryErrorStore()
+        return self._error_store
+
+    def set_error_store(self, store) -> None:
+        self._error_store = store
+
+    def replay_errors(self, entries=None, purge: bool = True) -> int:
+        """Re-drive stored erroneous events through their origin: stream
+        entries re-enter the input handler, sink entries re-publish. Returns
+        the number of entries replayed; replayed entries are purged by default
+        (a replay that fails again re-enters the store through the normal
+        failure path, so nothing is lost)."""
+        if self._error_store is None:
+            return 0
+        if entries is None:
+            entries = self.error_store.load()
+        replayed = 0
+        for e in entries:
+            rt = self._runtimes.get(e.app_name)
+            if rt is None:
+                continue
+            if rt.replay_error(e):
+                replayed += 1
+                if purge:
+                    # purge only DISPATCHED entries: a replay that fails again
+                    # re-enters the store as a fresh entry through the live
+                    # failure path, while an undispatchable one (origin gone)
+                    # must stay stored rather than silently vanish
+                    self.error_store.purge([e.id])
+        return replayed
 
     def set_config_manager(self, config_manager) -> None:
         """Deployment config SPI (reference: SiddhiManager.setConfigManager)."""

@@ -75,6 +75,9 @@ class StatisticsManager:
         self.throughput: dict[str, ThroughputTracker] = {}
         self.latency: dict[str, LatencyTracker] = {}
         self.buffered: dict[str, BufferedEventsTracker] = {}
+        # failed dispatches / sink publishes per component (reference analog:
+        # the error counters Siddhi's metrics registry keeps per junction)
+        self.errors: dict[str, ThroughputTracker] = {}
         # name -> () -> bytes; the TPU-native analog of the reference's
         # ObjectSizeCalculator memory metric (util/statistics/memory/):
         # device-buffer bytes held by each component's carried state
@@ -91,6 +94,9 @@ class StatisticsManager:
 
     def buffered_tracker(self, name: str) -> BufferedEventsTracker:
         return self.buffered.setdefault(name, BufferedEventsTracker(name))
+
+    def error_tracker(self, name: str) -> ThroughputTracker:
+        return self.errors.setdefault(name, ThroughputTracker(name))
 
     def register_memory(self, name: str, fn) -> None:
         """fn() -> device bytes held by the named component's state."""
@@ -112,6 +118,7 @@ class StatisticsManager:
                 n: round(t.avg_ms, 3) for n, t in self.latency.items()
             },
             "buffered": {n: t.get_size() for n, t in self.buffered.items()},
+            "errors": {n: t.count for n, t in self.errors.items()},
             "memory_bytes": mem,
         }
 

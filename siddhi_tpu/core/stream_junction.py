@@ -43,9 +43,18 @@ class StreamJunction:
         # (reference allows self-feeding junctions); recursion stays on-thread
         self.lock = threading.RLock()
         self.on_publish_stats: Callable[[int], None] | None = None
+        self.on_error_stats: Callable[[int], None] | None = None
         # user hook for subscriber failures (reference: the pluggable
         # Disruptor ExceptionHandler, SiddhiAppRuntime.java:664)
         self.exception_handler: Callable[[Exception], None] | None = None
+        # @OnError policy (reference: StreamJunction.handleError + OnErrorAction):
+        # None propagates to the sender; 'LOG' logs and drops the failing
+        # batch; 'STREAM' redirects it (plus the error) to fault_junction;
+        # 'STORE' spills it to the manager's ErrorStore via error_store_fn
+        self.fault_policy: str | None = None
+        self.fault_junction: "StreamJunction | None" = None
+        self.error_store_fn: Callable[[], object] | None = None
+        self.app_name: str = ""
 
     def subscribe(self, fn: Subscriber) -> None:
         self.subscribers.append(fn)
@@ -135,14 +144,8 @@ class StreamJunction:
                 )
                 # the trailing payload lane carries the send-time clock
                 self.publish_batch(batch, int(rows[-1, -1]))
-            except Exception:
-                import logging
-                import traceback
-
-                logging.getLogger(__name__).error(
-                    "async ring worker for stream '%s' dropped a batch:\n%s",
-                    self.schema.stream_id, traceback.format_exc(),
-                )
+            except Exception as e:
+                self._on_worker_error(e, "async ring worker")
 
     def queued(self) -> int:
         ring = getattr(self, "_ring", None)
@@ -175,14 +178,30 @@ class StreamJunction:
                     ts_list, rows, self.interner, capacity=self.batch_size
                 )
                 self.publish_batch(batch, now)
-            except Exception:  # a poisoned batch must not kill the worker
-                import logging
-                import traceback
+            except Exception as e:  # a poisoned batch must not kill the worker
+                self._on_worker_error(e, "async worker")
 
-                logging.getLogger(__name__).error(
-                    "async worker for stream '%s' dropped a batch:\n%s",
+    def _on_worker_error(self, exc: Exception, who: str) -> None:
+        """A poison batch (bad arity, un-packable value, downstream explosion
+        that escaped per-subscriber guards) must not kill the drain worker:
+        log, notify the app's exception handler, count it, and keep draining."""
+        import logging
+        import traceback
+
+        logging.getLogger(__name__).error(
+            "%s for stream '%s' dropped a batch:\n%s",
+            who, self.schema.stream_id, traceback.format_exc(),
+        )
+        if self.on_error_stats is not None:
+            self.on_error_stats(1)
+        handler = self.exception_handler
+        if handler is not None:
+            try:
+                handler(exc)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "exception handler for stream '%s' raised",
                     self.schema.stream_id,
-                    traceback.format_exc(),
                 )
 
     def stop_async(self) -> None:
@@ -228,26 +247,131 @@ class StreamJunction:
         with self.lock:
             if self.on_publish_stats is not None:
                 self.on_publish_stats(int(np.asarray(batch.valid).sum()))
+            guarded = (
+                self.exception_handler is not None or self.fault_policy is not None
+            )
+            # one STREAM/STORE routing per batch even when several subscribers
+            # fail on it — fault consumers must not double-count a failure
+            routed = False
             for fn in self.subscribers:
-                if self.exception_handler is None:
+                if not guarded:
                     fn(batch, now)
                 else:
                     try:
                         fn(batch, now)
                     except Exception as e:  # user-owned failure policy
-                        self.exception_handler(e)
+                        routed |= self._on_dispatch_error(batch, now, e, routed)
             if self.stream_callbacks:
-                events = self.schema.from_batch(batch, self.interner)
+                try:
+                    events = self.schema.from_batch(batch, self.interner)
+                except Exception as e:
+                    if not guarded:
+                        raise
+                    self._on_dispatch_error(batch, now, e, routed)
+                    return
                 if events:
                     rows = [(ts, data) for ts, kind, data in events]
                     for cb in self.stream_callbacks:
-                        if self.exception_handler is None:
+                        if not guarded:
                             cb(rows)
                         else:
                             try:
                                 cb(rows)
                             except Exception as e:
-                                self.exception_handler(e)
+                                routed |= self._on_dispatch_error(
+                                    batch, now, e, routed
+                                )
+
+    def _on_dispatch_error(
+        self, batch: EventBatch, now: int, exc: Exception, routed: bool = False
+    ) -> bool:
+        """Apply the stream's failure policy to one failed dispatch; returns
+        True when the batch's events were routed (fault stream / error store).
+        With `routed` set, the handler/stats/log still run for this failure
+        but the payload is not re-routed. The batch never propagates to the
+        sender once a handler or @OnError policy owns the failure
+        (reference: StreamJunction.handleError:390-404)."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        if self.on_error_stats is not None:
+            self.on_error_stats(1)
+        if self.exception_handler is not None:
+            try:
+                self.exception_handler(exc)
+            except Exception:
+                log.exception(
+                    "exception handler for stream '%s' raised", self.schema.stream_id
+                )
+        policy = self.fault_policy
+        if policy is None:
+            return False  # handler-only: existing set_exception_handler semantics
+        if policy == "LOG":
+            log.error(
+                "stream '%s': dropping a failed batch (@OnError action='LOG'): %s",
+                self.schema.stream_id, exc, exc_info=exc,
+            )
+            return False
+        if routed:
+            return False  # another subscriber already routed this batch
+        from siddhi_tpu.core.event import KIND_CURRENT, KIND_EXPIRED
+
+        try:
+            events = self.schema.from_batch(batch, self.interner)
+        except Exception:
+            log.exception(
+                "stream '%s': could not decode a failed batch for @OnError "
+                "routing; the batch was dropped", self.schema.stream_id,
+            )
+            return False
+        # only payload rows route onward: TIMER/RESET rows are synthetic
+        # all-null scheduler artifacts, not user events
+        events = [e for e in events if e[1] in (KIND_CURRENT, KIND_EXPIRED)]
+        if policy == "STREAM":
+            fj = self.fault_junction
+            if fj is None or not events:
+                return False
+            err = f"{type(exc).__name__}: {exc}"
+            try:
+                # publish per-chunk with the kind lane preserved — an EXPIRED
+                # row must not resurface on !S as a CURRENT event
+                cap = fj.batch_size
+                for ofs in range(0, len(events), cap):
+                    chunk = events[ofs : ofs + cap]
+                    fb = fj.schema.to_batch(
+                        [ts for ts, _k, _d in chunk],
+                        [tuple(d) + (err,) for _ts, _k, d in chunk],
+                        fj.interner,
+                        capacity=cap,
+                        kinds=[k for _ts, k, _d in chunk],
+                    )
+                    fj.publish_batch(fb, now)
+            except Exception:
+                log.exception(
+                    "fault stream '%s' dispatch failed; the batch was dropped",
+                    fj.schema.stream_id,
+                )
+            return True
+        if policy == "STORE":
+            from siddhi_tpu.core.error_store import ORIGIN_STREAM, make_entry
+
+            store = self.error_store_fn() if self.error_store_fn is not None else None
+            if store is None:
+                log.error(
+                    "stream '%s': @OnError action='STORE' but no error store "
+                    "is available; the batch was dropped", self.schema.stream_id,
+                )
+                return False
+            if not events:
+                return False
+            # replay re-injects through the input handler, i.e. as CURRENT
+            # events; EXPIRED rows are recorded for inspection all the same
+            store.store(make_entry(
+                self.app_name, ORIGIN_STREAM, self.schema.stream_id, exc,
+                events=[(ts, tuple(d)) for ts, _k, d in events],
+            ))
+            return True
+        return False
 
     is_async = False
 

@@ -60,6 +60,12 @@ class SingleInputStream:
         """Name by which expressions refer to this stream."""
         return self.alias or self.stream_id
 
+    @staticmethod
+    def fault_stream(stream_id: str) -> "SingleInputStream":
+        """Programmatic `from !S` — S's fault stream (attributes + `_error`),
+        auto-defined when S declares @OnError(action='STREAM')."""
+        return SingleInputStream("!" + stream_id, is_fault=True)
+
     def filter(self, e: Expression) -> "SingleInputStream":
         self.handlers.append(Filter(e))
         return self
@@ -322,6 +328,16 @@ class Query:
 
     def insert_into(self, target: str, for_: OutputEventsFor = OutputEventsFor.CURRENT) -> "Query":
         self.output_stream = InsertIntoStream(output_events=for_, target=target)
+        return self
+
+    def insert_into_fault(
+        self, target: str, for_: OutputEventsFor = OutputEventsFor.CURRENT
+    ) -> "Query":
+        """Programmatic `insert into !target` (target must declare
+        @OnError(action='STREAM'))."""
+        self.output_stream = InsertIntoStream(
+            output_events=for_, target="!" + target, is_fault=True
+        )
         return self
 
 

@@ -1,0 +1,736 @@
+"""Fault-stream & error-handling subsystem tests.
+
+Reference: modules/siddhi-core/src/test/java/.../core/stream/event/FaultStreamTestCase
+(@OnError LOG/STREAM routing, `!stream` queries), util/error/handler tests
+(error store capture + replay), and Sink.onError semantics from
+InMemoryTransportTestCase (on.error retry/wait/store matrix).
+"""
+
+import threading
+import time
+
+import pytest
+
+from siddhi_tpu import InMemoryErrorStore, SiddhiManager
+from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+from siddhi_tpu.core.errors import (
+    DefinitionNotExistError,
+    SiddhiAppCreationError,
+)
+from siddhi_tpu.core.io import (
+    BackoffRetryCounter,
+    ConnectionUnavailableError,
+    SINKS,
+    Sink,
+)
+
+
+def _wait_for(pred, timeout=30.0):
+    """Poll until pred() is truthy (async drains + first-batch jit compiles
+    make fixed sleeps racy); returns the last pred() value."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.01)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# parser: `!stream` syntax + @OnError validation
+# ---------------------------------------------------------------------------
+
+
+class TestFaultSyntax:
+    def test_from_fault_stream_parses(self):
+        app = SiddhiCompiler.parse("""
+        @OnError(action='STREAM')
+        define stream S (v int);
+        from !S select v, _error insert into F;
+        """)
+        q = app.execution_elements[0]
+        assert q.input_stream.stream_id == "!S"
+        assert q.input_stream.is_fault
+
+    def test_insert_into_fault_stream_parses(self):
+        app = SiddhiCompiler.parse("""
+        @OnError(action='STREAM')
+        define stream S (v int);
+        define stream T (v int, m string);
+        from T select v, m as _error insert into !S;
+        """)
+        out = app.execution_elements[0].output_stream
+        assert out.target == "!S"
+        assert out.is_fault
+
+    def test_bad_on_error_action_rejected(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime(
+                "@OnError(action='EXPLODE') define stream S (v int);"
+            )
+        mgr.shutdown()
+
+    def test_error_attribute_name_reserved(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime(
+                "@OnError(action='STREAM') define stream S (_error string);"
+            )
+        mgr.shutdown()
+
+    def test_insert_into_undeclared_fault_stream_rejected(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime("""
+            define stream S (v int);
+            define stream T (v int, m string);
+            from T select v, m insert into !S;
+            """)
+        mgr.shutdown()
+
+    def test_programmatic_fault_api(self):
+        from siddhi_tpu.core.types import AttrType
+        from siddhi_tpu.query_api.annotation import Annotation
+        from siddhi_tpu.query_api.definition import StreamDefinition
+        from siddhi_tpu.query_api.execution import (
+            Query,
+            Selector,
+            SingleInputStream,
+        )
+        from siddhi_tpu.query_api.expression import Variable
+        from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+        app = SiddhiApp.siddhi_app("Prog")
+        sd = StreamDefinition("S").attribute("v", AttrType.INT)
+        sd.annotation(Annotation("OnError", [("action", "STREAM")]))
+        app.define_stream(sd)
+        app.add_query(
+            Query.query()
+            .from_(SingleInputStream.fault_stream("S"))
+            .select(
+                Selector()
+                .select(None, Variable("v"))
+                .select(None, Variable("_error"))
+            )
+            .insert_into("FOut")
+        )
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(app)
+        faults = []
+        rt.add_callback("FOut", lambda evs: faults.extend(evs))
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 3))
+        rt.start()
+        rt.get_input_handler("S").send((3,))
+        assert [tuple(e.data) for e in faults] == [(3, "ValueError: poison 3")]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_from_undeclared_fault_stream_rejected(self):
+        mgr = SiddhiManager()
+        with pytest.raises(DefinitionNotExistError):
+            mgr.create_siddhi_app_runtime("""
+            define stream S (v int);
+            from !S select v insert into F;
+            """)
+        mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# @OnError runtime semantics
+# ---------------------------------------------------------------------------
+
+
+def _poison_subscriber(attr, bad):
+    """Subscriber raising when any valid row's `attr` equals `bad`."""
+    import numpy as np
+
+    def fn(batch, now):
+        vals = np.asarray(batch.cols[attr])[np.asarray(batch.valid)]
+        if (vals == bad).any():
+            raise ValueError(f"poison {bad}")
+
+    return fn
+
+
+class TestOnErrorStream:
+    def test_fault_events_carry_attrs_and_error(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('F1')
+        @OnError(action='STREAM')
+        define stream S (symbol string, price float);
+        from S select symbol, price insert into Out;
+        from !S select symbol, price, _error insert into FOut;
+        """)
+        got, faults = [], []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        rt.add_callback("FOut", lambda evs: faults.extend(evs))
+        rt.junctions["S"].subscribe(_poison_subscriber("price", -1.0))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(("WSO2", 10.0))
+        h.send(("BAD", -1.0))
+        h.send(("IBM", 20.0))
+        # the healthy query keeps processing every batch
+        assert [tuple(e.data) for e in got] == [
+            ("WSO2", 10.0), ("BAD", -1.0), ("IBM", 20.0)
+        ]
+        # only the failing batch lands on !S, original attrs + _error
+        assert [tuple(e.data) for e in faults] == [
+            ("BAD", -1.0, "ValueError: poison -1.0")
+        ]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_real_query_failure_routes_to_fault_stream(self):
+        # the query itself (not a synthetic subscriber) throws while
+        # processing a batch: its script function body explodes at trace time
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('F5')
+        @OnError(action='STREAM')
+        define stream S (v int);
+        define function bad[python] return int { nonexistent_name(data[0]) };
+        from S select bad(v) as w insert into Out;
+        from !S select v, _error insert into FOut;
+        """)
+        faults = []
+        rt.add_callback("FOut", lambda evs: faults.extend(evs))
+        rt.start()
+        rt.get_input_handler("S").send((5,))
+        assert len(faults) == 1
+        assert faults[0].data[0] == 5
+        assert "nonexistent_name" in faults[0].data[1]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_fault_stream_filterable_by_normal_query(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('F2')
+        @OnError(action='STREAM')
+        define stream S (v int);
+        from !S[v > 5] select v, _error insert into Big;
+        """)
+        big = []
+        rt.add_callback("Big", lambda evs: big.extend(evs))
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 0))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send((0,))
+        h.send((9,))  # no failure: never reaches !S
+        assert [tuple(e.data) for e in big] == []
+        rt.junctions["S"].subscribers[0] = _poison_subscriber("v", 9)
+        h.send((9,))
+        assert [tuple(e.data) for e in big] == [(9, "ValueError: poison 9")]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_query_can_insert_into_fault_stream(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('F3')
+        @OnError(action='STREAM')
+        define stream S (v int);
+        define stream Quarantine (v int, reason string);
+        from Quarantine select v, reason as _error insert into !S;
+        from !S select v, _error insert into FOut;
+        """)
+        faults = []
+        rt.add_callback("FOut", lambda evs: faults.extend(evs))
+        rt.start()
+        rt.get_input_handler("Quarantine").send((3, "manual"))
+        assert [tuple(e.data) for e in faults] == [(3, "manual")]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_positional_on_error_form(self):
+        # @OnError('STREAM') without the action= key must not silently
+        # degrade to LOG
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('F7')
+        @OnError('STREAM')
+        define stream S (v int);
+        from !S select v, _error insert into FOut;
+        """)
+        faults = []
+        rt.add_callback("FOut", lambda evs: faults.extend(evs))
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 1))
+        rt.start()
+        rt.get_input_handler("S").send((1,))
+        assert len(faults) == 1
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_fault_routing_preserves_event_kind(self):
+        # an EXPIRED row in a failed batch must stay EXPIRED on !S
+        import numpy as np
+
+        from siddhi_tpu.core.event import KIND_CURRENT, KIND_EXPIRED
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('F8')
+        @OnError(action='STREAM')
+        define stream S (v int);
+        @info(name='fq')
+        from !S select v, _error insert all events into FOut;
+        """)
+        kinds_seen = []
+        rt.add_callback(
+            "fq", lambda ts, ins, removed: kinds_seen.append(
+                (len(ins or []), len(removed or []))
+            )
+        )
+        rt.start()
+        j = rt.junctions["S"]
+
+        def boom(batch, now):
+            raise ValueError("always")
+
+        j.subscribe(boom)
+        batch = j.schema.to_batch(
+            [1, 2], [(10,), (20,)], j.interner,
+            capacity=j.batch_size, kinds=[KIND_CURRENT, KIND_EXPIRED],
+        )
+        j.publish_batch(batch, 2)
+        # one current + one removed event reached the fault-stream query
+        assert kinds_seen == [(1, 1)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_multiple_failing_subscribers_route_batch_once(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('F6')
+        @OnError(action='STREAM')
+        define stream S (v int);
+        from !S select v, _error insert into FOut;
+        """)
+        faults = []
+        rt.add_callback("FOut", lambda evs: faults.extend(evs))
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 4))
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 4))
+        rt.start()
+        rt.get_input_handler("S").send((4,))
+        # two subscribers failed on the same batch: ONE fault emission
+        assert len(faults) == 1
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_log_action_swallows_and_continues(self, caplog):
+        import logging
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('F4')
+        @OnError(action='LOG')
+        define stream S (v int);
+        from S select v insert into Out;
+        """)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 13))
+        rt.start()
+        h = rt.get_input_handler("S")
+        with caplog.at_level(logging.ERROR, logger="siddhi_tpu.core.stream_junction"):
+            h.send((13,))  # must NOT propagate to the sender
+        h.send((14,))
+        assert [tuple(e.data) for e in got] == [(13,), (14,)]
+        assert any("LOG" in r.message for r in caplog.records)
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_no_policy_still_propagates_to_sender(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("define stream S (v int);")
+        rt._junction("S").subscribe(_poison_subscriber("v", 1))
+        rt.start()
+        with pytest.raises(ValueError):
+            rt.get_input_handler("S").send((1,))
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestOnErrorStore:
+    def test_store_query_replay_purge(self):
+        mgr = SiddhiManager()
+        store = InMemoryErrorStore(capacity=8)
+        mgr.set_error_store(store)
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('ES1')
+        @OnError(action='STORE')
+        define stream S (v int);
+        from S select v insert into Out;
+        """)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        boom = _poison_subscriber("v", 5)
+        rt.junctions["S"].subscribe(boom)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send((5,), timestamp=111)
+        entries = store.load(app_name="ES1", stream_id="S")
+        assert len(entries) == 1
+        assert entries[0].events == [(111, (5,))]
+        assert "poison 5" in entries[0].error
+        # replay after removing the poison subscriber: events re-enter S
+        rt.junctions["S"].subscribers.remove(boom)
+        assert mgr.replay_errors() == 1
+        assert (5,) in [tuple(e.data) for e in got]
+        assert store.size() == 0  # replayed entries are purged
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_undispatchable_entry_stays_stored(self):
+        # an entry whose origin is gone must NOT be purged by replay
+        mgr = SiddhiManager()
+        store = InMemoryErrorStore()
+        mgr.set_error_store(store)
+        from siddhi_tpu.core.error_store import ORIGIN_STREAM, make_entry
+
+        store.store(
+            make_entry("NoSuchApp", ORIGIN_STREAM, "S", "gone", events=[(1, (1,))])
+        )
+        assert mgr.replay_errors() == 0
+        assert store.size() == 1
+        mgr.shutdown()
+
+    def test_capacity_bound_evicts_oldest(self):
+        store = InMemoryErrorStore(capacity=2)
+        from siddhi_tpu.core.error_store import ORIGIN_STREAM, make_entry
+
+        for v in range(3):
+            store.store(make_entry("A", ORIGIN_STREAM, "S", f"e{v}"))
+        assert store.size() == 2
+        assert store.dropped == 1
+        assert [e.error for e in store.load()] == ["e1", "e2"]
+        assert store.purge() == 2
+        assert store.size() == 0
+
+
+# ---------------------------------------------------------------------------
+# set_exception_handler / async drain survival
+# ---------------------------------------------------------------------------
+
+
+class TestExceptionHandler:
+    def test_handler_receives_and_processing_continues(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('EH1')
+        define stream S (v int);
+        from S select v insert into Out;
+        """)
+        got, errors = [], []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        rt.set_exception_handler(errors.append)
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 2))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send((1,))
+        h.send((2,))  # swallowed by the handler, sender unaffected
+        h.send((3,))
+        assert [tuple(e.data) for e in got] == [(1,), (2,), (3,)]
+        assert len(errors) == 1 and isinstance(errors[0], ValueError)
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_async_junction_survives_poison_event(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('EH2')
+        @async(buffer.size='64')
+        define stream S (v int);
+        from S select v insert into Out;
+        """)
+        got, errors = [], []
+        rt.add_callback("Out", lambda evs: got.extend(evs))
+        rt.set_exception_handler(errors.append)
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 7))
+        rt.start()
+        j = rt.junctions["S"]
+        assert j.is_async
+        h = rt.get_input_handler("S")
+        h.send((7,))
+        _wait_for(lambda: errors)
+        assert all(t.is_alive() for t in j._workers)  # worker survived
+        h.send((8,))
+        _wait_for(lambda: len(got) >= 2)
+        assert (8,) in [tuple(e.data) for e in got]
+        assert any(isinstance(e, ValueError) for e in errors)
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_async_worker_survives_unpackable_row(self):
+        # object columns force the python-queue drain path, where the worker
+        # itself packs rows: a wrong-arity row raises inside the worker
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('EH3')
+        @async(buffer.size='64')
+        define stream S (v object);
+        """)
+        rows, errors = [], []
+        rt.add_callback("S", lambda evs: rows.extend(evs))
+        rt.set_exception_handler(errors.append)
+        rt.start()
+        j = rt.junctions["S"]
+        h = rt.get_input_handler("S")
+        h.send(("a", "extra"))  # poison: arity 2 into a 1-attribute stream
+        _wait_for(lambda: errors)
+        assert all(t.is_alive() for t in j._workers)
+        h.send(("b",))
+        _wait_for(lambda: rows)
+        assert [tuple(e.data) for e in rows] == [("b",)]
+        assert len(errors) == 1
+        rt.shutdown()
+        mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# sink on.error
+# ---------------------------------------------------------------------------
+
+
+class _FlakySink(Sink):
+    """Publish raises until `down` clears; connect honors `conn_down`."""
+
+    def __init__(self):
+        self.delivered = []
+        self.down = False
+        self.conn_down = False
+        self.publish_attempts = 0
+
+    def connect(self):
+        if self.conn_down:
+            raise ConnectionUnavailableError("connect refused")
+
+    def publish(self, payload):
+        self.publish_attempts += 1
+        if self.down:
+            raise ConnectionUnavailableError("transport down")
+        self.delivered.append(payload)
+
+
+def _sink_app(on_error, extra=""):
+    mgr = SiddhiManager()
+    instances = []
+
+    class _Impl(_FlakySink):
+        def __init__(self):
+            super().__init__()
+            instances.append(self)
+
+    SINKS["flakytest"] = _Impl
+    try:
+        rt = mgr.create_siddhi_app_runtime(f"""
+        @app:name('SK_{on_error}')
+        define stream In (v int);
+        @sink(type='flakytest', on.error='{on_error}'{extra},
+              @map(type='passThrough'))
+        define stream Out (v int);
+        from In select v insert into Out;
+        """)
+    finally:
+        del SINKS["flakytest"]
+    rt.start()
+    return mgr, rt, instances[0]
+
+
+class TestSinkOnError:
+    def test_invalid_on_error_rejected(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            _FlakySink().init("S", {"on.error": "PANIC"}, None)
+        mgr.shutdown()
+
+    def test_retry_reconnects_and_delivers(self):
+        mgr, rt, sink = _sink_app("RETRY")
+        rt.get_input_handler("In").send((0,))  # warm up: first batch compiles
+        assert len(sink.delivered) == 1
+        sink.down = True
+        attempts_before = sink.publish_attempts
+
+        def recover():
+            time.sleep(0.12)  # past the first 50ms+100ms backoff steps
+            sink.down = False
+
+        threading.Thread(target=recover, daemon=True).start()
+        rt.get_input_handler("In").send((1,))  # blocks in the retry ladder
+        assert [tuple(e.data) for p in sink.delivered for e in p] == [(0,), (1,)]
+        assert sink.publish_attempts - attempts_before >= 2
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_retry_exhaustion_drops(self):
+        mgr, rt, sink = _sink_app("RETRY", extra=", retry.count='2'")
+        sink.down = True
+        sink.conn_down = True
+        rt.get_input_handler("In").send((1,))  # 2 attempts, then dropped
+        assert sink.delivered == []
+        sink.down = False
+        sink.conn_down = False
+        rt.get_input_handler("In").send((2,))
+        assert [tuple(e.data) for p in sink.delivered for e in p] == [(2,)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_wait_blocks_then_delivers(self):
+        mgr, rt, sink = _sink_app("WAIT")
+        sink.down = True
+        sink.conn_down = True
+        done = threading.Event()
+
+        def send():
+            rt.get_input_handler("In").send((1,))
+            done.set()
+
+        t = threading.Thread(target=send, daemon=True)
+        t.start()
+        assert not done.wait(0.2)  # caller is blocked while the link is down
+        sink.down = False
+        sink.conn_down = False
+        assert done.wait(5.0)  # reconnect chain lands, payload delivered
+        assert [tuple(e.data) for p in sink.delivered for e in p] == [(1,)]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_wait_shutdown_spills_to_error_store(self):
+        mgr, rt, sink = _sink_app("WAIT")
+        sink.down = True
+        sink.conn_down = True
+        done = threading.Event()
+
+        def send():
+            rt.get_input_handler("In").send((1,))
+            done.set()
+
+        threading.Thread(target=send, daemon=True).start()
+        assert not done.wait(0.2)
+        rt.shutdown()  # stops sinks: the WAIT loop must exit, not drop silently
+        assert done.wait(5.0)
+        assert sink.delivered == []
+        entries = mgr.error_store.load(origin="sink")
+        assert len(entries) == 1 and entries[0].stream_id == "Out"
+        mgr.shutdown()
+
+    def test_store_spills_and_replay_republishes(self):
+        mgr, rt, sink = _sink_app("STORE")
+        sink.down = True
+        rt.get_input_handler("In").send((1,))
+        assert sink.delivered == []
+        entries = mgr.error_store.load(origin="sink")
+        assert len(entries) == 1 and entries[0].stream_id == "Out"
+        sink.down = False
+        assert mgr.replay_errors() == 1
+        assert [tuple(e.data) for p in sink.delivered for e in p] == [(1,)]
+        assert mgr.error_store.size() == 0
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_failed_replay_against_log_sink_keeps_entry(self):
+        # an entry replayed into a still-down LOG sink is dropped by the
+        # sink's policy, so the store must keep it for a later attempt
+        mgr, rt, sink = _sink_app("LOG")
+        from siddhi_tpu.core.error_store import ORIGIN_SINK, make_entry
+
+        mgr.error_store.store(make_entry(
+            "SK_LOG", ORIGIN_SINK, "Out", "old failure", payload=[],
+        ))
+        sink.down = True
+        assert mgr.replay_errors() == 0
+        assert mgr.error_store.size() == 1
+        sink.down = False
+        assert mgr.replay_errors() == 1
+        assert mgr.error_store.size() == 0
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_invalid_retry_options_rejected_at_creation(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime("""
+            @sink(type='log', retry.jitter='2.5', @map(type='text'))
+            define stream Out (v int);
+            """)
+        mgr.shutdown()
+
+    def test_log_drops_and_recovers(self):
+        mgr, rt, sink = _sink_app("LOG")
+        sink.down = True
+        rt.get_input_handler("In").send((1,))
+        assert sink.delivered == []  # dropped
+        sink.down = False
+        time.sleep(0.12)  # background reconnect backoff
+        rt.get_input_handler("In").send((2,))
+        assert [tuple(e.data) for p in sink.delivered for e in p] == [(2,)]
+        rt.shutdown()
+        mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# backoff counter
+# ---------------------------------------------------------------------------
+
+
+class TestBackoffRetryCounter:
+    def test_default_sequence_unchanged(self):
+        c = BackoffRetryCounter()
+        seq = [c.next_interval_ms() for _ in range(10)]
+        assert seq == [50, 100, 500, 1000, 5000, 10000, 30000, 60000, 60000, 60000]
+        c.reset()
+        assert c.next_interval_ms() == 50
+
+    def test_interval_cap(self):
+        c = BackoffRetryCounter(max_interval_ms=750)
+        assert [c.next_interval_ms() for _ in range(5)] == [50, 100, 500, 750, 750]
+
+    def test_jitter_bounded(self):
+        import random
+
+        c = BackoffRetryCounter(jitter=0.5, rand=random.Random(42))
+        for base in [50, 100, 500, 1000]:
+            iv = c.next_interval_ms()
+            assert base <= iv <= int(base * 1.5)
+
+    def test_jitter_never_exceeds_cap(self):
+        import random
+
+        c = BackoffRetryCounter(max_interval_ms=100, jitter=1.0, rand=random.Random(7))
+        for _ in range(6):
+            assert c.next_interval_ms() <= 100  # the cap is a hard ceiling
+
+    def test_invalid_jitter_rejected(self):
+        with pytest.raises(ValueError):
+            BackoffRetryCounter(jitter=1.5)
+
+
+# ---------------------------------------------------------------------------
+# statistics: dispatch failures are counted
+# ---------------------------------------------------------------------------
+
+
+class TestErrorStatistics:
+    def test_error_counter_in_report(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('ST1')
+        @app:statistics(reporter='log', interval='3600')
+        @OnError(action='LOG')
+        define stream S (v int);
+        from S select v insert into Out;
+        """)
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 1))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send((1,))
+        h.send((0,))
+        rep = rt.statistics_manager.report()
+        assert rep["errors"]["stream.S"] == 1
+        assert rep["throughput"]["stream.S"] == 2
+        rt.shutdown()
+        mgr.shutdown()

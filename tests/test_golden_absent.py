@@ -280,10 +280,17 @@ class TestOrAbsentWithWaitingGolden:
         assert got == [("WSO2", None)]
 
     def test_or14_nothing_before_deadline(self):
-        # testQueryAbsent14: e1 only, checked before the waiting time elapses
-        got = run_timed(self.QL, [
-            ("send", "Stream1", ("WSO2", 15.0, 100)),
-        ], settle=0.05, warm=self.WARM)
+        # testQueryAbsent14: e1 only, checked before the waiting time elapses.
+        # The check races the 150 ms wall-clock deadline with ~100 ms of
+        # margin, so a loaded machine can legitimately cross it before the
+        # assert runs; retry a bounded number of times — a deterministic
+        # too-early emission still fails every attempt.
+        for attempt in range(3):
+            got = run_timed(self.QL, [
+                ("send", "Stream1", ("WSO2", 15.0, 100)),
+            ], settle=0.05, warm=self.WARM)
+            if got == []:
+                break
         assert got == []
 
     def test_or15_b_arrival_disables_absent_side(self):
