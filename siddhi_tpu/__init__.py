@@ -21,6 +21,30 @@ from siddhi_tpu.core.error_store import InMemoryErrorStore  # noqa: E402,F401
 from siddhi_tpu.core.manager import SiddhiManager  # noqa: E402,F401
 from siddhi_tpu.core.types import AttrType  # noqa: E402,F401
 
+# analysis exports resolve lazily (PEP 562): `import siddhi_tpu` must not pay
+# for the analyzer subsystem unless analyze()/strict mode is actually used
+_ANALYSIS_EXPORTS = {
+    "analyze", "AnalysisResult", "Diagnostic", "SiddhiAnalysisError",
+}
+
+
+def __getattr__(name):
+    if name in _ANALYSIS_EXPORTS:
+        import siddhi_tpu.analysis as _analysis
+
+        return getattr(_analysis, name)
+    raise AttributeError(f"module 'siddhi_tpu' has no attribute '{name}'")
+
+
 __version__ = "0.1.0"
 
-__all__ = ["SiddhiManager", "AttrType", "InMemoryErrorStore", "__version__"]
+__all__ = [
+    "SiddhiManager",
+    "AttrType",
+    "InMemoryErrorStore",
+    "analyze",
+    "AnalysisResult",
+    "Diagnostic",
+    "SiddhiAnalysisError",
+    "__version__",
+]

@@ -1,0 +1,51 @@
+"""siddhi_tpu.analysis — compile-time semantic analyzer + SiddhiQL linter.
+
+Public API:
+
+    from siddhi_tpu.analysis import analyze
+    result = analyze(app_or_source)     # SiddhiApp AST or SiddhiQL text
+    result.ok, result.errors, result.warnings
+    result.raise_if_errors()            # -> SiddhiAnalysisError
+
+Integration points:
+
+* `SiddhiManager.create_siddhi_app_runtime(app, strict=True)` (alias
+  `create_runtime`) runs this pass first and raises one
+  `SiddhiAnalysisError` aggregating every error diagnostic.
+* CLI: `python -m siddhi_tpu.analysis app.siddhi [--format=text|json]
+  [--werror]` — stable SA### codes documented in the README.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+from siddhi_tpu.analysis.analyzer import analyze as _analyze_app
+from siddhi_tpu.analysis.diagnostics import (
+    CODES,
+    ERROR,
+    WARNING,
+    AnalysisResult,
+    Diagnostic,
+    SiddhiAnalysisError,
+)
+from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+__all__ = [
+    "analyze",
+    "AnalysisResult",
+    "Diagnostic",
+    "SiddhiAnalysisError",
+    "CODES",
+    "ERROR",
+    "WARNING",
+]
+
+
+def analyze(app: Union[str, SiddhiApp]) -> AnalysisResult:
+    """Semantic analysis of a SiddhiApp (AST or SiddhiQL source text)."""
+    if isinstance(app, str):
+        from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+        app = SiddhiCompiler.parse(app)
+    return _analyze_app(app)

@@ -1,0 +1,90 @@
+"""SiddhiQL linter CLI.
+
+    python -m siddhi_tpu.analysis app.siddhi [more.siddhi ...]
+        [--format=text|json] [--werror] [--codes]
+
+Exit codes: 0 clean, 1 semantic errors (or warnings under --werror),
+2 unreadable/unparsable input. Parse errors are reported as SA001 with the
+parser's line/column rather than a traceback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from siddhi_tpu.analysis import CODES
+from siddhi_tpu.analysis.diagnostics import AnalysisResult, Diagnostic, ERROR
+from siddhi_tpu.core.errors import SiddhiParserError
+
+
+def _lint_source(source: str) -> AnalysisResult:
+    from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+    try:
+        app = SiddhiCompiler.parse(source)
+    except SiddhiParserError as exc:
+        return AnalysisResult([
+            Diagnostic(
+                "SA001", str(exc),
+                getattr(exc, "line", None), getattr(exc, "col", None),
+                ERROR,
+            )
+        ])
+    from siddhi_tpu.analysis.analyzer import analyze as analyze_app
+
+    return analyze_app(app)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m siddhi_tpu.analysis",
+        description="Compile-time semantic analyzer / linter for SiddhiQL apps.",
+    )
+    ap.add_argument("files", nargs="*", help="SiddhiQL app files ('-' = stdin)")
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="diagnostic output format (default: text)",
+    )
+    ap.add_argument(
+        "--werror", action="store_true",
+        help="treat warnings as errors (non-zero exit on any diagnostic)",
+    )
+    ap.add_argument(
+        "--codes", action="store_true",
+        help="print the SA### diagnostic catalog and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.codes:
+        for code, desc in sorted(CODES.items()):
+            print(f"{code}  {desc}")
+        return 0
+    if not args.files:
+        ap.error("no input files (or use --codes)")
+
+    worst = 0
+    for path in args.files:
+        try:
+            source = (
+                sys.stdin.read() if path == "-" else open(path).read()
+            )
+        except OSError as exc:
+            print(f"{path}: cannot read: {exc}", file=sys.stderr)
+            worst = max(worst, 2)
+            continue
+        result = _lint_source(source)
+        name = "<stdin>" if path == "-" else path
+        if args.format == "json":
+            print(result.to_json(name))
+        else:
+            print(result.format(name))
+        if any(d.code == "SA001" for d in result.diagnostics):
+            worst = max(worst, 2)
+        elif result.errors or (args.werror and result.warnings):
+            worst = max(worst, 1)
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

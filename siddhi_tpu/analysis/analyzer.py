@@ -1,0 +1,844 @@
+"""Semantic analysis pass over a parsed `SiddhiApp`.
+
+`analyze(app)` runs before (and independently of) runtime construction and
+returns an `AnalysisResult` of `Diagnostic`s:
+
+* name resolution — streams / tables / windows / aggregations / fault
+  streams / join aliases / pattern state labels, undefined and duplicate
+  references (SA1xx);
+* type inference over `core/types.py` promotion rules — incompatible
+  compares, arithmetic on STRING/BOOL, non-boolean filters, insert-into
+  arity/type mismatches (SA2xx);
+* window / stream-function / aggregator name + argument validation against
+  the builtin tables and the extension registry (SA3xx);
+* stream->query dataflow (dead streams, unfed windows, cycles — SA4xx,
+  warnings).
+
+The analyzer is deliberately *under*-approximate: anything it cannot know
+statically (extension return types, schemas downstream of extension stream
+functions) becomes "unknown" and related checks are skipped, so a clean
+result is trustworthy and a reported error is near-certain to fail at
+`create_runtime` or later.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from siddhi_tpu.core.types import NUMERIC_TYPES, AttrType, promote
+from siddhi_tpu.query_api.definition import AggregationDefinition, WindowDefinition
+from siddhi_tpu.query_api.execution import (
+    DeleteStream,
+    Filter,
+    InsertIntoStream,
+    JoinInputStream,
+    OrderByAttribute,
+    Partition,
+    Query,
+    ReturnStream,
+    Selector,
+    SingleInputStream,
+    StateInputStream,
+    StreamFunctionHandler,
+    UpdateOrInsertStream,
+    UpdateStream,
+    WindowHandler,
+    iter_state_streams,
+)
+from siddhi_tpu.query_api.expression import Variable
+from siddhi_tpu.query_api.annotation import find_annotation
+from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+from siddhi_tpu.analysis.dataflow import QueryFlow, check_dataflow
+from siddhi_tpu.analysis.diagnostics import (
+    WARNING,
+    AnalysisResult,
+    Diagnostic,
+)
+from siddhi_tpu.analysis.registries import check_stream_function, check_window
+from siddhi_tpu.analysis.symbols import SymbolTable, build_symbols
+from siddhi_tpu.analysis.typecheck import AnalysisScope, ExprChecker, _loc
+
+
+def analyze(app: SiddhiApp) -> AnalysisResult:
+    """Run the full semantic pass. Never raises on bad apps — every finding
+    becomes a Diagnostic; an unexpected analyzer fault degrades to an SA000
+    warning rather than masking runtime behavior."""
+    diags: list[Diagnostic] = []
+    try:
+        _analyze(app, diags)
+    except Exception as exc:  # pragma: no cover - analyzer defect guard
+        diags.append(Diagnostic(
+            "SA000",
+            f"internal analyzer error, analysis incomplete: {exc!r}",
+            severity=WARNING,
+        ))
+    return AnalysisResult(diags, app_name=app.name)
+
+
+def _analyze(app: SiddhiApp, diags: list[Diagnostic]) -> None:
+    sym = build_symbols(app, diags)
+    flows: list[QueryFlow] = []
+
+    for wd in app.window_definitions.values():
+        _check_window_definition(wd, sym, diags)
+
+    for ad in app.aggregation_definitions.values():
+        _check_aggregation_definition(ad, sym, diags, flows)
+
+    # query id assignment mirrors SiddhiAppRuntime.__init__: explicit @info
+    # names are reserved app-wide, unnamed queries take the next free queryN
+    taken: dict[str, int] = {}
+    for elem in app.execution_elements:
+        inner = [elem] if isinstance(elem, Query) else list(
+            getattr(elem, "queries", []) or []
+        )
+        for q in inner:
+            info = find_annotation(q.annotations, "info")
+            name = info.element("name") if info else None
+            if name:
+                taken[name] = taken.get(name, 0) + 1
+                if taken[name] == 2:  # report once per duplicated name
+                    line, col = _loc(q)
+                    diags.append(Diagnostic(
+                        "SA105", f"duplicate query name '{name}'", line, col
+                    ))
+
+    unnamed = 0
+    inferred_targets: dict[str, list] = {}
+    n_partitions = 0
+    for elem in app.execution_elements:
+        if isinstance(elem, Query):
+            info = find_annotation(elem.annotations, "info")
+            qid = info.element("name") if info else None
+            if not qid:
+                while f"query{unnamed}" in taken:
+                    unnamed += 1
+                qid = f"query{unnamed}"
+                unnamed += 1
+            _analyze_query(elem, qid, sym, diags, inferred_targets, flows)
+        elif isinstance(elem, Partition):
+            _analyze_partition(
+                elem, f"partition{n_partitions}", sym, diags,
+                inferred_targets, flows,
+            )
+            n_partitions += 1
+
+    check_dataflow(app, sym, flows, diags)
+
+
+# ---------------------------------------------------------------------------
+# definitions
+# ---------------------------------------------------------------------------
+
+
+def _check_window_definition(
+    wd: WindowDefinition, sym: SymbolTable, diags: list[Diagnostic]
+) -> None:
+    checker = ExprChecker(sym, diags)
+    scope = AnalysisScope().add(wd.id, sym.windows.get(wd.id) or {})
+    if wd.window is not None:
+        check_window(wd.window, checker, scope, diags, None)
+
+
+def _check_aggregation_definition(
+    ad: AggregationDefinition,
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+    flows: list[QueryFlow],
+) -> None:
+    stream = ad.basic_single_input_stream
+    if stream is None:
+        return
+    qid = f"aggregation '{ad.id}'"
+    checker = ExprChecker(sym, diags, query=qid)
+    schema = sym.streams.get(stream.stream_id)
+    if stream.stream_id not in sym.streams:
+        line, col = _loc(stream)
+        diags.append(Diagnostic(
+            "SA101",
+            f"aggregation '{ad.id}': stream '{stream.stream_id}' is not defined",
+            line, col, query=qid,
+        ))
+        return
+    scope = AnalysisScope()
+    ref = stream.alias or stream.stream_id
+    scope.add(ref, dict(schema) if schema is not None else None)
+    if ref != stream.stream_id:
+        scope.add(stream.stream_id, dict(schema) if schema is not None else None)
+    schema2 = _apply_handlers(stream, schema, ref, checker, scope, diags, qid)
+    scope.refs[ref] = schema2
+    if ad.selector is not None:
+        _analyze_selector(
+            ad.selector, checker, scope,
+            list(schema2.items()) if schema2 is not None else None,
+        )
+    flows.append(QueryFlow(qid, consumes={stream.stream_id}, produces=None))
+
+
+# ---------------------------------------------------------------------------
+# query inputs
+# ---------------------------------------------------------------------------
+
+
+def _inferred_schema(inferred_targets: Optional[dict], sid: str):
+    """Schema of a stream defined implicitly by an earlier insert-into
+    (mirrors _wire_insert registering the inferred StreamSchema). Returns
+    (found, schema|None-open)."""
+    if inferred_targets is None or sid not in inferred_targets:
+        return False, None
+    attrs = inferred_targets[sid]
+    if any(n is None for n, _t in attrs):
+        return True, None  # unnameable projection: stay open
+    return True, {n: t for n, t in attrs}
+
+
+def _resolve_single_source(
+    s: SingleInputStream,
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+    qid: Optional[str],
+    inner_schemas: Optional[dict],
+    allow_windows: bool,
+    inferred_targets: Optional[dict] = None,
+) -> tuple[bool, Optional[dict]]:
+    """Resolve a `from X` source to (found, schema|None-open)."""
+    sid = s.stream_id
+    line, col = _loc(s)
+
+    def err(code: str, msg: str) -> tuple[bool, Optional[dict]]:
+        diags.append(Diagnostic(code, msg, line, col, query=qid))
+        return False, None
+
+    if s.is_inner:
+        if inner_schemas is not None:
+            if sid in inner_schemas:
+                return True, inner_schemas[sid]
+            return err(
+                "SA101",
+                f"inner stream '#{sid}' is not produced by an earlier query "
+                "in this partition",
+            )
+        # outside partitions the runtime resolves '#x' as the plain stream x
+        if sid in sym.streams:
+            return True, sym.streams[sid]
+        return err("SA101", f"stream '#{sid}' is not defined")
+
+    if s.is_fault or sid.startswith("!"):
+        parent = sid[1:]
+        if sid in sym.streams:
+            return True, sym.streams[sid]
+        if parent in sym.streams:
+            return err(
+                "SA106",
+                f"fault stream '{sid}' does not exist: stream '{parent}' "
+                "does not declare @OnError(action='STREAM')",
+            )
+        return err("SA101", f"stream '{parent}' is not defined")
+
+    if sid in sym.streams:
+        return True, sym.streams[sid]
+    if allow_windows and sid in sym.windows:
+        return True, sym.windows[sid]
+    found, schema = _inferred_schema(inferred_targets, sid)
+    if found:
+        return True, schema
+    kind = sym.describe(sid)
+    if kind is not None:
+        hint = (
+            f" ('{sid}' is a {kind} — it cannot be consumed as a stream here)"
+        )
+    elif not allow_windows and sid in sym.windows:
+        hint = f" ('{sid}' is a named window — patterns consume streams only)"
+    else:
+        hint = ""
+    return err("SA101", f"stream '{sid}' is not defined{hint}")
+
+
+def _apply_handlers(
+    s: SingleInputStream,
+    schema: Optional[dict],
+    ref: str,
+    checker: ExprChecker,
+    scope: AnalysisScope,
+    diags: list[Diagnostic],
+    qid: Optional[str],
+    allow_windows: bool = True,
+) -> Optional[dict]:
+    """Walk a source's handler chain (filters / windows / stream functions),
+    returning the post-chain schema (None = open). Keeps `scope.refs[ref]`
+    up to date so later filters see appended stream-function attrs."""
+    cur = dict(schema) if schema is not None else None
+    scope.refs[ref] = cur
+    saw_window = False
+    for h in s.handlers:
+        if isinstance(h, Filter):
+            t = checker.infer_no_agg(h.expression, scope)
+            if t is not None and t is not AttrType.BOOL:
+                line, col = _loc(h.expression)
+                diags.append(Diagnostic(
+                    "SA203",
+                    f"filter must be a boolean expression, got {t!r}",
+                    line, col, query=qid,
+                ))
+        elif isinstance(h, WindowHandler):
+            if saw_window:
+                line, col = _loc(h)
+                diags.append(Diagnostic(
+                    "SA302", "only one window per stream", line, col, query=qid
+                ))
+            saw_window = True
+            check_window(h.window, checker, scope, diags, qid)
+        elif isinstance(h, StreamFunctionHandler):
+            ok, new_attrs = check_stream_function(h, checker, scope, diags, qid)
+            if not ok:
+                continue
+            if new_attrs is None:
+                cur = None  # extension output: schema now unknown
+            elif cur is not None:
+                for name, t in new_attrs.items():
+                    if name in cur:
+                        line, col = _loc(h)
+                        diags.append(Diagnostic(
+                            "SA302",
+                            f"stream function '#{h.name}' output '{name}' "
+                            "collides with an existing attribute",
+                            line, col, query=qid,
+                        ))
+                    cur[name] = t
+            scope.refs[ref] = cur
+    return cur
+
+
+def _analyze_query(
+    query: Query,
+    qid: str,
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+    inferred_targets: dict,
+    flows: list[QueryFlow],
+    inner_schemas: Optional[dict] = None,
+    inner_ns: str = "",
+) -> Optional[list]:
+    """Analyze one query; returns its output attrs (for partition inner
+    streams) — list[(name, AttrType|None)] or None when unknown."""
+    checker = ExprChecker(sym, diags, query=qid)
+    scope = AnalysisScope()
+    consumes: set[str] = set()
+    star_attrs: Optional[list] = None
+
+    stream = query.input_stream
+    if isinstance(stream, SingleInputStream):
+        found, schema = _resolve_single_source(
+            stream, sym, diags, qid, inner_schemas, allow_windows=True,
+            inferred_targets=inferred_targets,
+        )
+        ref = stream.ref
+        scope.add(ref, dict(schema) if schema is not None else None)
+        if found and ref != stream.stream_id:
+            scope.add(
+                stream.stream_id, dict(schema) if schema is not None else None
+            )
+        scope.default_ref = ref
+        if found:
+            # inner streams get a per-partition namespaced node id so the
+            # dataflow graph connects them to their producers (and two
+            # partitions' same-named inner streams stay distinct)
+            consumes.add(
+                f"{inner_ns}#{stream.stream_id}"
+                if stream.is_inner and inner_schemas is not None
+                else stream.stream_id
+            )
+        # handlers are validated even when the source is undefined (open
+        # schema): a window/function typo is independent of the stream typo
+        out_schema = _apply_handlers(
+            stream, schema if found else None, ref, checker, scope, diags, qid
+        )
+        star_attrs = (
+            list(out_schema.items())
+            if found and out_schema is not None
+            else None
+        )
+
+    elif isinstance(stream, JoinInputStream):
+        star_attrs = _analyze_join_input(
+            stream, checker, scope, sym, diags, qid, consumes, inferred_targets
+        )
+
+    elif isinstance(stream, StateInputStream):
+        star_attrs = _analyze_state_input(
+            stream, checker, scope, sym, diags, qid, consumes, inferred_targets
+        )
+
+    out_attrs = _analyze_selector(query.selector, checker, scope, star_attrs)
+    produces = _analyze_output(
+        query, qid, out_attrs, sym, diags, inferred_targets, scope, checker,
+        inner_ns=inner_ns,
+    )
+    flows.append(QueryFlow(qid, consumes=consumes, produces=produces))
+    return out_attrs
+
+
+def _analyze_join_input(
+    join: JoinInputStream,
+    checker: ExprChecker,
+    scope: AnalysisScope,
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+    qid: str,
+    consumes: set,
+    inferred_targets: Optional[dict] = None,
+) -> Optional[list]:
+    side_base: list[Optional[list]] = []
+    for s in (join.left, join.right):
+        sid = s.stream_id
+        line, col = _loc(s)
+        schema: Optional[dict]
+        if sid in sym.streams:
+            schema = sym.streams[sid]
+            consumes.add(sid)
+        elif sid in sym.tables:
+            schema = sym.tables[sid]
+        elif sid in sym.windows:
+            schema = sym.windows[sid]
+            consumes.add(sid)
+        elif sid in sym.aggregations:
+            schema = None  # aggregation bucket view: open
+        elif (inf := _inferred_schema(inferred_targets, sid))[0]:
+            schema = inf[1]
+            consumes.add(sid)
+        elif sid.startswith("!") and sid[1:] in sym.streams:
+            diags.append(Diagnostic(
+                "SA106",
+                f"fault stream '{sid}' does not exist: stream '{sid[1:]}' "
+                "does not declare @OnError(action='STREAM')",
+                line, col, query=qid,
+            ))
+            schema = None
+        else:
+            diags.append(Diagnostic(
+                "SA101", f"stream '{sid}' is not defined", line, col, query=qid
+            ))
+            schema = None
+        side_base.append(
+            list(schema.items()) if schema is not None else None
+        )
+        ref = s.ref
+        # join scope registers the two side refs only (join.py:404-409)
+        post = _apply_handlers(s, schema, ref, checker, scope, diags, qid)
+        scope.refs[ref] = post
+    scope.default_ref = join.left.ref
+
+    if join.on is not None:
+        t = checker.infer_no_agg(join.on, scope)
+        if t is not None and t is not AttrType.BOOL:
+            line, col = _loc(join.on)
+            diags.append(Diagnostic(
+                "SA203",
+                f"join 'on' must be a boolean expression, got {t!r}",
+                line, col, query=qid,
+            ))
+
+    if side_base[0] is None or side_base[1] is None:
+        return None
+    return side_base[0] + side_base[1]
+
+
+def _analyze_state_input(
+    state_stream: StateInputStream,
+    checker: ExprChecker,
+    scope: AnalysisScope,
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+    qid: str,
+    consumes: set,
+    inferred_targets: Optional[dict] = None,
+) -> Optional[list]:
+    atoms = list(iter_state_streams(state_stream.state))
+    # register every ref before checking any atom filter: pattern conditions
+    # may reference other state labels (pattern.py scope construction)
+    atom_schemas: list[Optional[dict]] = []
+    for s in atoms:
+        found, schema = _resolve_single_source(
+            s, sym, diags, qid, None, allow_windows=False,
+            inferred_targets=inferred_targets,
+        )
+        if found:
+            consumes.add(s.stream_id)
+        atom_schemas.append(dict(schema) if schema is not None else None)
+        scope.add(s.ref, atom_schemas[-1])
+    if atoms:
+        scope.default_ref = atoms[0].ref
+
+    for s, schema in zip(atoms, atom_schemas):
+        atom_scope = scope.child()
+        atom_scope.default_ref = s.ref
+        atom_scope.prefer_default = True
+        _apply_handlers(
+            s, schema, s.ref, checker, atom_scope, diags, qid,
+            allow_windows=False,
+        )
+        # appended stream-function attrs become visible pattern-wide
+        scope.refs[s.ref] = atom_scope.refs.get(s.ref, schema)
+
+    # select * over a pattern exposes every ref's attrs; duplicates require
+    # explicit projection (pattern_runtime.py:70-85)
+    flat: list = []
+    seen: set = set()
+    for s in atoms:
+        schema = scope.refs.get(s.ref)
+        if schema is None:
+            return None
+        for name, t in schema.items():
+            if name in seen:
+                continue
+            seen.add(name)
+            flat.append((name, t))
+    return flat
+
+
+# ---------------------------------------------------------------------------
+# selector
+# ---------------------------------------------------------------------------
+
+
+def _analyze_selector(
+    selector: Selector,
+    checker: ExprChecker,
+    scope: AnalysisScope,
+    star_attrs: Optional[list],
+) -> Optional[list]:
+    """Returns the selector's output attrs [(name, type|None)] or None when
+    unknowable (select * over an open input)."""
+    qid = checker.query
+    out_attrs: Optional[list]
+
+    if selector.select_all:
+        out_attrs = list(star_attrs) if star_attrs is not None else None
+    else:
+        out_attrs = []
+        names: set = set()
+        prev_allow = checker.allow_aggregators
+        checker.allow_aggregators = True
+        try:
+            for oa in selector.selection_list:
+                t = checker.infer(oa.expression, scope)
+                name = None
+                if oa.rename:
+                    name = oa.rename
+                elif isinstance(oa.expression, Variable) and oa.expression.attribute:
+                    name = oa.expression.attribute
+                else:
+                    line, col = _loc(oa)
+                    checker.diags.append(Diagnostic(
+                        "SA210",
+                        "expression projections need a name: add `as <name>`",
+                        line, col, query=qid,
+                    ))
+                if name is not None:
+                    if name in names:
+                        line, col = _loc(oa)
+                        checker.diags.append(Diagnostic(
+                            "SA211",
+                            f"duplicate output attribute '{name}'",
+                            line, col, query=qid,
+                        ))
+                    names.add(name)
+                out_attrs.append((name, t))
+        finally:
+            checker.allow_aggregators = prev_allow
+
+    for v in selector.group_by:
+        checker.infer_no_agg(v, scope)
+
+    if selector.having is not None:
+        hav_scope = scope.child()
+        if out_attrs is not None:
+            # output attrs shadow input attrs for unqualified names
+            # (selector.py having scope: __out__ level first)
+            hav_scope.add("__out__", {n: t for n, t in out_attrs if n})
+            hav_scope.default_ref = scope.default_ref
+        prev_allow = checker.allow_aggregators
+        checker.allow_aggregators = True
+        try:
+            t = checker.infer(selector.having, hav_scope)
+        finally:
+            checker.allow_aggregators = prev_allow
+        if t is not None and t is not AttrType.BOOL:
+            line, col = _loc(selector.having)
+            checker.diags.append(Diagnostic(
+                "SA203",
+                f"having must be a boolean expression, got {t!r}",
+                line, col, query=qid,
+            ))
+
+    for ob in selector.order_by:
+        _check_order_by(ob, checker, scope, out_attrs)
+
+    return out_attrs
+
+
+def _check_order_by(
+    ob: OrderByAttribute,
+    checker: ExprChecker,
+    scope: AnalysisScope,
+    out_attrs: Optional[list],
+) -> None:
+    var = ob.variable
+    t: Optional[AttrType]
+    out_names = dict(n_t for n_t in (out_attrs or []) if n_t[0])
+    if var.stream_id is None and var.attribute in out_names:
+        t = out_names[var.attribute]
+    else:
+        t = checker.resolve_variable(var, scope)
+    if t in (AttrType.STRING, AttrType.OBJECT):
+        line, col = _loc(var)
+        checker.diags.append(Diagnostic(
+            "SA212",
+            f"order by '{var.attribute}': STRING/OBJECT sort keys are not "
+            "supported (interned ids are not lexicographic)",
+            line, col, query=checker.query,
+        ))
+
+
+# ---------------------------------------------------------------------------
+# outputs
+# ---------------------------------------------------------------------------
+
+
+def _widening_ok(src: AttrType, dst: AttrType) -> bool:
+    return (
+        src in NUMERIC_TYPES and dst in NUMERIC_TYPES and promote(src, dst) is dst
+    )
+
+
+def _analyze_output(
+    query: Query,
+    qid: str,
+    out_attrs: Optional[list],
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+    inferred_targets: dict,
+    scope: AnalysisScope,
+    checker: ExprChecker,
+    inner_ns: str = "",
+) -> Optional[str]:
+    """Validate the query's output clause; returns the produced stream id
+    (for dataflow), or None."""
+    out = query.output_stream
+    line, col = _loc(out)
+
+    if isinstance(out, InsertIntoStream):
+        target = out.target
+        if out.is_inner:
+            return f"{inner_ns}#{target}"  # partition-inner production
+        if out.is_fault or target.startswith("!"):
+            parent = target[1:]
+            if parent in sym.streams and parent not in sym.fault_parents:
+                diags.append(Diagnostic(
+                    "SA107",
+                    f"insert into '{target}': fault streams exist only for "
+                    f"streams declaring @OnError(action='STREAM') — add it "
+                    f"to '{parent}'",
+                    line, col, query=qid,
+                ))
+                return target
+            if parent not in sym.streams:
+                diags.append(Diagnostic(
+                    "SA101",
+                    f"insert into '{target}': stream '{parent}' is not defined",
+                    line, col, query=qid,
+                ))
+                return target
+
+        declared: Optional[dict] = None
+        widening = False
+        what = "stream"
+        if target in sym.tables:
+            declared = sym.tables[target]
+            widening = True  # tables allow numeric widening on insert
+            what = "table"
+        elif target in sym.streams:
+            declared = sym.streams[target]
+        elif target in sym.windows:
+            declared = sym.windows[target]
+            what = "window"
+
+        if out_attrs is None:
+            return target
+        if declared is not None:
+            _check_insert_schema(
+                target, what, out_attrs, list(declared.items()),
+                diags, qid, line, col, widening,
+            )
+        else:
+            prior = inferred_targets.get(target)
+            if prior is None:
+                inferred_targets[target] = list(out_attrs)
+            else:
+                _check_insert_schema(
+                    target, "stream (inferred from an earlier insert)",
+                    out_attrs, prior, diags, qid, line, col, False,
+                )
+        return target
+
+    if isinstance(out, (DeleteStream, UpdateStream, UpdateOrInsertStream)):
+        target = out.target
+        table = sym.tables.get(target)
+        if table is None:
+            diags.append(Diagnostic(
+                "SA108",
+                f"'{target}' is not a defined table "
+                f"(tables: {', '.join(sorted(sym.tables)) or 'none'})",
+                line, col, query=qid,
+            ))
+            return None
+        if isinstance(out, UpdateOrInsertStream) and out_attrs is not None:
+            _check_insert_schema(
+                target, "table", out_attrs, list(table.items()),
+                diags, qid, line, col, widening=True,
+            )
+        # on / set clauses resolve against {__out__: selector output, table}
+        # with unqualified names preferring the output (table.py:801-805)
+        op_scope = AnalysisScope()
+        op_scope.add(
+            "__out__",
+            {n: t for n, t in out_attrs if n} if out_attrs is not None else None,
+        )
+        op_scope.add(target, table)
+        op_scope.default_ref = "__out__"
+        op_scope.prefer_default = True
+        if out.on is not None:
+            t = checker.infer_no_agg(out.on, op_scope)
+            if t is not None and t is not AttrType.BOOL:
+                oline, ocol = _loc(out.on)
+                diags.append(Diagnostic(
+                    "SA203",
+                    f"'on' must be a boolean expression, got {t!r}",
+                    oline, ocol, query=qid,
+                ))
+        for sa in getattr(out, "set_attributes", None) or []:
+            tv = sa.table_variable
+            if tv.stream_id is not None and tv.stream_id != target:
+                tline, tcol = _loc(tv)
+                diags.append(Diagnostic(
+                    "SA103",
+                    f"set target '{tv.stream_id}.{tv.attribute}' must be a "
+                    f"column of table '{target}'",
+                    tline, tcol, query=qid,
+                ))
+            elif table is not None and tv.attribute not in table:
+                tline, tcol = _loc(tv)
+                diags.append(Diagnostic(
+                    "SA103",
+                    f"table '{target}' has no column '{tv.attribute}' "
+                    f"(has: {', '.join(table)})",
+                    tline, tcol, query=qid,
+                ))
+            checker.infer_no_agg(sa.expression, op_scope)
+        return None
+
+    if isinstance(out, ReturnStream):
+        return None
+    return None
+
+
+def _check_insert_schema(
+    target: str,
+    what: str,
+    out_attrs: list,
+    declared: list,
+    diags: list[Diagnostic],
+    qid: str,
+    line: Optional[int],
+    col: Optional[int],
+    widening: bool,
+) -> None:
+    if len(out_attrs) != len(declared):
+        diags.append(Diagnostic(
+            "SA205",
+            f"insert into {what} '{target}': selector emits "
+            f"{len(out_attrs)} attribute(s) but the target has "
+            f"{len(declared)}",
+            line, col, query=qid,
+        ))
+        return
+    for (on_, ot), (tn, tt) in zip(out_attrs, declared):
+        if ot is None or tt is None:
+            continue
+        if ot == tt:
+            continue
+        if widening and _widening_ok(ot, tt):
+            continue
+        diags.append(Diagnostic(
+            "SA206",
+            f"insert into {what} '{target}': output attribute "
+            f"'{on_ or '?'}' is {ot!r} but target attribute '{tn}' is {tt!r}",
+            line, col, query=qid,
+        ))
+        return  # first mismatch is enough; the fix usually cascades
+
+
+# ---------------------------------------------------------------------------
+# partitions
+# ---------------------------------------------------------------------------
+
+
+def _analyze_partition(
+    part: Partition,
+    pid: str,
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+    inferred_targets: dict,
+    flows: list[QueryFlow],
+) -> None:
+    from siddhi_tpu.query_api.execution import (
+        RangePartitionType,
+        ValuePartitionType,
+    )
+
+    checker = ExprChecker(sym, diags, query=pid)
+    for pt in part.partition_types:
+        line, col = _loc(pt)
+        schema = sym.streams.get(pt.stream_id)
+        if pt.stream_id not in sym.streams:
+            diags.append(Diagnostic(
+                "SA101",
+                f"partition: stream '{pt.stream_id}' is not defined",
+                line, col, query=pid,
+            ))
+            continue
+        pscope = AnalysisScope().add(
+            pt.stream_id, dict(schema) if schema is not None else None
+        )
+        if isinstance(pt, ValuePartitionType):
+            checker.infer_no_agg(pt.expression, pscope)
+        elif isinstance(pt, RangePartitionType):
+            for rng in pt.ranges:
+                t = checker.infer_no_agg(rng.condition, pscope)
+                if t is not None and t is not AttrType.BOOL:
+                    rline, rcol = _loc(rng.condition)
+                    diags.append(Diagnostic(
+                        "SA203",
+                        "range partition condition must be boolean, "
+                        f"got {t!r}",
+                        rline, rcol, query=pid,
+                    ))
+
+    inner_schemas: dict[str, Optional[dict]] = {}
+    unnamed = 0
+    for q in part.queries:
+        info = find_annotation(q.annotations, "info")
+        qid = (info.element("name") if info else None) or f"{pid}_query{unnamed}"
+        unnamed += 1
+        out_attrs = _analyze_query(
+            q, qid, sym, diags, inferred_targets, flows,
+            inner_schemas=inner_schemas, inner_ns=pid,
+        )
+        out = q.output_stream
+        if isinstance(out, InsertIntoStream) and out.is_inner:
+            inner_schemas[out.target] = (
+                {n: t for n, t in out_attrs if n}
+                if out_attrs is not None
+                else None
+            )

@@ -1,0 +1,116 @@
+"""Stream -> query dataflow graph: dead streams, unfed windows, cycles.
+
+All findings here are warnings (SA4xx): the runtime supports cyclic
+topologies (the app-level processing lock exists for exactly that), input
+handlers can feed any defined stream from outside, and callback-only egress
+streams are legitimate — so none of these shapes is *wrong*, they are just
+worth a look.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from siddhi_tpu.analysis.diagnostics import WARNING, Diagnostic
+
+
+@dataclasses.dataclass
+class QueryFlow:
+    """One query's dataflow contribution: consumed stream ids -> produced
+    stream id (insert-into target; None for return/table outputs)."""
+
+    qid: str
+    consumes: set
+    produces: Optional[str] = None
+
+
+def check_dataflow(app, sym, flows: list[QueryFlow], diags: list[Diagnostic]) -> None:
+    consumed: set = set()
+    produced: set = set()
+    for f in flows:
+        consumed.update(f.consumes)
+        if f.produces is not None:
+            produced.add(f.produces)
+
+    # SA401: streams that participate in nothing at all — not consumed, not
+    # produced, no transport, not a fault parent whose '!S' is consumed
+    for sid, d in app.stream_definitions.items():
+        if sid in consumed or sid in produced:
+            continue
+        if sid in sym.sourced or sid in sym.sinked:
+            continue
+        if ("!" + sid) in consumed or ("!" + sid) in produced:
+            continue
+        diags.append(Diagnostic(
+            "SA401",
+            f"dead stream: '{sid}' is defined but never consumed or produced "
+            "by any query, aggregation, source, or sink",
+            getattr(d, "line", None), getattr(d, "col", None),
+            severity=WARNING,
+        ))
+
+    # SA402: named windows consumed by queries but never fed by an insert
+    for wid, d in app.window_definitions.items():
+        if wid in consumed and wid not in produced:
+            diags.append(Diagnostic(
+                "SA402",
+                f"named window '{wid}' is consumed but no query inserts into "
+                "it — its consumers can only fire on direct input-handler "
+                "sends",
+                getattr(d, "line", None), getattr(d, "col", None),
+                severity=WARNING,
+            ))
+
+    # SA403: cycles in the stream graph (edges: each consumed -> produced)
+    edges: dict[str, set] = {}
+    for f in flows:
+        if f.produces is None:
+            continue
+        for c in f.consumes:
+            edges.setdefault(c, set()).add(f.produces)
+
+    cycle = _find_cycle(edges)
+    if cycle:
+        diags.append(Diagnostic(
+            "SA403",
+            "stream dataflow cycle: " + " -> ".join(cycle)
+            + " (events may loop; ensure a filter breaks the feedback)",
+            severity=WARNING,
+        ))
+
+
+def _find_cycle(edges: dict) -> Optional[list]:
+    """First cycle in the graph as a node path, or None (iterative DFS)."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: dict = {}
+    parent: dict = {}
+    for root in sorted(edges):
+        if color.get(root, WHITE) != WHITE:
+            continue
+        stack = [(root, iter(sorted(edges.get(root, ()))))]
+        color[root] = GRAY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                c = color.get(nxt, WHITE)
+                if c == GRAY:
+                    # found: unwind the gray path from node back to nxt
+                    path = [nxt, node]
+                    cur = node
+                    while cur != nxt and cur in parent:
+                        cur = parent[cur]
+                        path.append(cur)
+                    path.reverse()
+                    return path
+                if c == WHITE:
+                    color[nxt] = GRAY
+                    parent[nxt] = node
+                    stack.append((nxt, iter(sorted(edges.get(nxt, ())))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+    return None

@@ -1,0 +1,243 @@
+"""Window / stream-function name + argument validation.
+
+Mirrors the dispatch tables of `core/windows.py::make_window` and
+`core/stream_function.py::make_stream_function` plus the extension registry
+(`core/extension.py`), without constructing any runtime stage. Extension
+windows/stream functions validate the name only — their parameter contracts
+live in the extension factories.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from siddhi_tpu.core.extension import lookup
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.query_api.definition import WindowSpec
+from siddhi_tpu.query_api.expression import Constant, Expression, Variable
+
+from siddhi_tpu.analysis.diagnostics import Diagnostic
+
+# builtin window name -> (min_args, max_args) with per-window extra checks
+BUILTIN_WINDOWS = {
+    "length": (1, 1),
+    "time": (1, 1),
+    "timelength": (2, 2),
+    "externaltime": (2, 2),
+    "lengthbatch": (1, 1),
+    "timebatch": (1, 2),
+    "externaltimebatch": (2, 4),
+    "sort": (1, None),
+    "frequent": (1, None),
+    "lossyfrequent": (1, None),
+    "cron": (1, 1),
+}
+
+# which builtin window parameter positions must be constant integers/times
+_INT_PARAMS = {
+    "length": (0,),
+    "time": (0,),
+    "timelength": (0, 1),
+    "externaltime": (1,),
+    "lengthbatch": (0,),
+    "timebatch": (0, 1),
+    "externaltimebatch": (1, 2, 3),
+    "sort": (0,),
+    "frequent": (0,),
+}
+
+# parameter positions that must be an attribute of the stream (external time)
+_ATTR_PARAMS = {
+    "externaltime": (0,),
+    "externaltimebatch": (0,),
+}
+
+
+def _window_key(spec: WindowSpec) -> str:
+    return (
+        spec.name.lower()
+        if spec.namespace is None
+        else f"{spec.namespace}:{spec.name}"
+    )
+
+
+def check_window(
+    spec: WindowSpec,
+    checker,
+    scope,
+    diags: list[Diagnostic],
+    query: Optional[str],
+) -> None:
+    """Validate one `#window.name(...)` / window-definition spec."""
+    name = _window_key(spec)
+
+    def diag(code: str, msg: str, node=None) -> None:
+        node = node if node is not None else spec
+        diags.append(Diagnostic(
+            code, msg,
+            getattr(node, "line", None), getattr(node, "col", None),
+            query=query,
+        ))
+
+    if name not in BUILTIN_WINDOWS:
+        if lookup("window", name) is not None:
+            for p in spec.parameters:
+                checker.infer_no_agg(p, scope)
+            return
+        diag("SA301", f"unknown window type '{spec.name}'")
+        return
+
+    lo, hi = BUILTIN_WINDOWS[name]
+    n = len(spec.parameters)
+    if n < lo or (hi is not None and n > hi):
+        expect = f"{lo}" if hi == lo else (f"{lo}+" if hi is None else f"{lo}-{hi}")
+        diag(
+            "SA302",
+            f"window '{spec.name}' takes {expect} parameter(s), got {n}",
+        )
+        return
+
+    for i in _INT_PARAMS.get(name, ()):
+        if i >= n:
+            continue
+        p = spec.parameters[i]
+        if not isinstance(p, Constant):
+            diag(
+                "SA302",
+                f"window '{spec.name}': parameter {i} must be a constant "
+                "integer or time value",
+                p,
+            )
+        elif not isinstance(p.value, (int, float)) or isinstance(p.value, bool):
+            diag(
+                "SA302",
+                f"window '{spec.name}': parameter {i} must be a constant "
+                f"integer or time value, got {p.value!r}",
+                p,
+            )
+
+    for i in _ATTR_PARAMS.get(name, ()):
+        if i >= n:
+            continue
+        p = spec.parameters[i]
+        if not isinstance(p, Variable):
+            diag(
+                "SA302",
+                f"window '{spec.name}': parameter {i} must be an attribute",
+                p,
+            )
+            continue
+        t = checker.resolve_variable(p, scope)
+        if t is not None and t not in (AttrType.INT, AttrType.LONG):
+            diag(
+                "SA302",
+                f"window '{spec.name}': external time attribute "
+                f"'{p.attribute}' must be INT/LONG, got {t!r}",
+                p,
+            )
+
+    if name == "cron":
+        p = spec.parameters[0]
+        if not (isinstance(p, Constant) and isinstance(p.value, str)):
+            diag(
+                "SA302",
+                "window 'cron': parameter 0 must be a constant cron string",
+                p if isinstance(p, Expression) else None,
+            )
+
+    if name == "sort":
+        _check_sort_keys(spec, spec.parameters[1:], checker, scope, diag)
+    elif name == "frequent":
+        for p in spec.parameters[1:]:
+            if not isinstance(p, Variable):
+                diag("SA302", "window 'frequent': keys must be attributes", p)
+            else:
+                checker.resolve_variable(p, scope)
+    elif name == "lossyfrequent":
+        rest = spec.parameters[1:]
+        if rest and isinstance(rest[0], Constant) and not isinstance(
+            rest[0].value, str
+        ):
+            rest = rest[1:]  # optional error-bound constant
+        for p in rest:
+            if not isinstance(p, Variable):
+                diag("SA302", "window 'lossyFrequent': keys must be attributes", p)
+            else:
+                checker.resolve_variable(p, scope)
+
+
+def _check_sort_keys(spec, params, checker, scope, diag) -> None:
+    i = 0
+    while i < len(params):
+        p = params[i]
+        if not isinstance(p, Variable):
+            diag(
+                "SA302",
+                "window 'sort': parameters after the length must be "
+                "attribute [, 'asc'|'desc'] pairs",
+                p,
+            )
+            return
+        checker.resolve_variable(p, scope)
+        if (
+            i + 1 < len(params)
+            and isinstance(params[i + 1], Constant)
+            and str(params[i + 1].value).lower() in ("asc", "desc")
+        ):
+            i += 1
+        i += 1
+
+
+# stream functions: builtin name -> (handler) — returns the appended output
+# attrs, or OPEN (None) when unknown (extension), mirroring
+# stream_function.make_stream_function
+def check_stream_function(
+    handler,
+    checker,
+    scope,
+    diags: list[Diagnostic],
+    query: Optional[str],
+):
+    """Validate a `#ns:name(...)` handler. Returns (ok, new_attrs) where
+    new_attrs is a dict of appended attributes, or None when the function is
+    an extension whose output attributes are unknowable statically."""
+    name = (
+        f"{handler.namespace}:{handler.name}"
+        if handler.namespace
+        else handler.name
+    ).lower()
+
+    def diag(code: str, msg: str, node=None) -> None:
+        node = node if node is not None else handler
+        diags.append(Diagnostic(
+            code, msg,
+            getattr(node, "line", None), getattr(node, "col", None),
+            query=query,
+        ))
+
+    if name == "log":
+        return True, {}
+
+    if name == "pol2cart":
+        if len(handler.parameters) not in (2, 3):
+            diag("SA302", "pol2Cart(theta, rho[, z]) needs 2-3 arguments")
+        new = {"x": AttrType.DOUBLE, "y": AttrType.DOUBLE}
+        for p in handler.parameters:
+            t = checker.infer_no_agg(p, scope)
+            if t is not None and t not in (
+                AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE,
+            ):
+                diag("SA302", f"pol2Cart arguments must be numeric, got {t!r}", p)
+        if len(handler.parameters) > 2:
+            new["z"] = AttrType.DOUBLE
+        return True, new
+
+    if lookup("stream_function", name) is not None or lookup(
+        "stream_processor", name
+    ) is not None:
+        for p in handler.parameters:
+            checker.infer_no_agg(p, scope)
+        return True, None  # extension: appended attrs unknown
+
+    diag("SA303", f"unknown stream function '#{name}'")
+    return False, {}

@@ -1,0 +1,127 @@
+"""Symbol table: the definitions pass of the semantic analyzer.
+
+Collects every name a query can reference — streams (plus @OnError fault
+streams and trigger streams), tables, named windows, aggregations, and script
+functions — mirroring what `SiddhiAppRuntime.__init__` registers at creation
+time (app_runtime.py stream_schemas / tables / named_windows / aggregations).
+
+A schema is a dict `attr -> AttrType | None`; the whole schema may instead be
+`OPEN` (None) meaning "attributes unknown" — e.g. downstream of an extension
+stream function — in which case attribute checks are skipped rather than
+guessed at.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.query_api.annotation import find_annotation
+from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+from siddhi_tpu.analysis.diagnostics import Diagnostic
+
+# schema type: dict[attr] -> AttrType | None (None = unknown attr type)
+Schema = dict
+
+
+@dataclasses.dataclass
+class SymbolTable:
+    streams: dict[str, Optional[Schema]] = dataclasses.field(default_factory=dict)
+    tables: dict[str, Optional[Schema]] = dataclasses.field(default_factory=dict)
+    windows: dict[str, Optional[Schema]] = dataclasses.field(default_factory=dict)
+    aggregations: dict[str, Optional[Schema]] = dataclasses.field(default_factory=dict)
+    # script-defined functions: name -> return AttrType
+    functions: dict[str, AttrType] = dataclasses.field(default_factory=dict)
+    # streams declaring @OnError(action='STREAM') (fault stream '!S' exists)
+    fault_parents: set = dataclasses.field(default_factory=set)
+    # streams carrying a @source / declared triggers (dataflow producers)
+    sourced: set = dataclasses.field(default_factory=set)
+    # streams carrying a @sink (dataflow consumers)
+    sinked: set = dataclasses.field(default_factory=set)
+
+    def consumable(self, stream_id: str) -> Optional[Schema]:
+        """Schema for a `from X` source (stream, fault stream, or window);
+        KeyError semantics are the caller's job — returns a sentinel miss."""
+        if stream_id in self.streams:
+            return self.streams[stream_id]
+        if stream_id in self.windows:
+            return self.windows[stream_id]
+        raise KeyError(stream_id)
+
+    def describe(self, stream_id: str) -> Optional[str]:
+        """What a name IS, for better undefined-stream messages."""
+        if stream_id in self.tables:
+            return "table"
+        if stream_id in self.aggregations:
+            return "aggregation"
+        return None
+
+
+def _attrs_schema(definition, diags: list[Diagnostic], what: str) -> Schema:
+    schema: Schema = {}
+    for a in definition.attributes:
+        if a.name in schema:
+            diags.append(Diagnostic(
+                "SA109",
+                f"duplicate attribute '{a.name}' in {what} '{definition.id}'",
+                getattr(a, "line", None), getattr(a, "col", None),
+            ))
+        schema[a.name] = a.type
+    return schema
+
+
+def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
+    sym = SymbolTable()
+
+    for sid, d in app.stream_definitions.items():
+        sym.streams[sid] = _attrs_schema(d, diags, "stream")
+        if find_annotation(d.annotations, "source") is not None:
+            sym.sourced.add(sid)
+        if find_annotation(d.annotations, "sink") is not None:
+            sym.sinked.add(sid)
+        oe = find_annotation(d.annotations, "OnError")
+        if oe is None:
+            continue
+        action = (oe.element("action") or oe.element(None) or "LOG").upper()
+        if action not in ("LOG", "STREAM", "STORE"):
+            diags.append(Diagnostic(
+                "SA110",
+                f"stream '{sid}': unknown @OnError action '{action}' "
+                "(expected LOG, STREAM, or STORE)",
+                getattr(d, "line", None), getattr(d, "col", None),
+            ))
+            continue
+        if action == "STREAM":
+            if "_error" in sym.streams[sid]:
+                diags.append(Diagnostic(
+                    "SA111",
+                    f"stream '{sid}': @OnError(action='STREAM') reserves the "
+                    "attribute name '_error'",
+                    getattr(d, "line", None), getattr(d, "col", None),
+                ))
+            sym.fault_parents.add(sid)
+            fault = dict(sym.streams[sid])
+            fault["_error"] = AttrType.STRING
+            sym.streams["!" + sid] = fault
+
+    for tid, d in app.table_definitions.items():
+        sym.tables[tid] = _attrs_schema(d, diags, "table")
+
+    for wid, d in app.window_definitions.items():
+        sym.windows[wid] = _attrs_schema(d, diags, "window")
+
+    # triggers each define a stream <id>(triggered_time long)
+    # (reference: DefinitionParserHelper trigger stream registration)
+    for tid in app.trigger_definitions:
+        sym.streams[tid] = {"triggered_time": AttrType.LONG}
+        sym.sourced.add(tid)
+
+    for fid, fdef in app.function_definitions.items():
+        sym.functions[fid] = fdef.return_type
+
+    for aid in app.aggregation_definitions:
+        sym.aggregations[aid] = None  # bucket-view schema: leave open
+
+    return sym

@@ -1,0 +1,570 @@
+"""Expression scope resolution + type inference for the semantic analyzer.
+
+Mirrors `core/executor.py` (Scope._resolve, _arith/promote, _compare,
+_require_bool, _compile_function) and `core/aggregators.py` (build_aggregator
+type matrix) — but instead of compiling, it *infers* and reports diagnostics
+with source locations, and it degrades gracefully: any type it cannot know
+statically (extension functions, open schemas downstream of extension stream
+functions) becomes `None` ("unknown") and downstream checks are skipped
+rather than guessed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from siddhi_tpu.core.executor import AGGREGATOR_NAMES
+from siddhi_tpu.core.types import NUMERIC_TYPES, AttrType, promote
+from siddhi_tpu.query_api.expression import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Divide,
+    Expression,
+    In,
+    IsNull,
+    Mod,
+    Multiply,
+    Not,
+    Or,
+    Subtract,
+    Variable,
+)
+
+from siddhi_tpu.analysis.diagnostics import ERROR, WARNING, Diagnostic
+
+_TYPE_NAMES = {
+    "string": AttrType.STRING,
+    "int": AttrType.INT,
+    "long": AttrType.LONG,
+    "float": AttrType.FLOAT,
+    "double": AttrType.DOUBLE,
+    "bool": AttrType.BOOL,
+    "object": AttrType.OBJECT,
+}
+
+_ARITH = (Add, Subtract, Multiply, Divide, Mod)
+
+
+def _loc(node) -> tuple:
+    return getattr(node, "line", None), getattr(node, "col", None)
+
+
+class AnalysisScope:
+    """Name-resolution scope chain, mirroring executor.Scope resolution order
+    (qualified ref walk, prefer_parent for in-table conditions, prefer_default
+    for pattern-atom filters, per-level ambiguity)."""
+
+    def __init__(self, parent: Optional["AnalysisScope"] = None):
+        self.parent = parent
+        self.refs: dict[str, Optional[dict]] = {}
+        self.default_ref: Optional[str] = parent.default_ref if parent else None
+        self.prefer_default = False
+        self.prefer_parent = False
+
+    def add(self, ref: str, schema: Optional[dict]) -> "AnalysisScope":
+        self.refs[ref] = schema
+        if self.default_ref is None:
+            self.default_ref = ref
+        return self
+
+    def child(self) -> "AnalysisScope":
+        return AnalysisScope(self)
+
+    def has_open_ref(self) -> bool:
+        scope: Optional[AnalysisScope] = self
+        while scope is not None:
+            if any(s is None for s in scope.refs.values()):
+                return True
+            scope = scope.parent
+        return False
+
+    def lookup_ref(self, ref: str) -> tuple[bool, Optional[dict]]:
+        scope: Optional[AnalysisScope] = self
+        while scope is not None:
+            if ref in scope.refs:
+                return True, scope.refs[ref]
+            scope = scope.parent
+        return False, None
+
+    def all_refs(self) -> list[str]:
+        out: list[str] = []
+        scope: Optional[AnalysisScope] = self
+        while scope is not None:
+            out.extend(r for r in scope.refs if r not in out)
+            scope = scope.parent
+        return out
+
+
+class ExprChecker:
+    """Stateful walker: `infer(expr, scope)` returns the expression's
+    AttrType (or None = unknown) and appends diagnostics."""
+
+    def __init__(
+        self,
+        symbols,
+        diags: list[Diagnostic],
+        query: Optional[str] = None,
+        allow_aggregators: bool = False,
+    ):
+        self.sym = symbols
+        self.diags = diags
+        self.query = query
+        self.allow_aggregators = allow_aggregators
+
+    def diag(self, code: str, message: str, node=None, severity: str = ERROR) -> None:
+        line, col = _loc(node) if node is not None else (None, None)
+        self.diags.append(
+            Diagnostic(code, message, line, col, severity, self.query)
+        )
+
+    # ---- variables -------------------------------------------------------
+
+    def resolve_variable(self, var: Variable, scope: AnalysisScope) -> Optional[AttrType]:
+        if var.stream_id is not None:
+            found, schema = scope.lookup_ref(var.stream_id)
+            if not found:
+                self.diag(
+                    "SA102",
+                    f"unknown stream reference '{var.stream_id}' "
+                    f"(in scope: {', '.join(sorted(scope.all_refs())) or 'none'})",
+                    var,
+                )
+                return None
+            if schema is None:
+                return None  # open schema: attributes unknown
+            if var.attribute == "":
+                return None  # bare stream ref (`e1[0] is null` form)
+            if var.attribute not in schema:
+                self.diag(
+                    "SA103",
+                    f"'{var.stream_id}' has no attribute '{var.attribute}' "
+                    f"(has: {', '.join(schema) or 'none'})",
+                    var,
+                )
+                return None
+            return schema[var.attribute]
+
+        # unqualified attribute
+        if scope.prefer_parent and scope.parent is not None:
+            t = self._try_resolve_silent(var, scope.parent)
+            if t is not _MISS:
+                return t
+        if scope.prefer_default and scope.default_ref is not None:
+            s: Optional[AnalysisScope] = scope
+            while s is not None:
+                schema = s.refs.get(scope.default_ref)
+                if schema is None and scope.default_ref in s.refs:
+                    return None  # open default ref
+                if schema is not None and var.attribute in schema:
+                    return schema[var.attribute]
+                s = s.parent
+        s = scope
+        while s is not None:
+            if any(sc is None for sc in s.refs.values()):
+                return None  # an open ref at this level could hold the attr
+            hits = [
+                (ref, schema[var.attribute])
+                for ref, schema in s.refs.items()
+                if var.attribute in schema
+            ]
+            if len(hits) > 1:
+                types = {t for _, t in hits}
+                self.diag(
+                    "SA104",
+                    f"unqualified attribute '{var.attribute}' is ambiguous "
+                    f"across {sorted(r for r, _ in hits)} — qualify it",
+                    var,
+                    severity=WARNING,
+                )
+                return hits[0][1] if len(types) == 1 else None
+            if hits:
+                return hits[0][1]
+            s = s.parent
+        self.diag(
+            "SA103",
+            f"unknown attribute '{var.attribute}' "
+            f"(in scope: {', '.join(sorted(scope.all_refs())) or 'none'})",
+            var,
+        )
+        return None
+
+    def _try_resolve_silent(self, var: Variable, scope: AnalysisScope):
+        """prefer_parent probe: resolve without emitting diagnostics."""
+        if scope.has_open_ref():
+            return None
+        s: Optional[AnalysisScope] = scope
+        while s is not None:
+            hits = [schema[var.attribute] for schema in s.refs.values()
+                    if schema is not None and var.attribute in schema]
+            if hits:
+                return hits[0]
+            s = s.parent
+        return _MISS
+
+    # ---- expressions -----------------------------------------------------
+
+    def infer(self, expr: Expression, scope: AnalysisScope) -> Optional[AttrType]:
+        if isinstance(expr, Constant):
+            return expr.type
+
+        if isinstance(expr, Variable):
+            return self.resolve_variable(expr, scope)
+
+        if isinstance(expr, _ARITH):
+            lt = self.infer(expr.left, scope)
+            rt = self.infer(expr.right, scope)
+            op = {Add: "+", Subtract: "-", Multiply: "*", Divide: "/", Mod: "%"}[
+                type(expr)
+            ]
+            for side, t in (("left", lt), ("right", rt)):
+                if t is not None and t not in NUMERIC_TYPES:
+                    self.diag(
+                        "SA202",
+                        f"arithmetic '{op}' on non-numeric {side} operand ({t!r})",
+                        expr,
+                    )
+                    return None
+            if lt is None or rt is None:
+                return None
+            return promote(lt, rt)
+
+        if isinstance(expr, Compare):
+            lt = self.infer(expr.left, scope)
+            rt = self.infer(expr.right, scope)
+            if lt is None or rt is None:
+                return AttrType.BOOL
+            if lt in NUMERIC_TYPES and rt in NUMERIC_TYPES:
+                return AttrType.BOOL
+            if lt == rt and lt in (AttrType.BOOL, AttrType.STRING, AttrType.OBJECT):
+                if expr.op not in (CompareOp.EQ, CompareOp.NEQ):
+                    self.diag(
+                        "SA201",
+                        f"operator '{expr.op.value}' is not defined for {lt!r}",
+                        expr,
+                    )
+                return AttrType.BOOL
+            self.diag(
+                "SA201",
+                f"cannot compare {lt!r} {expr.op.value} {rt!r}",
+                expr,
+            )
+            return AttrType.BOOL
+
+        if isinstance(expr, (And, Or)):
+            word = "and" if isinstance(expr, And) else "or"
+            for side in (expr.left, expr.right):
+                t = self.infer(side, scope)
+                if t is not None and t is not AttrType.BOOL:
+                    self.diag(
+                        "SA204",
+                        f"'{word}' operand must be BOOL, got {t!r}",
+                        side,
+                    )
+            return AttrType.BOOL
+
+        if isinstance(expr, Not):
+            t = self.infer(expr.expression, scope)
+            if t is not None and t is not AttrType.BOOL:
+                self.diag("SA204", f"'not' operand must be BOOL, got {t!r}", expr)
+            return AttrType.BOOL
+
+        if isinstance(expr, IsNull):
+            if expr.expression is not None:
+                # bare `name is null` keeps both readings (attribute vs pattern
+                # state alias): if the name matches an in-scope ref, the
+                # compile layer prefers the state-alias reading — do the same
+                if expr.stream_id is not None:
+                    found, _schema = scope.lookup_ref(expr.stream_id)
+                    if found:
+                        return AttrType.BOOL
+                self.infer(expr.expression, scope)
+                return AttrType.BOOL
+            if expr.stream_id is not None:
+                found, _schema = scope.lookup_ref(expr.stream_id)
+                if not found:
+                    self.diag(
+                        "SA102",
+                        f"unknown stream reference '{expr.stream_id}' in 'is null'",
+                        expr,
+                    )
+            return AttrType.BOOL
+
+        if isinstance(expr, In):
+            self._check_in_table(expr, scope)
+            return AttrType.BOOL
+
+        if isinstance(expr, AttributeFunction):
+            return self.infer_function(expr, scope)
+
+        return None  # unknown node kind: stay permissive
+
+    def _check_in_table(self, expr: In, scope: AnalysisScope) -> None:
+        table = self.sym.tables.get(expr.source_id)
+        if table is None:
+            # aggregation duration tables ("<agg>_SECONDS"...) register as
+            # tables at runtime; treat them as open schemas
+            if any(
+                expr.source_id.startswith(aid + "_")
+                for aid in self.sym.aggregations
+            ):
+                table_schema: Optional[dict] = None
+            elif expr.source_id in self.sym.windows:
+                table_schema = self.sym.windows[expr.source_id]
+            else:
+                self.diag(
+                    "SA108",
+                    f"'in {expr.source_id}': no such table "
+                    f"(tables: {', '.join(sorted(self.sym.tables)) or 'none'})",
+                    expr,
+                )
+                return
+        else:
+            table_schema = table
+        inner = scope.child()
+        inner.add(expr.source_id, table_schema)
+        inner.prefer_parent = True
+        t = self.infer(expr.expression, inner)
+        if t is not None and t is not AttrType.BOOL:
+            self.diag("SA203", f"in-table condition must be BOOL, got {t!r}", expr)
+
+    # ---- functions & aggregators ----------------------------------------
+
+    def is_aggregator(self, expr: Expression) -> bool:
+        return (
+            isinstance(expr, AttributeFunction)
+            and expr.namespace is None
+            and expr.name in AGGREGATOR_NAMES
+        )
+
+    def infer_no_agg(self, expr: Expression, scope: AnalysisScope) -> Optional[AttrType]:
+        """Infer with aggregators disallowed (aggregator arguments — nested
+        aggregators are rejected by the executor after lifting)."""
+        prev = self.allow_aggregators
+        self.allow_aggregators = False
+        try:
+            return self.infer(expr, scope)
+        finally:
+            self.allow_aggregators = prev
+
+    def infer_function(
+        self, expr: AttributeFunction, scope: AnalysisScope
+    ) -> Optional[AttrType]:
+        if self.is_aggregator(expr):
+            if not self.allow_aggregators:
+                self.diag(
+                    "SA209",
+                    f"aggregator '{expr.name}' is only valid in a select "
+                    "clause (or having)",
+                    expr,
+                )
+                return None
+            return self.infer_aggregator(expr, scope)
+
+        name = f"{expr.namespace}:{expr.name}" if expr.namespace else expr.name
+        params = expr.parameters
+        sub = self  # scalar args inherit the aggregator policy (lifting)
+
+        if name in ("cast", "convert"):
+            return sub._cast_type(expr, scope)
+        if name == "coalesce":
+            types = [sub.infer(p, scope) for p in params]
+            if not params:
+                self.diag("SA207", f"{name}() needs at least one argument", expr)
+                return None
+            known = [t for t in types if t is not None]
+            if known and any(t != known[0] for t in known):
+                self.diag(
+                    "SA207",
+                    f"coalesce requires homogeneous parameter types, got "
+                    f"{[t for t in types]!r}",
+                    expr,
+                )
+                return None
+            return types[0]
+        if name == "ifThenElse":
+            if len(params) != 3:
+                self.diag(
+                    "SA207",
+                    f"ifThenElse(condition, then, else) takes 3 arguments, "
+                    f"got {len(params)}",
+                    expr,
+                )
+                for p in params:
+                    sub.infer(p, scope)
+                return None
+            ct = sub.infer(params[0], scope)
+            if ct is not None and ct is not AttrType.BOOL:
+                self.diag(
+                    "SA207",
+                    f"ifThenElse condition must be BOOL, got {ct!r}",
+                    params[0],
+                )
+            at, bt = sub.infer(params[1], scope), sub.infer(params[2], scope)
+            if at is None or bt is None:
+                return None
+            if at in NUMERIC_TYPES and bt in NUMERIC_TYPES:
+                return promote(at, bt)
+            if at == bt:
+                return at
+            self.diag(
+                "SA207", f"ifThenElse branches {at!r} vs {bt!r}", expr
+            )
+            return None
+        if name.startswith("instanceOf") and expr.namespace is None:
+            target = _TYPE_NAMES.get(name[len("instanceOf"):].lower())
+            if target is None:
+                self.diag("SA208", f"unknown function '{name}'", expr)
+                return None
+            if len(params) != 1:
+                self.diag(
+                    "SA207", f"{name}(value) takes 1 argument, got {len(params)}",
+                    expr,
+                )
+            for p in params:
+                sub.infer(p, scope)
+            return AttrType.BOOL
+        if name in ("maximum", "minimum"):
+            if not params:
+                self.diag("SA207", f"{name}() needs at least one argument", expr)
+                return None
+            types = [sub.infer(p, scope) for p in params]
+            out: Optional[AttrType] = None
+            for p, t in zip(params, types):
+                if t is not None and t not in NUMERIC_TYPES:
+                    self.diag(
+                        "SA207",
+                        f"{name} arguments must be numeric, got {t!r}",
+                        p,
+                    )
+                    return None
+            if any(t is None for t in types):
+                return None
+            out = types[0]
+            for t in types[1:]:
+                out = promote(out, t)
+            return out
+        if name == "eventTimestamp":
+            return AttrType.LONG
+        if name == "currentTimeMillis":
+            return AttrType.LONG
+        if name == "UUID":
+            return AttrType.STRING
+        if name == "default":
+            if len(params) != 2:
+                self.diag(
+                    "SA207",
+                    f"default(value, fallback) takes 2 arguments, got {len(params)}",
+                    expr,
+                )
+                for p in params:
+                    sub.infer(p, scope)
+                return None
+            st, dt = sub.infer(params[0], scope), sub.infer(params[1], scope)
+            if st is None or dt is None:
+                return st
+            if st != dt and not (st in NUMERIC_TYPES and dt in NUMERIC_TYPES):
+                self.diag(
+                    "SA207", f"default({st!r}, {dt!r}) type mismatch", expr
+                )
+            return st
+
+        # script-defined functions (`define function f[...] return T {...}`)
+        for p in params:
+            sub.infer(p, scope)
+        if expr.namespace is None and expr.name in self.sym.functions:
+            return self.sym.functions[expr.name]
+
+        from siddhi_tpu.core.extension import lookup_function
+
+        if lookup_function(name) is not None:
+            return None  # extension: return type unknowable statically
+        self.diag("SA208", f"unknown function '{name}'", expr)
+        return None
+
+    def _cast_type(self, expr: AttributeFunction, scope: AnalysisScope) -> Optional[AttrType]:
+        name = expr.name
+        params = expr.parameters
+        if len(params) != 2 or not isinstance(params[1], Constant):
+            self.diag(
+                "SA207",
+                f"{name}(value, 'type') requires a value and a constant type name",
+                expr,
+            )
+            for p in params:
+                self.infer(p, scope)
+            return None
+        target = _TYPE_NAMES.get(str(params[1].value).lower())
+        if target is None:
+            self.diag(
+                "SA207", f"unknown {name} target {params[1].value!r}", params[1]
+            )
+            self.infer(params[0], scope)
+            return None
+        src = self.infer(params[0], scope)
+        if src is None:
+            return target
+        # mirror executor._compile_function cast/convert legality matrix
+        if target in (AttrType.STRING, AttrType.OBJECT) or src in (
+            AttrType.STRING,
+            AttrType.OBJECT,
+        ):
+            if src == target:
+                return target
+            if target is AttrType.STRING and src in NUMERIC_TYPES:
+                return target
+            self.diag(
+                "SA207",
+                f"cannot {name} {src!r} to {target!r} "
+                "(string parsing/printing beyond numeric->string is not "
+                "supported on device)",
+                expr,
+            )
+            return target
+        if target is AttrType.BOOL or src is AttrType.BOOL:
+            if src != target:
+                self.diag("SA207", f"cannot {name} {src!r} to {target!r}", expr)
+            return target
+        return target
+
+    def infer_aggregator(
+        self, expr: AttributeFunction, scope: AnalysisScope
+    ) -> Optional[AttrType]:
+        low = expr.name.lower()
+        arg_types = [self.infer_no_agg(p, scope) for p in expr.parameters]
+        if low == "count":
+            return AttrType.LONG
+        if not expr.parameters:
+            self.diag(
+                "SA305", f"aggregator '{expr.name}' needs an argument", expr
+            )
+            return None
+        arg_t = arg_types[0]
+        if low == "distinctcount":
+            return AttrType.LONG
+        if arg_t is not None and arg_t not in NUMERIC_TYPES:
+            self.diag(
+                "SA305",
+                f"aggregator '{expr.name}' needs a numeric argument, got {arg_t!r}",
+                expr.parameters[0],
+            )
+            return None
+        if low == "sum":
+            if arg_t is None:
+                return None
+            return (
+                AttrType.LONG
+                if arg_t in (AttrType.INT, AttrType.LONG)
+                else AttrType.DOUBLE
+            )
+        if low in ("avg", "stddev"):
+            return AttrType.DOUBLE
+        if low in ("min", "max", "minforever", "maxforever"):
+            return arg_t
+        return None
+
+
+_MISS = object()

@@ -166,6 +166,13 @@ class Parser:
         t = self.peek()
         return SiddhiParserError(msg, t.line, t.col)
 
+    def stamp(self, node, tok: Token):
+        """Thread the source position of `tok` onto an AST node (only when the
+        node does not already carry a more specific position)."""
+        if getattr(node, "line", None) is None:
+            node.line, node.col = tok.line, tok.col
+        return node
+
     def name(self) -> str:
         t = self.peek()
         if t.type in ("ID", "QID"):
@@ -268,38 +275,48 @@ class Parser:
     # ---- definitions -----------------------------------------------------
 
     def _definition(self, app: SiddhiApp, anns: list[Annotation]) -> None:
+        def_tok = self.peek()
         self.expect_kw("define")
         kind = self.expect_kw(
             "stream", "table", "window", "trigger", "function", "aggregation"
         ).text.lower()
         if kind == "stream":
             d = StreamDefinition(self.name(), self._attr_list(), anns)
-            app.define_stream(d)
+            app.define_stream(self.stamp(d, def_tok))
         elif kind == "table":
             d = TableDefinition(self.name(), self._attr_list(), anns)
-            app.define_table(d)
+            app.define_table(self.stamp(d, def_tok))
         elif kind == "window":
             wid = self.name()
             attrs = self._attr_list()
+            spec_tok = self.peek()
             ns, fname, params = self._function_operation()
             out = "all"
             if self.accept_kw("output"):
                 out = self._output_event_type().value.split()[0]
-            app.define_window(
-                WindowDefinition(wid, attrs, anns, window=WindowSpec(ns, fname, params), output_events=out)
-            )
+            spec = self.stamp(WindowSpec(ns, fname, params), spec_tok)
+            app.define_window(self.stamp(
+                WindowDefinition(wid, attrs, anns, window=spec, output_events=out),
+                def_tok,
+            ))
         elif kind == "trigger":
             tid = self.name()
             self.expect_kw("at")
             if self.accept_kw("every"):
                 ms = self._time_value()
-                app.define_trigger(TriggerDefinition(tid, at_every_ms=ms, annotations=anns))
+                app.define_trigger(self.stamp(
+                    TriggerDefinition(tid, at_every_ms=ms, annotations=anns), def_tok
+                ))
             else:
                 s = self.expect("STRING").text
                 if s.lower() == "start":
-                    app.define_trigger(TriggerDefinition(tid, at_start=True, annotations=anns))
+                    app.define_trigger(self.stamp(
+                        TriggerDefinition(tid, at_start=True, annotations=anns), def_tok
+                    ))
                 else:
-                    app.define_trigger(TriggerDefinition(tid, at_cron=s, annotations=anns))
+                    app.define_trigger(self.stamp(
+                        TriggerDefinition(tid, at_cron=s, annotations=anns), def_tok
+                    ))
         elif kind == "function":
             fid = self.name()
             self.expect("[")
@@ -308,7 +325,9 @@ class Parser:
             self.expect_kw("return")
             rt = self._attr_type()
             body = self.expect("SCRIPT").text
-            app.define_function(FunctionDefinition(fid, lang, rt, body, anns))
+            app.define_function(self.stamp(
+                FunctionDefinition(fid, lang, rt, body, anns), def_tok
+            ))
         else:  # aggregation
             aid = self.name()
             self.expect_kw("from")
@@ -320,15 +339,18 @@ class Parser:
                 by = self._attribute_reference()
             self.expect_kw("every")
             period = self._aggregation_time()
-            app.define_aggregation(
-                AggregationDefinition(aid, stream, selector, by, period, anns)
-            )
+            app.define_aggregation(self.stamp(
+                AggregationDefinition(aid, stream, selector, by, period, anns),
+                def_tok,
+            ))
 
     def _attr_list(self) -> list[Attribute]:
         self.expect("(")
-        attrs = [Attribute(self.name(), self._attr_type())]
+        tok = self.peek()
+        attrs = [self.stamp(Attribute(self.name(), self._attr_type()), tok)]
         while self.accept(","):
-            attrs.append(Attribute(self.name(), self._attr_type()))
+            tok = self.peek()
+            attrs.append(self.stamp(Attribute(self.name(), self._attr_type()), tok))
         self.expect(")")
         return attrs
 
@@ -356,10 +378,11 @@ class Parser:
     # ---- partition -------------------------------------------------------
 
     def _partition(self, anns: list[Annotation]) -> Partition:
+        part_tok = self.peek()
         self.expect_kw("partition")
         self.expect_kw("with")
         self.expect("(")
-        part = Partition(annotations=anns)
+        part = self.stamp(Partition(annotations=anns), part_tok)
         part.partition_types.append(self._partition_with())
         while self.accept(","):
             part.partition_types.append(self._partition_with())
@@ -377,6 +400,7 @@ class Parser:
 
     def _partition_with(self):
         start = self.pos
+        start_tok = self.peek()
         expr = self._expression()
         if self.at_kw("as") or self.at_kw("or"):
             # range partition: expr as 'name' (or ...)* of Stream
@@ -390,22 +414,24 @@ class Parser:
                 if not self.accept_kw("or"):
                     break
             self.expect_kw("of")
-            return RangePartitionType(self.name(), ranges)
+            return self.stamp(RangePartitionType(self.name(), ranges), start_tok)
         self.expect_kw("of")
-        return ValuePartitionType(self.name(), expr)
+        return self.stamp(ValuePartitionType(self.name(), expr), start_tok)
 
     # ---- query -----------------------------------------------------------
 
     def _query(self, anns: list[Annotation]) -> Query:
+        from_tok = self.peek()
         self.expect_kw("from")
-        q = Query(annotations=anns)
+        q = self.stamp(Query(annotations=anns), from_tok)
         q.input_stream = self._query_input()
         if self.at_kw("select"):
             q.selector = self._query_section()
         else:
             q.selector = Selector(select_all=True)
         q.output_rate = self._output_rate()
-        q.output_stream = self._query_output()
+        out_tok = self.peek()
+        q.output_stream = self.stamp(self._query_output(), out_tok)
         return q
 
     def _query_input(self):
@@ -485,20 +511,25 @@ class Parser:
         return s
 
     def _source(self) -> SingleInputStream:
+        tok = self.peek()
         inner = bool(self.accept("#"))
         # `!S` consumes S's fault stream (reference: SiddhiQL.g4 fault streams,
         # keyed internally under the '!'-prefixed id)
         fault = False if inner else bool(self.accept("!"))
         name = self.name()
-        return SingleInputStream(
-            ("!" + name) if fault else name, is_inner=inner, is_fault=fault
+        return self.stamp(
+            SingleInputStream(
+                ("!" + name) if fault else name, is_inner=inner, is_fault=fault
+            ),
+            tok,
         )
 
     def _stream_handlers(self, s: SingleInputStream) -> None:
         while True:
+            tok = self.peek()
             if self.at("["):
                 self.next()
-                s.handlers.append(Filter(self._expression()))
+                s.handlers.append(self.stamp(Filter(self._expression()), tok))
                 self.expect("]")
             elif self.at("#"):
                 # '#window.x(...)' | '#ns:func(...)' | '#func(...)' | '#[filter]'
@@ -512,11 +543,15 @@ class Parser:
                 if self.at_kw("window") and self.peek(1).type == ".":
                     self.next()
                     self.next()
+                    spec_tok = self.peek()
                     ns, name, params = self._function_operation()
-                    s.handlers.append(WindowHandler(WindowSpec(ns, name, params)))
+                    spec = self.stamp(WindowSpec(ns, name, params), spec_tok)
+                    s.handlers.append(self.stamp(WindowHandler(spec), tok))
                 else:
                     ns, name, params = self._function_operation()
-                    s.handlers.append(StreamFunctionHandler(ns, name, params))
+                    s.handlers.append(
+                        self.stamp(StreamFunctionHandler(ns, name, params), tok)
+                    )
             else:
                 break
 
@@ -584,12 +619,13 @@ class Parser:
     def _state_chain(self, sep: str) -> StateElement:
         elem = self._state_term(sep)
         while self.at(sep):
-            self.next()
+            tok = self.next()
             nxt = self._state_term(sep)
-            elem = NextStateElement(elem, nxt)
+            elem = self.stamp(NextStateElement(elem, nxt), tok)
         return elem
 
     def _state_term(self, sep: str) -> StateElement:
+        tok = self.peek()
         every = bool(self.accept_kw("every"))
         if self.accept("("):
             inner = self._state_chain(sep)
@@ -598,7 +634,7 @@ class Parser:
         else:
             elem = self._pattern_source(sep)
         if every:
-            elem = EveryStateElement(elem)
+            elem = self.stamp(EveryStateElement(elem), tok)
         if self.at_kw("within"):
             self.next()
             elem.within_ms = self._time_value()
@@ -607,24 +643,29 @@ class Parser:
     def _pattern_source(self, sep: str) -> StateElement:
         left = self._single_or_absent(sep)
         if self.at_kw("and", "or"):
+            tok = self.peek()
             op = LogicalType(self.next().text.lower())
             right = self._single_or_absent(sep)
-            return LogicalStateElement(left, op, right)
+            return self.stamp(LogicalStateElement(left, op, right), tok)
         return left
 
     def _single_or_absent(self, sep: str) -> StateElement:
         # absent source: not S[...] (for t)?  — absent may appear on either or
         # both sides of a logical element (reference: logical_absent_stateful)
+        tok = self.peek()
         if self.accept_kw("not"):
             s = self._basic_source()
             waiting = None
             if self.accept_kw("for"):
                 waiting = self._time_value()
-            return AbsentStreamStateElement(stream=s, waiting_time_ms=waiting)
+            return self.stamp(
+                AbsentStreamStateElement(stream=s, waiting_time_ms=waiting), tok
+            )
         return self._pattern_single(sep)
 
     def _pattern_single(self, sep: str) -> StateElement:
         # (event '=')? basic_source ('<' collect '>' | * + ?)?
+        tok = self.peek()
         alias = None
         if (
             self.peek().type in ("ID", "QID")
@@ -635,33 +676,36 @@ class Parser:
             self.next()  # '='
         s = self._basic_source()
         s.alias = alias
-        elem = StreamStateElement(stream=s)
+        elem = self.stamp(StreamStateElement(stream=s), tok)
         if self.at("<"):
             self.next()
             mn, mx = self._collect()
             self.expect(">")
-            return CountStateElement(elem, mn, mx)
+            return self.stamp(CountStateElement(elem, mn, mx), tok)
         if sep == "," and self.peek().type in ("*", "+", "?"):
             suffix = self.next().type
             if suffix == "*":
-                return CountStateElement(elem, 0, CountStateElement.ANY)
+                return self.stamp(CountStateElement(elem, 0, CountStateElement.ANY), tok)
             if suffix == "+":
-                return CountStateElement(elem, 1, CountStateElement.ANY)
-            return CountStateElement(elem, 0, 1)
+                return self.stamp(CountStateElement(elem, 1, CountStateElement.ANY), tok)
+            return self.stamp(CountStateElement(elem, 0, 1), tok)
         return elem
 
     def _basic_source(self) -> SingleInputStream:
         s = self._source()
         # only filters/stream functions (no windows) on pattern sources
         while True:
+            tok = self.peek()
             if self.at("["):
                 self.next()
-                s.handlers.append(Filter(self._expression()))
+                s.handlers.append(self.stamp(Filter(self._expression()), tok))
                 self.expect("]")
             elif self.at("#") and self.peek(1).type == "ID":
                 self.next()
                 ns, name, params = self._function_operation()
-                s.handlers.append(StreamFunctionHandler(ns, name, params))
+                s.handlers.append(
+                    self.stamp(StreamFunctionHandler(ns, name, params), tok)
+                )
             else:
                 break
         return s
@@ -685,8 +729,9 @@ class Parser:
     # --- selector
 
     def _query_section(self, group_by_only: bool = False) -> Selector:
+        sel_tok = self.peek()
         self.expect_kw("select")
-        sel = Selector()
+        sel = self.stamp(Selector(), sel_tok)
         if self.accept("*"):
             sel.select_all = True
         else:
@@ -723,11 +768,12 @@ class Parser:
         return sel
 
     def _output_attribute(self) -> OutputAttribute:
+        tok = self.peek()
         e = self._expression()
         rename = None
         if self.accept_kw("as"):
             rename = self.name()
-        return OutputAttribute(rename, e)
+        return self.stamp(OutputAttribute(rename, e), tok)
 
     # --- output rate & output
 
@@ -865,58 +911,60 @@ class Parser:
     def _or_expr(self) -> Expression:
         e = self._and_expr()
         while self.at_kw("or"):
-            self.next()
-            e = Or(e, self._and_expr())
+            tok = self.next()
+            e = self.stamp(Or(e, self._and_expr()), tok)
         return e
 
     def _and_expr(self) -> Expression:
         e = self._in_expr()
         while self.at_kw("and"):
-            self.next()
-            e = And(e, self._in_expr())
+            tok = self.next()
+            e = self.stamp(And(e, self._in_expr()), tok)
         return e
 
     def _in_expr(self) -> Expression:
         e = self._equality()
         while self.at_kw("in"):
-            self.next()
-            e = In(e, self.name())
+            tok = self.next()
+            e = self.stamp(In(e, self.name()), tok)
         return e
 
     def _equality(self) -> Expression:
         e = self._relational()
         while self.peek().type in ("==", "!="):
-            op = CompareOp(self.next().type)
-            e = Compare(e, op, self._relational())
+            tok = self.next()
+            op = CompareOp(tok.type)
+            e = self.stamp(Compare(e, op, self._relational()), tok)
         return e
 
     def _relational(self) -> Expression:
         e = self._additive()
         while self.peek().type in ("<", "<=", ">", ">="):
-            op = CompareOp(self.next().type)
-            e = Compare(e, op, self._additive())
+            tok = self.next()
+            op = CompareOp(tok.type)
+            e = self.stamp(Compare(e, op, self._additive()), tok)
         return e
 
     def _additive(self) -> Expression:
         e = self._multiplicative()
         while self.peek().type in ("+", "-"):
-            op = self.next().type
+            tok = self.next()
             rhs = self._multiplicative()
-            e = Add(e, rhs) if op == "+" else Subtract(e, rhs)
+            e = self.stamp(Add(e, rhs) if tok.type == "+" else Subtract(e, rhs), tok)
         return e
 
     def _multiplicative(self) -> Expression:
         e = self._unary()
         while self.peek().type in ("*", "/", "%"):
-            op = self.next().type
+            tok = self.next()
             rhs = self._unary()
-            e = {"*": Multiply, "/": Divide, "%": Mod}[op](e, rhs)
+            e = self.stamp({"*": Multiply, "/": Divide, "%": Mod}[tok.type](e, rhs), tok)
         return e
 
     def _unary(self) -> Expression:
         if self.at_kw("not"):
-            self.next()
-            return Not(self._unary())
+            tok = self.next()
+            return self.stamp(Not(self._unary()), tok)
         if self.peek().type in ("-", "+"):
             sign = self.next().type
             t = self.peek()
@@ -939,57 +987,60 @@ class Parser:
         if t.type == "INT":
             # time constant? INT followed by a time unit identifier
             if self.peek(1).type == "ID" and self.peek(1).text.lower() in TIME_UNITS:
-                return TimeConstant(self._time_value())
+                return self.stamp(TimeConstant(self._time_value()), t)
             self.next()
-            return Constant(int(t.value), AttrType.INT)
+            return self.stamp(Constant(int(t.value), AttrType.INT), t)
         if t.type == "LONG":
             self.next()
-            return Constant(int(t.value), AttrType.LONG)
+            return self.stamp(Constant(int(t.value), AttrType.LONG), t)
         if t.type == "FLOAT":
             self.next()
-            return Constant(float(t.value), AttrType.FLOAT)
+            return self.stamp(Constant(float(t.value), AttrType.FLOAT), t)
         if t.type == "DOUBLE":
             self.next()
-            return Constant(float(t.value), AttrType.DOUBLE)
+            return self.stamp(Constant(float(t.value), AttrType.DOUBLE), t)
         if t.type == "STRING":
             self.next()
-            return Constant(t.text, AttrType.STRING)
+            return self.stamp(Constant(t.text, AttrType.STRING), t)
         if t.type in ("ID", "QID", "#"):
             low = t.text.lower() if t.type == "ID" else ""
             if low == "true":
                 self.next()
-                return Constant(True, AttrType.BOOL)
+                return self.stamp(Constant(True, AttrType.BOOL), t)
             if low == "false":
                 self.next()
-                return Constant(False, AttrType.BOOL)
+                return self.stamp(Constant(False, AttrType.BOOL), t)
             if low == "null":
                 self.next()
-                return Constant(None, AttrType.OBJECT)
+                return self.stamp(Constant(None, AttrType.OBJECT), t)
             return self._maybe_is_null(self._ref_or_function())
         raise self.err(f"unexpected token {t.text!r} in expression")
 
     def _maybe_is_null(self, e: Expression) -> Expression:
         if self.at_kw("is") and self.peek(1).type == "ID" and self.peek(1).text.lower() == "null":
-            self.next()
+            tok = self.next()
             self.next()
             if isinstance(e, Variable) and e.stream_id is not None and e.attribute == "":
                 # explicit stream reference form: `e1[0] is null`
-                return IsNull(stream_id=e.stream_id, stream_index=e.stream_index)
+                return self.stamp(
+                    IsNull(stream_id=e.stream_id, stream_index=e.stream_index), tok
+                )
             if isinstance(e, Variable) and e.stream_id is None:
                 # bare `name is null` is ambiguous: attribute or pattern state
                 # alias. Keep both readings; the compile layer prefers a state
                 # alias when one matches (reference null_check rule has the
                 # same ambiguity resolved in the visitor).
-                return IsNull(expression=e, stream_id=e.attribute)
-            return IsNull(expression=e)
+                return self.stamp(IsNull(expression=e, stream_id=e.attribute), tok)
+            return self.stamp(IsNull(expression=e), tok)
         return e
 
     def _ref_or_function(self) -> Expression:
         # function: (ns ':')? name '(' ... ')'
         if self.peek().type in ("ID", "QID"):
+            tok = self.peek()
             if self.peek(1).type == "(":
                 fname = self.name()
-                return self._finish_function(None, fname)
+                return self.stamp(self._finish_function(None, fname), tok)
             if (
                 self.peek(1).type == ":"
                 and self.peek(2).type in ("ID", "QID")
@@ -998,7 +1049,7 @@ class Parser:
                 ns = self.name()
                 self.next()
                 fname = self.name()
-                return self._finish_function(ns, fname)
+                return self.stamp(self._finish_function(ns, fname), tok)
         return self._attribute_reference(allow_stream_ref=True)
 
     def _finish_function(self, ns: Optional[str], fname: str) -> Expression:
@@ -1016,6 +1067,7 @@ class Parser:
 
     def _attribute_reference(self, allow_stream_ref: bool = False) -> Variable:
         # [#]name[idx][#name2[idx2]].attr | attr
+        tok = self.peek()
         inner = bool(self.accept("#"))
         name1 = self.name()
         idx = None
@@ -1033,11 +1085,15 @@ class Parser:
             name1 = f"{name1}#{name2}"
         if self.accept("."):
             attr = self.name()
-            return Variable(attr, stream_id=name1, stream_index=idx, is_inner=inner)
+            return self.stamp(
+                Variable(attr, stream_id=name1, stream_index=idx, is_inner=inner), tok
+            )
         if idx is not None:
             # indexed bare stream reference (only meaningful before IS NULL)
-            return Variable("", stream_id=name1, stream_index=idx, is_inner=inner)
-        return Variable(name1, is_inner=inner)
+            return self.stamp(
+                Variable("", stream_id=name1, stream_index=idx, is_inner=inner), tok
+            )
+        return self.stamp(Variable(name1, is_inner=inner), tok)
 
     def _attribute_index(self) -> int:
         if self.at("INT"):

@@ -437,7 +437,7 @@ class SiddhiAppRuntime:
             src_junction = nw.out_junction
         if in_schema is None:
             raise DefinitionNotExistError(
-                f"stream '{stream.stream_id}' is not defined"
+                f"query '{qid}': stream '{stream.stream_id}' is not defined"
             )
         qr = QueryRuntime(
             query, qid, in_schema, self.interner,
@@ -506,6 +506,18 @@ class SiddhiAppRuntime:
 
     def _add_pattern_query(self, qid: str, query: Query) -> None:
         from siddhi_tpu.core.pattern_runtime import PatternQueryRuntime
+
+        # pre-validate every referenced stream: the NFA builder indexes
+        # stream_schemas directly, which would surface a raw KeyError with no
+        # stream/query context (fallback path when analysis is disabled)
+        from siddhi_tpu.query_api.execution import iter_state_streams
+
+        for s in iter_state_streams(query.input_stream.state):
+            if s.stream_id not in self.stream_schemas:
+                raise DefinitionNotExistError(
+                    f"query '{qid}': pattern stream '{s.stream_id}' is not "
+                    "defined (patterns consume streams, not tables or windows)"
+                )
 
         token_capacity = self._capacity_annotation("app:patternCapacity", 128)
         count_capacity = self._capacity_annotation("app:countCapacity", 8)
@@ -611,7 +623,9 @@ class SiddhiAppRuntime:
             if sch is None and s.stream_id in agg_findables:
                 sch = agg_findables[s.stream_id].schema
             if sch is None:
-                raise DefinitionNotExistError(f"stream '{s.stream_id}' is not defined")
+                raise DefinitionNotExistError(
+                    f"query '{qid}': join stream '{s.stream_id}' is not defined"
+                )
             schemas.append(sch)
         join_capacity = self._capacity_annotation(
             "app:joinCapacity", DEFAULT_JOIN_CAPACITY

@@ -21,18 +21,39 @@ class SiddhiManager:
         self._runtimes: dict[str, object] = {}
 
     # app: SiddhiQL source text or a programmatic SiddhiApp AST
-    def create_siddhi_app_runtime(self, app: Union[str, SiddhiApp]):
+    def create_siddhi_app_runtime(
+        self, app: Union[str, SiddhiApp], strict: bool = False
+    ):
+        """Build a runtime for `app`. With `strict=True` the semantic
+        analyzer (`siddhi_tpu.analysis`) runs first: every error diagnostic
+        is aggregated into one `SiddhiAnalysisError` raise (warnings are
+        logged), so a bad app fails with source locations instead of dying
+        mid-construction — or worse, mid-traffic — on the first problem."""
         from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
         from siddhi_tpu.core.app_runtime import SiddhiAppRuntime
 
         if isinstance(app, str):
             app = SiddhiCompiler.parse(app)
+        if strict:
+            import logging
+
+            from siddhi_tpu.analysis import analyze
+
+            result = analyze(app)
+            for w in result.warnings:
+                logging.getLogger("siddhi_tpu.analysis").warning(
+                    w.format(result.app_name)
+                )
+            result.raise_if_errors()
         runtime = SiddhiAppRuntime(app, self)
         old = self._runtimes.get(runtime.name)
         if old is not None:
             old.shutdown()
         self._runtimes[runtime.name] = runtime
         return runtime
+
+    # short alias, mirroring the analyzer docs: create_runtime(app, strict=...)
+    create_runtime = create_siddhi_app_runtime
 
     def get_siddhi_app_runtime(self, name: str):
         return self._runtimes.get(name)

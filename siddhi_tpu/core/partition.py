@@ -745,6 +745,16 @@ class PartitionRuntime:
     def _add_pattern_query(self, qid: str, query: Query) -> None:
         app = self.app
         self._check_output_target(query)
+        # guard the NFA builder's raw stream_schemas indexing with a named
+        # error (fallback path when semantic analysis is disabled)
+        from siddhi_tpu.query_api.execution import iter_state_streams
+
+        for s in iter_state_streams(query.input_stream.state):
+            if s.stream_id not in app.stream_schemas:
+                raise SiddhiAppCreationError(
+                    f"query '{qid}': pattern stream '{s.stream_id}' is not "
+                    "defined (patterns consume streams, not tables or windows)"
+                )
         qr = PartitionedPatternQueryRuntime(
             query, qid, app.stream_schemas, app.interner,
             p_capacity=self.p, key_fns=self.key_fns,

@@ -16,14 +16,24 @@ from siddhi_tpu.query_api.expression import Expression, Variable
 from siddhi_tpu.core.types import AttrType
 
 
+class SourceLocated:
+    """Mixin: 1-based source position of the node's first token, stamped by
+    the SiddhiQL parser (None for programmatic ASTs). Plain class attributes
+    on purpose — they are not dataclass fields, so constructor signatures of
+    the dataclasses mixing this in are unchanged."""
+
+    line = None
+    col = None
+
+
 @dataclasses.dataclass
-class Attribute:
+class Attribute(SourceLocated):
     name: str
     type: AttrType
 
 
 @dataclasses.dataclass
-class AbstractDefinition:
+class AbstractDefinition(SourceLocated):
     id: str
     attributes: list[Attribute] = dataclasses.field(default_factory=list)
     annotations: list[Annotation] = dataclasses.field(default_factory=list)
@@ -59,7 +69,7 @@ class WindowDefinition(AbstractDefinition):
 
 
 @dataclasses.dataclass
-class WindowSpec:
+class WindowSpec(SourceLocated):
     """A window invocation `ns:name(params)` attached to a stream or window def."""
 
     namespace: Optional[str]
@@ -68,7 +78,7 @@ class WindowSpec:
 
 
 @dataclasses.dataclass
-class TriggerDefinition:
+class TriggerDefinition(SourceLocated):
     """`define trigger T at every 5 sec | 'cron' | 'start'`
     (reference: definition/TriggerDefinition.java)."""
 
@@ -80,7 +90,7 @@ class TriggerDefinition:
 
 
 @dataclasses.dataclass
-class FunctionDefinition:
+class FunctionDefinition(SourceLocated):
     """`define function f[lang] return type { body }`
     (reference: definition/FunctionDefinition.java)."""
 
@@ -133,7 +143,7 @@ class TimePeriod:
 
 
 @dataclasses.dataclass
-class AggregationDefinition:
+class AggregationDefinition(SourceLocated):
     """`define aggregation A from S select ... group by ... aggregate by ts every ...`
     (reference: definition/AggregationDefinition.java)."""
 

@@ -13,7 +13,7 @@ import enum
 from typing import Optional, Union
 
 from siddhi_tpu.query_api.annotation import Annotation
-from siddhi_tpu.query_api.definition import WindowSpec
+from siddhi_tpu.query_api.definition import SourceLocated, WindowSpec
 from siddhi_tpu.query_api.expression import Expression, Variable
 
 
@@ -23,17 +23,17 @@ from siddhi_tpu.query_api.expression import Expression, Variable
 
 
 @dataclasses.dataclass
-class Filter:
+class Filter(SourceLocated):
     expression: Expression
 
 
 @dataclasses.dataclass
-class WindowHandler:
+class WindowHandler(SourceLocated):
     window: WindowSpec
 
 
 @dataclasses.dataclass
-class StreamFunctionHandler:
+class StreamFunctionHandler(SourceLocated):
     namespace: Optional[str]
     name: str
     parameters: list[Expression]
@@ -48,7 +48,7 @@ StreamHandler = Union[Filter, WindowHandler, StreamFunctionHandler]
 
 
 @dataclasses.dataclass
-class SingleInputStream:
+class SingleInputStream(SourceLocated):
     stream_id: str
     alias: Optional[str] = None  # `as e1`
     handlers: list[StreamHandler] = dataclasses.field(default_factory=list)
@@ -89,7 +89,7 @@ class JoinEventTrigger(enum.Enum):
 
 
 @dataclasses.dataclass
-class JoinInputStream:
+class JoinInputStream(SourceLocated):
     left: SingleInputStream
     join_type: JoinType
     right: SingleInputStream
@@ -107,7 +107,7 @@ class JoinInputStream:
 # ---------------------------------------------------------------------------
 
 
-class StateElement:
+class StateElement(SourceLocated):
     """Base; every element may carry a `within_ms` bound
     (reference: query-api execution/query/input/state/StateElement.java)."""
 
@@ -167,7 +167,7 @@ class StateStreamType(enum.Enum):
 
 
 @dataclasses.dataclass
-class StateInputStream:
+class StateInputStream(SourceLocated):
     type: StateStreamType
     state: StateElement
     within_ms: Optional[int] = None
@@ -176,13 +176,31 @@ class StateInputStream:
 InputStream = Union[SingleInputStream, JoinInputStream, StateInputStream]
 
 
+def iter_state_streams(state: StateElement):
+    """Yield every SingleInputStream referenced by a pattern/sequence state
+    tree, in source order (used by the runtime for pre-validation and by the
+    semantic analyzer for scope construction)."""
+    if isinstance(state, CountStateElement):
+        yield from iter_state_streams(state.stream)
+    elif isinstance(state, StreamStateElement):
+        yield state.stream
+    elif isinstance(state, NextStateElement):
+        yield from iter_state_streams(state.state)
+        yield from iter_state_streams(state.next)
+    elif isinstance(state, EveryStateElement):
+        yield from iter_state_streams(state.state)
+    elif isinstance(state, LogicalStateElement):
+        yield from iter_state_streams(state.left)
+        yield from iter_state_streams(state.right)
+
+
 # ---------------------------------------------------------------------------
 # selector
 # ---------------------------------------------------------------------------
 
 
 @dataclasses.dataclass
-class OutputAttribute:
+class OutputAttribute(SourceLocated):
     rename: Optional[str]
     expression: Expression
 
@@ -207,7 +225,7 @@ class OrderByAttribute:
 
 
 @dataclasses.dataclass
-class Selector:
+class Selector(SourceLocated):
     selection_list: list[OutputAttribute] = dataclasses.field(default_factory=list)
     group_by: list[Variable] = dataclasses.field(default_factory=list)
     having: Optional[Expression] = None
@@ -233,7 +251,7 @@ class OutputEventsFor(enum.Enum):
 
 
 @dataclasses.dataclass
-class OutputStream:
+class OutputStream(SourceLocated):
     output_events: OutputEventsFor = OutputEventsFor.CURRENT
 
 
@@ -307,7 +325,7 @@ OutputRate = Union[EventOutputRate, TimeOutputRate, SnapshotOutputRate, None]
 
 
 @dataclasses.dataclass
-class Query:
+class Query(SourceLocated):
     input_stream: InputStream = None
     selector: Selector = dataclasses.field(default_factory=Selector)
     output_stream: OutputStream = dataclasses.field(default_factory=ReturnStream)
@@ -342,7 +360,7 @@ class Query:
 
 
 @dataclasses.dataclass
-class ValuePartitionType:
+class ValuePartitionType(SourceLocated):
     stream_id: str
     expression: Expression
 
@@ -354,13 +372,13 @@ class RangePartitionProperty:
 
 
 @dataclasses.dataclass
-class RangePartitionType:
+class RangePartitionType(SourceLocated):
     stream_id: str
     ranges: list[RangePartitionProperty]
 
 
 @dataclasses.dataclass
-class Partition:
+class Partition(SourceLocated):
     partition_types: list[Union[ValuePartitionType, RangePartitionType]] = dataclasses.field(
         default_factory=list
     )
@@ -369,7 +387,7 @@ class Partition:
 
 
 @dataclasses.dataclass
-class InputStore:
+class InputStore(SourceLocated):
     store_id: str
     alias: Optional[str] = None
     on: Optional[Expression] = None
@@ -378,7 +396,7 @@ class InputStore:
 
 
 @dataclasses.dataclass
-class StoreQuery:
+class StoreQuery(SourceLocated):
     """One-shot pull query (reference: execution/query/StoreQuery.java)."""
 
     input_store: Optional[InputStore] = None

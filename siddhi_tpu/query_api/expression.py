@@ -17,7 +17,14 @@ from siddhi_tpu.core.types import AttrType
 
 class Expression:
     """AST base class. Builder helpers (`value`, `var`) are module functions,
-    mirroring the reference's `Expression.value()/variable()` statics."""
+    mirroring the reference's `Expression.value()/variable()` statics.
+
+    `line`/`col` carry the 1-based source position of the node's first token
+    when the node came out of the SiddhiQL parser (None for programmatically
+    built ASTs) — semantic diagnostics (`siddhi_tpu.analysis`) report them."""
+
+    line: Optional[int] = None
+    col: Optional[int] = None
 
 
 def value(v: Any, type_: Optional[AttrType] = None) -> "Constant":

@@ -36,3 +36,45 @@ except Exception:
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long wall-clock tests excluded from tier-1 (-m 'not slow')"
+    )
+
+
+# ---------------------------------------------------------------------------
+# analyzer sweep: every app that successfully builds a runtime anywhere in the
+# suite must also analyze clean (zero errors, no SA000 internal faults) — the
+# whole test corpus doubles as the analyzer's false-positive regression net.
+# Disable with SIDDHI_ANALYSIS_SWEEP=0.
+# ---------------------------------------------------------------------------
+
+if os.environ.get("SIDDHI_ANALYSIS_SWEEP", "1") != "0":
+    from siddhi_tpu.core.manager import SiddhiManager as _SM
+
+    _orig_create = _SM.create_siddhi_app_runtime
+
+    def _checked_create(self, app, strict=False):
+        runtime = _orig_create(self, app, strict=strict)
+        # only sweep apps that construct successfully: tests asserting
+        # creation errors must keep seeing the original exception
+        try:
+            from siddhi_tpu.analysis import analyze
+
+            result = analyze(runtime.app)
+        except Exception as exc:  # analyzer crash = sweep failure
+            raise AssertionError(f"analyzer crashed on a valid app: {exc!r}")
+        problems = result.errors + [
+            d for d in result.warnings if d.code == "SA000"
+        ]
+        if problems:
+            msgs = "\n".join(d.format() for d in problems)
+            raise AssertionError(
+                "analyzer flagged a valid app (false positive):\n" + msgs
+            )
+        return runtime
+
+    _SM.create_siddhi_app_runtime = _checked_create
+    _SM.create_runtime = _checked_create

@@ -1,0 +1,240 @@
+"""Compile-time semantic analyzer (siddhi_tpu.analysis) tests.
+
+Three layers:
+* golden corpus — every bad app under tests/analysis_corpus/ declares its
+  exact expected diagnostics (code + line:col) in trailing
+  `-- expect[-warning]: SA### L:C` comments, asserted exactly;
+* API — strict runtime creation, error aggregation, source locations;
+* CLI — text/json formats, --werror, exit codes.
+
+(The fourth layer lives in conftest.py: every app the full test suite
+successfully builds a runtime for is re-analyzed and must be clean.)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.analysis import CODES, SiddhiAnalysisError, analyze
+from siddhi_tpu.analysis.__main__ import main as lint_main
+
+CORPUS = sorted(
+    glob.glob(os.path.join(os.path.dirname(__file__), "analysis_corpus", "*.siddhi"))
+)
+
+_EXPECT = re.compile(
+    r"^--\s*(expect|expect-warning):\s*(SA\d{3})\s+(\d+|-):(\d+|-)\s*$"
+)
+
+
+def _parse_expectations(src: str):
+    errors, warnings = [], []
+    for line in src.splitlines():
+        m = _EXPECT.match(line.strip())
+        if not m:
+            continue
+        kind, code, ln, col = m.groups()
+        loc = (
+            code,
+            None if ln == "-" else int(ln),
+            None if col == "-" else int(col),
+        )
+        (errors if kind == "expect" else warnings).append(loc)
+    return sorted(errors), sorted(warnings)
+
+
+def test_corpus_is_populated():
+    assert len(CORPUS) >= 20, "analysis corpus shrank below ~20 bad apps"
+
+
+@pytest.mark.parametrize(
+    "path", CORPUS, ids=[os.path.basename(p)[:-7] for p in CORPUS]
+)
+def test_corpus_exact_diagnostics(path):
+    src = open(path).read()
+    want_errors, want_warnings = _parse_expectations(src)
+    assert want_errors or want_warnings, f"{path} declares no expectations"
+    result = analyze(src)
+    got_errors = sorted((d.code, d.line, d.col) for d in result.errors)
+    got_warnings = sorted((d.code, d.line, d.col) for d in result.warnings)
+    assert got_errors == want_errors, result.format(path)
+    assert got_warnings == want_warnings, result.format(path)
+
+
+def test_every_corpus_code_is_documented():
+    for path in CORPUS:
+        for code, _l, _c in sum(_parse_expectations(open(path).read()), []):
+            assert code in CODES, f"{code} missing from diagnostics.CODES"
+
+
+# ---------------------------------------------------------------------------
+# API
+# ---------------------------------------------------------------------------
+
+BAD_APP = """
+define stream S (a int, b string);
+from Missing select a insert into Out;
+from S[b > 3] select a insert into Out2;
+"""
+
+
+def test_analyze_accepts_source_and_ast():
+    from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+    r1 = analyze(BAD_APP)
+    r2 = analyze(SiddhiCompiler.parse(BAD_APP))
+    assert [d.code for d in r1.errors] == [d.code for d in r2.errors]
+    assert not r1.ok and len(r1.errors) == 2
+
+
+def test_diagnostics_carry_locations():
+    r = analyze(BAD_APP)
+    codes = {(d.code, d.line, d.col) for d in r.errors}
+    assert ("SA101", 3, 6) in codes  # `from Missing`
+    assert ("SA201", 4, 10) in codes  # `b > 3`
+
+
+def test_strict_runtime_creation_aggregates_all_errors():
+    mgr = SiddhiManager()
+    with pytest.raises(SiddhiAnalysisError) as exc_info:
+        mgr.create_siddhi_app_runtime(BAD_APP, strict=True)
+    err = exc_info.value
+    assert len(err.diagnostics) == 2
+    assert {d.code for d in err.diagnostics} == {"SA101", "SA201"}
+    assert "SA101" in str(err) and "SA201" in str(err)
+    mgr.shutdown()
+
+
+def test_create_runtime_alias_and_strict_clean_app():
+    mgr = SiddhiManager()
+    rt = mgr.create_runtime(
+        """
+        define stream S (a int);
+        @info(name='q') from S[a > 0] select a insert into Out;
+        """,
+        strict=True,
+    )
+    got = []
+    rt.add_callback("q", lambda ts, i, r: got.extend(e.data for e in i or []))
+    rt.start()
+    rt.get_input_handler("S").send((5,))
+    rt.shutdown()
+    mgr.shutdown()
+    assert got == [(5,)]
+
+
+def test_strict_false_keeps_legacy_behavior():
+    # without strict, a semantically-bad-but-buildable app still constructs
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(
+        """
+        define stream S (a int);
+        define stream Dead (z int);
+        from S select a insert into Out;
+        """
+    )
+    assert rt is not None
+    mgr.shutdown()
+
+
+def test_programmatic_ast_without_locations():
+    from siddhi_tpu.query_api import execution as ex
+    from siddhi_tpu.query_api import expression as E
+    from siddhi_tpu.query_api.definition import Attribute, StreamDefinition
+    from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+    from siddhi_tpu.core.types import AttrType
+
+    app = SiddhiApp()
+    app.define_stream(StreamDefinition("S", [Attribute("a", AttrType.INT)]))
+    q = ex.Query().from_(ex.SingleInputStream("Nope")).insert_into("Out")
+    q.selector = ex.Selector(select_all=True)
+    app.add_query(q)
+    r = analyze(app)
+    assert [d.code for d in r.errors] == ["SA101"]
+    assert r.errors[0].line is None  # no source positions programmatically
+
+
+def test_warning_severities_do_not_fail_ok():
+    r = analyze(
+        """
+        define stream A (x int);
+        from A[x > 0] select x insert into B;
+        from B select x insert into A;
+        """
+    )
+    assert r.ok
+    assert {d.code for d in r.warnings} == {"SA403"}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+def test_cli_clean_app(tmp_path, capsys):
+    path = _write(
+        tmp_path, "ok.siddhi",
+        "define stream S (a int);\nfrom S select a insert into Out;\n",
+    )
+    assert lint_main([path]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s)" in out
+
+
+def test_cli_bad_app_text(tmp_path, capsys):
+    path = _write(
+        tmp_path, "bad.siddhi",
+        "define stream S (a int);\nfrom Missing select a insert into Out;\n",
+    )
+    assert lint_main([path]) == 1
+    out = capsys.readouterr().out
+    assert "SA101" in out and f"{path}:2:6" in out
+
+
+def test_cli_json_format(tmp_path, capsys):
+    path = _write(
+        tmp_path, "bad.siddhi",
+        "define stream S (a int);\nfrom S[a + 1] select a insert into Out;\n",
+    )
+    assert lint_main([path, "--format=json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["errors"] == 1
+    (d,) = [x for x in payload["diagnostics"] if x["severity"] == "error"]
+    assert d["code"] == "SA203" and d["line"] == 2
+
+
+def test_cli_werror_promotes_warnings(tmp_path, capsys):
+    body = (
+        "define stream A (x int);\n"
+        "from A[x > 0] select x insert into B;\n"
+        "from B select x insert into A;\n"
+    )
+    path = _write(tmp_path, "warn.siddhi", body)
+    assert lint_main([path]) == 0
+    assert lint_main([path, "--werror"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_parse_error_is_sa001(tmp_path, capsys):
+    path = _write(tmp_path, "broken.siddhi", "define stream (;\n")
+    assert lint_main([path]) == 2
+    assert "SA001" in capsys.readouterr().out
+
+
+def test_cli_codes_catalog(capsys):
+    assert lint_main(["--codes"]) == 0
+    out = capsys.readouterr().out
+    for code in ("SA101", "SA206", "SA301", "SA403"):
+        assert code in out
