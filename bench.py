@@ -684,6 +684,12 @@ def main():
     ap.add_argument("--events", type=int, default=2_000_000)
     ap.add_argument("--batch", type=int, default=32768)
     ap.add_argument("--leg", help="run ONE leg in-process and print its JSON")
+    ap.add_argument(
+        "--deadline", type=float,
+        default=float(os.environ.get("SIDDHI_BENCH_DEADLINE_S", "0") or 0),
+        help="overall wall-clock budget in seconds (0 = none); legs that "
+        "would not fit are skipped so the final JSON line always prints",
+    )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -691,63 +697,139 @@ def main():
         print(json.dumps(_run_leg(args.leg, args)))
         return
 
+    # driver resilience contract (BENCH_r05 shipped rc=124 and NO output when
+    # one wedged leg ate the harness budget): every leg runs under its own
+    # subprocess timeout, the overall --deadline skips legs that cannot fit,
+    # and the final JSON line is emitted exactly once on EVERY exit path —
+    # normal completion, per-leg timeout, driver crash, or SIGTERM/SIGINT
+    # from an outer `timeout`.
+    import signal
+
     detail: dict = {}
+    failed: list = []
+    current_leg = [None]
+    current_child = [None]
+    emitted = [False]
+
+    def _emit():
+        if emitted[0]:
+            return
+        emitted[0] = True
+        if failed:
+            detail["failed_legs"] = failed
+        per = [detail.get(k) for k in WORKLOADS]
+        per = [v for v in per if v]
+        geomean = (
+            math.exp(sum(math.log(v) for v in per) / len(per)) if per else 0.0
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "engine_throughput_geomean",
+                    "value": round(geomean, 1),
+                    "unit": "events/s",
+                    "vs_baseline": round(geomean / REFERENCE_EVENTS_PER_SEC, 3),
+                    "detail": detail,
+                }
+            )
+        )
+        sys.stdout.flush()
+
+    def _on_signal(signum, frame):
+        child = current_child[0]
+        if child is not None:  # don't orphan a leg burning the machine
+            try:
+                child.kill()
+            except Exception:
+                pass
+        leg = current_leg[0]
+        if leg is not None:
+            failed.append({"leg": leg, "error": f"signal{signum}"})
+            detail[f"{leg}_error"] = f"signal{signum}"
+        _emit()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    t_start = time.monotonic()
     legs = list(WORKLOADS) + [
         "filter_window_avg_delivered", "pattern_2state_delivered",
         "tumbling_groupby_delivered", "p99", "tables", "timebudget", "verify",
     ]
-    for leg in legs:
-        cmd = [sys.executable, os.path.abspath(__file__), "--leg", leg,
-               "--events", str(args.events), "--batch", str(args.batch)]
-        env = dict(os.environ)
-        env["SIDDHI_TPU_AUX_DRAIN_S"] = "0"
-        env.setdefault("PYTHONPATH", os.path.dirname(os.path.abspath(__file__)))
-        try:
-            proc = subprocess.run(
-                cmd, capture_output=True, text=True,
-                timeout=2800 if leg == "verify" else 1200, env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
+    try:
+        for leg in legs:
+            current_leg[0] = leg
+            leg_timeout = 2800 if leg == "verify" else 1200
+            if args.deadline:
+                remaining = args.deadline - (time.monotonic() - t_start)
+                if remaining < 60:
+                    failed.append({"leg": leg, "error": "skipped(deadline)"})
+                    detail[f"{leg}_error"] = "skipped(deadline)"
+                    continue
+                # keep ~30 s of slack so the driver itself always finishes
+                leg_timeout = min(leg_timeout, remaining - 30)
+            cmd = [sys.executable, os.path.abspath(__file__), "--leg", leg,
+                   "--events", str(args.events), "--batch", str(args.batch)]
+            env = dict(os.environ)
+            env["SIDDHI_TPU_AUX_DRAIN_S"] = "0"
+            env.setdefault(
+                "PYTHONPATH", os.path.dirname(os.path.abspath(__file__))
             )
-            line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else "{}"
-            got = json.loads(line)
-        except Exception as e:
+            out_text, err_text = "", ""
+            try:
+                child = subprocess.Popen(
+                    cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, env=env,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+                current_child[0] = child
+                try:
+                    out_text, err_text = child.communicate(timeout=leg_timeout)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+                    child.communicate()
+                    raise
+                line = (
+                    out_text.strip().splitlines()[-1]
+                    if out_text.strip()
+                    else "{}"
+                )
+                got = json.loads(line)
+                if child.returncode != 0 and not got:
+                    raise RuntimeError(f"rc={child.returncode}")
+            except subprocess.TimeoutExpired:
+                failed.append({"leg": leg, "error": "timeout"})
+                got = {f"{leg}_error": "timeout"}
+            except Exception as e:
+                if args.verbose:
+                    print(f"# leg {leg} FAILED: {e}", file=sys.stderr)
+                    if err_text:
+                        print(err_text[-2000:], file=sys.stderr)
+                failed.append({"leg": leg, "error": type(e).__name__})
+                got = {f"{leg}_error": f"{type(e).__name__}"}
+            finally:
+                current_child[0] = None
+            detail.update(got)
             if args.verbose:
-                print(f"# leg {leg} FAILED: {e}", file=sys.stderr)
-                if 'proc' in dir():
-                    print(proc.stderr[-2000:], file=sys.stderr)
-            got = {f"{leg}_error": f"{type(e).__name__}"}
-        detail.update(got)
-        if args.verbose:
-            print(f"# {leg}: {got}")
+                print(f"# {leg}: {got}")
+        current_leg[0] = None
 
-    # budget sanity: every measured leg must fall inside its published
-    # [floor, ceiling] interval (10% tolerance for run-to-run drift between
-    # the leg subprocess and the budget subprocess)
-    for leg in WORKLOADS:
-        v = detail.get(leg)
-        ceil_v = detail.get(f"{leg}_ceiling_mev_s")
-        floor_v = detail.get(f"{leg}_floor_mev_s")
-        if not v or not ceil_v or not floor_v:
-            continue
-        if v > ceil_v * 1e6 * 1.1 or v < floor_v * 1e6 * 0.5:
-            detail[f"{leg}_budget_flag"] = (
-                f"measured {v:.0f} outside [{floor_v}M/2, {ceil_v}M*1.1]"
-            )
-
-    per = [detail.get(k) for k in WORKLOADS]
-    per = [v for v in per if v]
-    geomean = math.exp(sum(math.log(v) for v in per) / len(per)) if per else 0.0
-    print(
-        json.dumps(
-            {
-                "metric": "engine_throughput_geomean",
-                "value": round(geomean, 1),
-                "unit": "events/s",
-                "vs_baseline": round(geomean / REFERENCE_EVENTS_PER_SEC, 3),
-                "detail": detail,
-            }
-        )
-    )
+        # budget sanity: every measured leg must fall inside its published
+        # [floor, ceiling] interval (10% tolerance for run-to-run drift
+        # between the leg subprocess and the budget subprocess)
+        for leg in WORKLOADS:
+            v = detail.get(leg)
+            ceil_v = detail.get(f"{leg}_ceiling_mev_s")
+            floor_v = detail.get(f"{leg}_floor_mev_s")
+            if not v or not ceil_v or not floor_v:
+                continue
+            if v > ceil_v * 1e6 * 1.1 or v < floor_v * 1e6 * 0.5:
+                detail[f"{leg}_budget_flag"] = (
+                    f"measured {v:.0f} outside [{floor_v}M/2, {ceil_v}M*1.1]"
+                )
+    finally:
+        _emit()
 
 
 if __name__ == "__main__":

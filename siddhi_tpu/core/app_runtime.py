@@ -76,18 +76,56 @@ class SiddhiAppRuntime:
 
             self._scheduler = SystemTimeScheduler()
 
-        # @app:statistics(reporter='console'|'log', interval='N')
-        # (reference: SiddhiAppParser.java:106-142)
+        # @app:statistics(reporter='console'|'log'|'jsonl'|'prometheus'|'none',
+        #                 interval='N', trace.sample='P', trace.seed='S',
+        #                 trace.capacity='K', file='...', port='...')
+        # (reference: SiddhiAppParser.java:106-142; tracing/exposition are
+        # this engine's additions — see siddhi_tpu/observability/)
         self.statistics_manager = None
+        self.tracer = None
         st = find_annotation(app.annotations, "app:statistics")
         if st is not None:
             from siddhi_tpu.core.statistics import StatisticsManager
 
-            self.statistics_manager = StatisticsManager(
-                self.name,
-                reporter=st.element("reporter", "console"),
-                interval_s=float(st.element("interval", "60")),
-            )
+            opts = {k: v for k, v in st.elements if k is not None}
+            sample = opts.get("trace.sample")
+            if sample is not None:
+                from siddhi_tpu.observability.tracing import Tracer
+
+                try:
+                    self.tracer = Tracer(
+                        float(sample),
+                        capacity=int(opts.get("trace.capacity", "256")),
+                        seed=(
+                            int(opts["trace.seed"])
+                            if "trace.seed" in opts
+                            else None
+                        ),
+                    )
+                except ValueError as e:
+                    raise SiddhiAppCreationError(
+                        f"@app:statistics trace options: {e}"
+                    ) from e
+            try:
+                self.statistics_manager = StatisticsManager(
+                    self.name,
+                    reporter=st.element("reporter", "console"),
+                    interval_s=float(st.element("interval", "60")),
+                    options=opts,
+                    tracer=self.tracer,
+                )
+            except ValueError as e:
+                raise SiddhiAppCreationError(
+                    f"@app:statistics options: {e}"
+                ) from e
+            if str(self.statistics_manager.reporter).lower() == "prometheus":
+                try:
+                    int(opts.get("port", "9464"))
+                except ValueError as e:
+                    raise SiddhiAppCreationError(
+                        f"@app:statistics(reporter='prometheus'): invalid "
+                        f"port '{opts.get('port')}'"
+                    ) from e
 
         self.stream_schemas: dict[str, StreamSchema] = {}
         self.junctions: dict[str, StreamJunction] = {}
@@ -147,15 +185,21 @@ class SiddhiAppRuntime:
                     batch_max=int(a.element("batch.size.max", "0")) or None,
                 )
             if self.statistics_manager is not None:
-                tracker = self.statistics_manager.throughput_tracker(
-                    f"stream.{sid}"
+                sm = self.statistics_manager
+                j = self._junction(sid)
+                j.on_publish_stats = sm.throughput_tracker(f"stream.{sid}").add
+                sm.buffered_tracker(f"stream.{sid}").register(j.queued)
+                j.on_error_stats = sm.error_tracker(f"stream.{sid}").add
+                # per-subscriber error attribution: failures are ALSO counted
+                # under `stream.<id>.subscriber.<name>` (Prometheus exposes
+                # the pair as component/subscriber labels)
+                j.error_stats_factory = (
+                    lambda sub, _sid=sid: sm.error_tracker(
+                        f"stream.{_sid}", subscriber=sub
+                    ).add
                 )
-                self._junction(sid).on_publish_stats = tracker.add
-                bt = self.statistics_manager.buffered_tracker(f"stream.{sid}")
-                bt.register(self._junction(sid).queued)
-                self._junction(sid).on_error_stats = (
-                    self.statistics_manager.error_tracker(f"stream.{sid}").add
-                )
+                # live device budget for this junction's fused dispatch path
+                j.device_stats = sm.junction_device_stats(f"stream.{sid}")
 
         for sid, action in self.on_error_actions.items():
             j = self._junction(sid)
@@ -195,15 +239,30 @@ class SiddhiAppRuntime:
             nw = NamedWindow(wd, self.interner)
             self.named_windows[wid] = nw
             in_j = StreamJunction(nw.schema, self.interner, self.batch_size)
+            in_j.tracer = self.tracer
             self.junctions[wid] = in_j
             nw.out_junction = StreamJunction(
                 nw.schema, self.interner, self.batch_size
             )
+            nw.out_junction.tracer = self.tracer
+            wlt = (
+                self.statistics_manager.latency_tracker(f"window.{wid}")
+                if self.statistics_manager is not None
+                else None
+            )
 
-            def receive(batch: EventBatch, now: int, _nw=nw) -> None:
-                with self._process_lock:
-                    out, aux = _nw.receive(batch, now)
-                    _nw.out_junction.publish_batch(out, now)
+            def receive(batch: EventBatch, now: int, _nw=nw, _lt=wlt) -> None:
+                # mark_out in finally: a poison batch caught by the junction's
+                # failure policy must not leak an open mark on the TLS stack
+                if _lt is not None:
+                    _lt.mark_in()
+                try:
+                    with self._process_lock:
+                        out, aux = _nw.receive(batch, now)
+                        _nw.out_junction.publish_batch(out, now)
+                finally:
+                    if _lt is not None:
+                        _lt.mark_out()
                 if _nw.needs_scheduler:
                     if _nw.host_next_timer is not None:
                         self._scheduler.start()
@@ -213,7 +272,7 @@ class SiddhiAppRuntime:
                     else:
                         self._schedule_at(aux, _nw.timer_target)
 
-            in_j.subscribe(receive)
+            in_j.subscribe(receive, name=f"window.{wid}")
             if nw.needs_scheduler:
                 def fire(t_ms: int, _nw=nw, _recv=receive) -> None:
                     _recv(self._timer_batch(_nw.schema, t_ms), t_ms)
@@ -240,13 +299,27 @@ class SiddhiAppRuntime:
             for t in ar.tables.values():
                 self.tables[t.table_id] = t
 
-            def agg_receive(batch: EventBatch, now: int, _ar=ar) -> None:
-                with self._process_lock:
-                    aux = _ar.receive(batch, now)
+            alt = (
+                self.statistics_manager.latency_tracker(f"aggregation.{aid}")
+                if self.statistics_manager is not None
+                else None
+            )
+
+            def agg_receive(batch: EventBatch, now: int, _ar=ar, _lt=alt) -> None:
+                if _lt is not None:
+                    _lt.mark_in()
+                try:
+                    with self._process_lock:
+                        aux = _ar.receive(batch, now)
+                finally:
+                    if _lt is not None:
+                        _lt.mark_out()
                 if "next_timer" in aux:
                     self._schedule_at(aux, _ar.timer_target)
 
-            self._junction(in_sid).subscribe(agg_receive)
+            self._junction(in_sid).subscribe(
+                agg_receive, name=f"aggregation.{aid}"
+            )
 
             def agg_fire(t_ms: int, _ar=ar, _schema=in_schema, _recv=agg_receive) -> None:
                 _recv(self._timer_batch(_schema, t_ms), t_ms)
@@ -287,20 +360,32 @@ class SiddhiAppRuntime:
                 )
             for n_sink, ann in enumerate(find_all(d.annotations, "sink")):
                 sink = build_sink(ann, sid, schema)
+                sm = self.statistics_manager
                 wire_sink_error_handling(
                     sink,
                     lambda: self.manager.error_store,
                     self.name,
                     f"{sid}[{n_sink}]",
-                    self.statistics_manager.error_tracker(f"sink.{sid}").add
-                    if self.statistics_manager is not None
+                    sm.error_tracker(f"sink.{sid}").add
+                    if sm is not None
                     else None,
+                    on_publish_stats=(
+                        sm.throughput_tracker(f"sink.{sid}").add
+                        if sm is not None
+                        else None
+                    ),
+                    latency_tracker=(
+                        sm.latency_tracker(f"sink.{sid}")
+                        if sm is not None
+                        else None
+                    ),
                 )
                 self.sinks.append(sink)
                 self._junction(sid).add_stream_callback(
                     lambda rows, _s=sink: _s.on_events(
                         [Event(t, data) for t, data in rows]
-                    )
+                    ),
+                    name=f"sink.{sid}[{n_sink}]",
                 )
 
         from siddhi_tpu.core.partition import PartitionRuntime
@@ -358,6 +443,7 @@ class SiddhiAppRuntime:
                 raise DefinitionNotExistError(f"stream '{stream_id}' is not defined")
             j = StreamJunction(schema, self.interner, self.batch_size)
             j.exception_handler = getattr(self, "_exception_handler", None)
+            j.tracer = self.tracer
             self.junctions[stream_id] = j
         return j
 
@@ -405,6 +491,18 @@ class SiddhiAppRuntime:
         # fused-ingest eligibility checks the live target junction directly
         qr._insert_target_junction = target_junction
 
+    def _wire_query_stats(self, qr, qid: str):
+        """Attach latency + device-budget trackers to a query runtime;
+        returns the latency tracker (or None with statistics off)."""
+        sm = self.statistics_manager
+        if sm is None:
+            return None
+        qr.device_step_tracker = sm.device_time_tracker(f"query.{qid}", "step")
+        qr.sync_stall_tracker = sm.device_time_tracker(
+            f"query.{qid}", "sync_stall"
+        )
+        return sm.latency_tracker(f"query.{qid}")
+
     def _timer_batch(self, schema: StreamSchema, t_ms: int) -> EventBatch:
         from siddhi_tpu.core.event import KIND_TIMER
 
@@ -449,11 +547,7 @@ class SiddhiAppRuntime:
 
         decode = self._decode
         in_junction = src_junction or self._junction(stream.stream_id)
-        lt = (
-            self.statistics_manager.latency_tracker(f"query.{qid}")
-            if self.statistics_manager is not None
-            else None
-        )
+        lt = self._wire_query_stats(qr, qid)
 
         def receive(
             batch: EventBatch, now: int, _qr=qr, _lt=lt, _qid=qid,
@@ -469,11 +563,13 @@ class SiddhiAppRuntime:
                 )
             if _lt is not None:
                 _lt.mark_in()
-            with self._process_lock:
-                out_batch, aux = _qr.receive(batch, now)
-                _qr.route_output(out_batch, now, decode)
-            if _lt is not None:
-                _lt.mark_out()
+            try:
+                with self._process_lock:
+                    out_batch, aux = _qr.receive(batch, now)
+                    _qr.route_output(out_batch, now, decode)
+            finally:
+                if _lt is not None:
+                    _lt.mark_out()
             if dbg is not None:
                 dbg.check(
                     _qid, QueryTerminal.OUT,
@@ -484,7 +580,7 @@ class SiddhiAppRuntime:
                 )
             self._maybe_schedule(_qr, aux)
 
-        in_junction.subscribe(receive)
+        in_junction.subscribe(receive, name=f"query.{qid}")
         from siddhi_tpu.core.ingest import FuseEndpoint
 
         in_junction.fuse_candidates.append(FuseEndpoint(
@@ -537,11 +633,18 @@ class SiddhiAppRuntime:
         self.queries[qid] = qr
         self._wire_insert(qr)
         decode = self._decode
+        lt = self._wire_query_stats(qr, qid)
 
-        def receive(batch: EventBatch, now: int, sid: str, _qr=qr) -> None:
-            with self._process_lock:
-                out_batch, aux = _qr.receive(batch, now, sid)
-                _qr.route_output(out_batch, now, decode)
+        def receive(batch: EventBatch, now: int, sid: str, _qr=qr, _lt=lt) -> None:
+            if _lt is not None:
+                _lt.mark_in()
+            try:
+                with self._process_lock:
+                    out_batch, aux = _qr.receive(batch, now, sid)
+                    _qr.route_output(out_batch, now, decode)
+            finally:
+                if _lt is not None:
+                    _lt.mark_out()
             self._maybe_schedule(_qr, aux)
 
         from siddhi_tpu.core.ingest import FuseEndpoint
@@ -549,12 +652,14 @@ class SiddhiAppRuntime:
         for sid in qr.prog.stream_ids:
             sj = self._junction(sid)
             sj.subscribe(
-                lambda b, now, _sid=sid: receive(b, now, _sid)
+                lambda b, now, _sid=sid: receive(b, now, _sid),
+                name=f"query.{qid}",
             )
             sj.fuse_candidates.append(FuseEndpoint(
                 qr,
                 impl_factory=lambda _qr=qr, _sid=sid: _qr._make_step(_sid),
                 init_state=lambda now, _qr=qr: _qr.init_state(now),
+                latency_tracker=lt,
             ))
 
         if qr.needs_scheduler:
@@ -639,11 +744,20 @@ class SiddhiAppRuntime:
         self.queries[qid] = qr
         self._wire_insert(qr)
         decode = self._decode
+        lt = self._wire_query_stats(qr, qid)
 
-        def receive_side(batch: EventBatch, now: int, side: str, _qr=qr) -> None:
-            with self._process_lock:
-                out_batch, aux = _qr.receive(batch, now, side)
-                _qr.route_output(out_batch, now, decode)
+        def receive_side(
+            batch: EventBatch, now: int, side: str, _qr=qr, _lt=lt
+        ) -> None:
+            if _lt is not None:
+                _lt.mark_in()
+            try:
+                with self._process_lock:
+                    out_batch, aux = _qr.receive(batch, now, side)
+                    _qr.route_output(out_batch, now, decode)
+            finally:
+                if _lt is not None:
+                    _lt.mark_out()
             if "next_timer" in aux:
                 self._schedule_at(aux, _qr.timer_targets.get(side))
 
@@ -653,7 +767,12 @@ class SiddhiAppRuntime:
         # (reference: JoinInputStreamParser self-join double dispatch)
         if join.left.stream_id == join.right.stream_id:
             j = self._junction(join.left.stream_id)
-            j.subscribe(lambda b, now: (receive_side(b, now, "l"), receive_side(b, now, "r")))
+            j.subscribe(
+                lambda b, now: (
+                    receive_side(b, now, "l"), receive_side(b, now, "r")
+                ),
+                name=f"query.{qid}",
+            )
 
             def _both_sides_impl(_qr=qr):
                 import jax.numpy as jnp
@@ -679,6 +798,7 @@ class SiddhiAppRuntime:
             j.fuse_candidates.append(FuseEndpoint(
                 qr, impl_factory=_both_sides_impl,
                 init_state=lambda now, _qr=qr: _qr.init_state(),
+                latency_tracker=lt,
             ))
         else:
             for side, stream in (("l", join.left), ("r", join.right)):
@@ -688,12 +808,14 @@ class SiddhiAppRuntime:
                     # (no FuseEndpoint: that junction never sees send_columns,
                     # and the missing candidate keeps it per-batch)
                     nw.out_junction.subscribe(
-                        lambda b, now, _s=side: receive_side(b, now, _s)
+                        lambda b, now, _s=side: receive_side(b, now, _s),
+                        name=f"query.{qid}",
                     )
                 elif not qr.table_sides[side]:
                     sj = self._junction(stream.stream_id)
                     sj.subscribe(
-                        lambda b, now, _s=side: receive_side(b, now, _s)
+                        lambda b, now, _s=side: receive_side(b, now, _s),
+                        name=f"query.{qid}",
                     )
                     sj.fuse_candidates.append(FuseEndpoint(
                         qr,
@@ -703,6 +825,7 @@ class SiddhiAppRuntime:
                             )
                         ),
                         init_state=lambda now, _qr=qr: _qr.init_state(),
+                        latency_tracker=lt,
                     ))
 
         for side, schema in qr.side_schemas.items():
@@ -819,8 +942,29 @@ class SiddhiAppRuntime:
         return self._debugger
 
     def enable_stats(self, enabled: bool) -> None:
+        """Toggle metric collection AND tracing at runtime (reference:
+        SiddhiAppRuntime.enableStats:682). Disabling stops every tracker at
+        its gate check — the hot path cost becomes one attribute read."""
         if self.statistics_manager is not None:
             self.statistics_manager.enabled = enabled
+        if self.tracer is not None:
+            self.tracer.enabled = enabled
+
+    def traces(self) -> list:
+        """Completed sampled traces (oldest first), each a JSON-serializable
+        dict of spans crossing ingress junction -> query -> sink. Empty when
+        `@app:statistics(trace.sample=...)` is not configured."""
+        return self.tracer.traces() if self.tracer is not None else []
+
+    def dump_traces(self, path: str | None = None, indent: int = 1) -> str:
+        """JSON dump of `traces()`; also written to `path` when given."""
+        import json as _json
+
+        text = _json.dumps(self.traces(), indent=indent)
+        if path is not None:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(text)
+        return text
 
     def add_callback(self, name: str, callback: Callable) -> None:
         """Stream callback `cb(events: list[Event])` or query callback
@@ -889,8 +1033,16 @@ class SiddhiAppRuntime:
                 windows=self.named_windows,
                 aggregations=self.aggregations,
             )
-        with self._process_lock:
-            return sqr.execute(self.clock())
+        from siddhi_tpu.observability.metrics import timed
+
+        lt = (
+            self.statistics_manager.latency_tracker("storequery")
+            if self.statistics_manager is not None
+            else None
+        )
+        with timed(lt):
+            with self._process_lock:
+                return sqr.execute(self.clock())
 
     def start(self) -> None:
         self._running = True
@@ -925,6 +1077,10 @@ class SiddhiAppRuntime:
                 sm.register_memory(
                     f"table.{tid}", _tree_bytes(lambda _t=t: _t.state)
                 )
+                # table-op accounting: mutating steps + record-store flushes
+                # (wired here so aggregation duration tables are covered too)
+                t.mutation_stats = sm.throughput_tracker(f"table.{tid}").add
+                t.flush_latency = sm.latency_tracker(f"table.{tid}.flush")
             for wid, w in self.named_windows.items():
                 sm.register_memory(
                     f"window.{wid}", _tree_bytes(lambda _w=w: _w.state)
@@ -934,6 +1090,10 @@ class SiddhiAppRuntime:
                     f"aggregation.{aid}", _tree_bytes(lambda _a=ar: _a.state)
                 )
             sm.start_reporting()
+            if str(sm.reporter).lower() == "prometheus":
+                # pull-based exposition: serve every app on this manager
+                port = int(sm.options.get("port", "9464"))
+                self.manager.serve_metrics(port)
         if self._playback_clock is not None:
             self._playback_clock.start_heartbeat()
         # absent-at-start patterns must arm their timers before any event

@@ -25,6 +25,7 @@ falls back to the per-batch path with identical semantics.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 import jax
@@ -53,6 +54,9 @@ class FuseEndpoint:
         self.qr = qr
         self.impl_factory = impl_factory
         self.init_state = init_state
+        # in fused mode per-batch markIn/markOut is impossible (K batches run
+        # in one dispatch), so the tracker records the CHUNK dispatch wall
+        # time instead — the engine's actual unit of processing latency here
         self.latency_tracker = latency_tracker
 
 
@@ -112,8 +116,6 @@ class FusedJunctionIngest:
             return False  # an unfused subscriber is attached
         for ep in self.endpoints:
             qr = ep.qr
-            if ep.latency_tracker is not None:
-                return False
             if getattr(qr, "rate_limiter", None) is not None:
                 return False
             # query callbacks are OK: the deliver-mode program packs outputs
@@ -359,6 +361,18 @@ class FusedJunctionIngest:
             )
 
         app_lock = self.app._process_lock
+        # observability hooks: device-budget trackers on the junction plus
+        # per-endpoint latency trackers (recording CHUNK dispatch wall time —
+        # in fused mode the chunk is the unit of processing). All None/empty
+        # when statistics are off: the loop below pays one truthiness check.
+        ds = self.junction.device_stats
+        tracked = [
+            ep.latency_tracker
+            for ep in self.endpoints
+            if ep.latency_tracker is not None
+        ]
+        tr = self.junction.tracer
+        stream_span = f"stream.{self.junction.schema.stream_id}"
         pending_drain = None  # previous chunk's packs, drained one chunk late
         c_off = 0
         while c_off < n:
@@ -419,11 +433,29 @@ class FusedJunctionIngest:
                     ts_ep = ep.qr._collect_table_states()
                     ep_tids.append(list(ts_ep))
                     tstates.update(ts_ep)
+                span = (
+                    tr.start_span(stream_span, int(counts.sum()))
+                    if tr is not None
+                    else None
+                )
+                t0 = (
+                    time.perf_counter_ns()
+                    if (ds is not None or tracked)
+                    else 0
+                )
                 try:
                     new_states, tstates, aux_red, packs = prog(
                         tuple(states), tstates, wire,
                         counts, bases, np.int64(now),
                     )
+                    if t0:
+                        dt = time.perf_counter_ns() - t0
+                        for lt in tracked:
+                            lt.record_ns(dt)
+                        if ds is not None:
+                            ds.step.record_ns(dt)
+                            ds.h2d_bytes.add(int(wire.nbytes))
+                            ds.h2d_chunks.add(1)
                 except Exception as e:
                     # the call donated the state buffers: they are gone either
                     # way, so reset to fresh state (lazily re-initialized on
@@ -439,6 +471,9 @@ class FusedJunctionIngest:
                     handler(e)
                     c_off = c_end
                     continue  # next chunk, like per-batch send_columns would
+                finally:
+                    if span is not None:
+                        tr.end_span(span)
                 for ep, st in zip(self.endpoints, new_states):
                     ep.qr.state = st
                 for ep, tids in zip(self.endpoints, ep_tids):
@@ -503,6 +538,7 @@ class FusedJunctionIngest:
 
         if not hasattr(self, "_drain_guess"):
             self._drain_guess = {}
+        ds = self.junction.device_stats
         # packs align with the endpoints the program was built to deliver
         for i, pack in zip(self._deliver_idx, packs):
             qr = self.endpoints[i].qr
@@ -523,9 +559,12 @@ class FusedJunctionIngest:
             # ascontiguousarray: this backend's device_get can hand back a
             # strided view of the device-layout buffer for some slice sizes,
             # and the .view(dtype) reinterprets below require dense bytes
+            t0 = time.perf_counter_ns() if ds is not None else 0
             head = np.ascontiguousarray(
                 jax.device_get(pack["buf"][: hdr_rows + guess])
             )
+            if t0:
+                ds.sync_stall.record_ns(time.perf_counter_ns() - t0)
             cnts = head[:hdr_rows].reshape(-1)[: 4 * K].view(np.int32)
             total = int(cnts.sum())
             self._drain_guess[i] = max(total, 1)
@@ -535,11 +574,14 @@ class FusedJunctionIngest:
             if L <= guess:
                 host = head[hdr_rows:]
             else:
+                t0 = time.perf_counter_ns() if ds is not None else 0
                 tail = np.ascontiguousarray(
                     jax.device_get(
                         pack["buf"][hdr_rows + guess : hdr_rows + L]
                     )
                 )
+                if t0:
+                    ds.sync_stall.record_ns(time.perf_counter_ns() - t0)
                 host = np.concatenate([head[hdr_rows:], tail])
             lanes = {}
             for name, dt, off in layout:

@@ -421,6 +421,8 @@ class Sink:
         self.app_name = ""
         self.sink_ref = ""
         self.on_error_stats: Optional[Callable[[int], None]] = None
+        self.on_publish_stats: Optional[Callable[[int], None]] = None
+        self.latency_tracker = None  # map+publish latency histogram
 
     def connect(self) -> None:
         pass
@@ -439,8 +441,15 @@ class Sink:
         self.disconnect()
 
     def on_events(self, events: list[Event]) -> None:
-        payload = self.mapper.map(events) if self.mapper else events
-        self.publish_guarded(payload)
+        from siddhi_tpu.observability.metrics import timed
+
+        with timed(self.latency_tracker):
+            payload = self.mapper.map(events) if self.mapper else events
+            ok = self.publish_guarded(payload)
+            # count only DELIVERED events: a down transport must not report
+            # healthy egress throughput while dropping/spilling payloads
+            if ok and self.on_publish_stats is not None:
+                self.on_publish_stats(len(events))
 
     def publish_guarded(self, payload) -> bool:
         """Publish under the sink's on.error policy; True when the payload was
@@ -636,11 +645,14 @@ class DistributedSink:
 def wire_sink_error_handling(
     sink, error_store_fn: Callable[[], object], app_name: str,
     sink_ref: str, on_error_stats: Optional[Callable[[int], None]] = None,
+    on_publish_stats: Optional[Callable[[int], None]] = None,
+    latency_tracker=None,
 ) -> None:
-    """Attach app-level error plumbing to a (possibly distributed) sink.
-    `sink_ref` uniquely names this @sink within the app; distributed
+    """Attach app-level error/metrics plumbing to a (possibly distributed)
+    sink. `sink_ref` uniquely names this @sink within the app; distributed
     destinations get `.0`, `.1`, ... suffixes so STORE entries identify the
-    exact failing destination for replay."""
+    exact failing destination for replay. Throughput/latency trackers are
+    shared across a distributed sink's destinations (one egress component)."""
     if isinstance(sink, DistributedSink):
         targets = [(s, f"{sink_ref}.{i}") for i, s in enumerate(sink.sinks)]
     else:
@@ -650,6 +662,8 @@ def wire_sink_error_handling(
         s.app_name = app_name
         s.sink_ref = ref
         s.on_error_stats = on_error_stats
+        s.on_publish_stats = on_publish_stats
+        s.latency_tracker = latency_tracker
 
 
 # ---------------------------------------------------------------------------

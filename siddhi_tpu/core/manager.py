@@ -19,6 +19,7 @@ class SiddhiManager:
         self.persistence_store = None
         self._error_store = None
         self._runtimes: dict[str, object] = {}
+        self._metrics_server = None
 
     # app: SiddhiQL source text or a programmatic SiddhiApp AST
     def create_siddhi_app_runtime(
@@ -121,6 +122,57 @@ class SiddhiManager:
         """Deployment config SPI (reference: SiddhiManager.setConfigManager)."""
         self.config_manager = config_manager
 
+    # ---- metrics exposition (observability/http_server.py) ----------------
+
+    def serve_metrics(self, port: int = 9464, host: str = "127.0.0.1") -> int:
+        """Serve Prometheus text (`/metrics`), raw reports (`/metrics.json`),
+        and sampled traces (`/traces`) for EVERY app runtime registered on
+        this manager that has statistics enabled. Idempotent: a second call
+        returns the already-bound port. Pass port=0 for an ephemeral port;
+        the bound port is returned either way."""
+        if self._metrics_server is not None:
+            bound = self._metrics_server.port
+            if port not in (0, bound):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "serve_metrics(%d): metrics are already served on port "
+                    "%d; the manager exposes ONE endpoint for all apps — "
+                    "point the scrape at %d", port, bound, bound,
+                )
+            return bound
+        from siddhi_tpu.observability.http_server import MetricsServer
+
+        self._metrics_server = MetricsServer(self, host=host, port=port)
+        return self._metrics_server.port
+
+    @property
+    def metrics_port(self):
+        """Bound metrics port, or None when no endpoint is being served."""
+        return (
+            self._metrics_server.port
+            if self._metrics_server is not None
+            else None
+        )
+
+    def stop_metrics(self) -> None:
+        srv, self._metrics_server = self._metrics_server, None
+        if srv is not None:
+            srv.close()
+
+    def observability_reports(self) -> list:
+        """One `StatisticsManager.report()` dict per stats-enabled app."""
+        return [
+            rt.statistics_manager.report()
+            for rt in list(self._runtimes.values())
+            if getattr(rt, "statistics_manager", None) is not None
+        ]
+
+    def prometheus_text(self) -> str:
+        from siddhi_tpu.observability.reporters import render_prometheus
+
+        return render_prometheus(self.observability_reports())
+
     def persist(self) -> None:
         for rt in self._runtimes.values():
             rt.persist()
@@ -130,6 +182,7 @@ class SiddhiManager:
             rt.restore_last_revision()
 
     def shutdown(self) -> None:
+        self.stop_metrics()
         for rt in list(self._runtimes.values()):
             rt.shutdown()
         self._runtimes.clear()

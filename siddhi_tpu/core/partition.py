@@ -628,7 +628,9 @@ class PartitionRuntime:
                         table_apply(flat, now)
                 app._maybe_schedule(_qr, aux)
 
-            app._junction(stream.stream_id).subscribe(receive)
+            app._junction(stream.stream_id).subscribe(
+                receive, name=f"query.{qid}"
+            )
 
             if qr.needs_scheduler:
                 def fire(t_ms: int, _qr=qr, _schema=in_schema) -> None:
@@ -732,14 +734,17 @@ class PartitionRuntime:
         if join.left.stream_id == join.right.stream_id:
             j = app._junction(join.left.stream_id)
             j.subscribe(
-                lambda b, now: (receive_side(b, now, "l"), receive_side(b, now, "r"))
+                lambda b, now: (receive_side(b, now, "l"), receive_side(b, now, "r")),
+                name=f"query.{qid}",
             )
         else:
             app._junction(join.left.stream_id).subscribe(
-                lambda b, now: receive_side(b, now, "l")
+                lambda b, now: receive_side(b, now, "l"),
+                name=f"query.{qid}",
             )
             app._junction(join.right.stream_id).subscribe(
-                lambda b, now: receive_side(b, now, "r")
+                lambda b, now: receive_side(b, now, "r"),
+                name=f"query.{qid}",
             )
 
     def _add_pattern_query(self, qid: str, query: Query) -> None:
@@ -781,7 +786,8 @@ class PartitionRuntime:
 
         for sid in qr.prog.stream_ids:
             app._junction(sid).subscribe(
-                lambda b, now, _sid=sid: receive(b, now, _sid)
+                lambda b, now, _sid=sid: receive(b, now, _sid),
+                name=f"query.{qid}",
             )
 
         if qr.needs_scheduler:

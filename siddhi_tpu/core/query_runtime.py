@@ -289,6 +289,10 @@ class BaseQueryRuntime:
         self.query_callbacks: list[Callable] = []
         self.publish_fn: Optional[Callable] = None
         self._receive_lock = threading.RLock()
+        # device-budget trackers (wired by the app runtime when statistics
+        # are on): jitted-step dispatch time and host-blocking decode stalls
+        self.device_step_tracker = None
+        self.sync_stall_tracker = None
         self.state = None
         self.tables = {}
         self.table_op = None
@@ -487,13 +491,28 @@ class BaseQueryRuntime:
                 self.query_id,
             )
 
+    def _timed_decode(self, decode, schema, out):
+        """Host decode with the d2h truth-sync stall recorded: decoding a
+        device batch is the blocking read that forces real completion of the
+        dependent chain (the live version of bench.py's truth sync)."""
+        st = self.sync_stall_tracker
+        if st is None:
+            return decode(schema, out)
+        import time as _time
+
+        t0 = _time.perf_counter_ns()
+        try:
+            return decode(schema, out)
+        finally:
+            st.record_ns(_time.perf_counter_ns() - t0)
+
     def route_output(self, out: EventBatch, now: int, decode) -> None:
         """Dispatch a step's output to query callbacks / downstream junction.
 
         `decode` = app-runtime host decoder (batch -> event triples).
         """
         if self.rate_limiter is not None:
-            rows = decode(self.out_schema, out)
+            rows = self._timed_decode(decode, self.out_schema, out)
             keys = None
             if "__group_key__" in out.cols:
                 import numpy as np
@@ -521,7 +540,7 @@ class BaseQueryRuntime:
             self._deliver(released, now)
             return
         if self.query_callbacks:
-            events = decode(self.out_schema, out)
+            events = self._timed_decode(decode, self.out_schema, out)
             if events:
                 ins = [e for e in events if e[1] == KIND_CURRENT]
                 removed = [e for e in events if e[1] == KIND_EXPIRED]
@@ -669,9 +688,16 @@ class QueryRuntime(BaseQueryRuntime):
             if self.state is None:
                 self.state = self._fresh(self.init_state())
             tstates = self._collect_table_states()
+            dt = self.device_step_tracker
+            if dt is not None:
+                import time as _time
+
+                t0 = _time.perf_counter_ns()
             self.state, tstates, out, aux = self._step(
                 self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)
             )
+            if dt is not None:
+                dt.record_ns(_time.perf_counter_ns() - t0)
             self._writeback_table_states(tstates)
         self._warn_aux(aux)
         return out, aux

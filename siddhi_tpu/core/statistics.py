@@ -1,150 +1,43 @@
-"""Statistics: throughput/latency/buffer trackers + periodic reporters.
+"""Statistics — back-compat shim over `siddhi_tpu.observability`.
+
+The statistics subsystem grew into a package: histogram metrics
+(log-bucketed p50/p95/p99/p999 + EWMA rates), a reporter SPI
+(console/log/JSON-lines/Prometheus via `manager.serve_metrics(port)`),
+sampled event tracing, and device-budget profiling hooks. Everything that
+used to live here keeps its import path and API:
+
+  ThroughputTracker      count + 1m/5m EWMA rates
+  LatencyTracker         mark_in/mark_out -> log-bucketed histogram
+                         (nesting-safe via a per-thread mark stack)
+  BufferedEventsTracker  async ring occupancy
+  StatisticsManager      registry + reporter thread (+ device metrics,
+                         per-subscriber error attribution)
 
 Reference: util/statistics/metrics/SiddhiStatisticsManager.java:35-80
-(Dropwizard MetricRegistry + console/JMX reporters), ThroughputTracker.java,
-LatencyTracker.java, BufferedEventsTracker.java; enabled by
-`@app:statistics(reporter='console', interval='N')` (SiddhiAppParser.java:106-142)
-and toggled at runtime (SiddhiAppRuntime.enableStats :682). Metric naming
-follows util/SiddhiConstants.java METRIC_* conventions.
+(Dropwizard MetricRegistry + console/JMX reporters); enabled by
+`@app:statistics(reporter='console', interval='N')`
+(SiddhiAppParser.java:106-142) and toggled at runtime
+(SiddhiAppRuntime.enableStats :682).
 """
 
 from __future__ import annotations
 
-import threading
-import time
-from typing import Optional
+from siddhi_tpu.observability.metrics import (  # noqa: F401
+    BufferedEventsTracker,
+    LatencyTracker,
+    LogHistogram,
+    ThroughputTracker,
+)
+from siddhi_tpu.observability.registry import (  # noqa: F401
+    JunctionDeviceStats,
+    StatisticsManager,
+)
 
-
-class ThroughputTracker:
-    def __init__(self, name: str):
-        self.name = name
-        self.count = 0
-        self._lock = threading.Lock()
-
-    def add(self, n: int = 1) -> None:
-        with self._lock:
-            self.count += n
-
-
-class LatencyTracker:
-    """markIn/markOut around a processing chain (per-thread nesting safe)."""
-
-    def __init__(self, name: str):
-        self.name = name
-        self.total_ns = 0
-        self.samples = 0
-        self._tls = threading.local()
-        self._lock = threading.Lock()
-
-    def mark_in(self) -> None:
-        self._tls.t0 = time.perf_counter_ns()
-
-    def mark_out(self) -> None:
-        t0 = getattr(self._tls, "t0", None)
-        if t0 is None:
-            return
-        dt = time.perf_counter_ns() - t0
-        with self._lock:
-            self.total_ns += dt
-            self.samples += 1
-
-    @property
-    def avg_ms(self) -> float:
-        return (self.total_ns / self.samples) / 1e6 if self.samples else 0.0
-
-
-class BufferedEventsTracker:
-    """Occupancy of async ingress rings (reference: BufferedEventsTracker on
-    Disruptor rings, StreamJunction.java:334-345)."""
-
-    def __init__(self, name: str):
-        self.name = name
-        self.get_size = lambda: 0
-
-    def register(self, fn) -> None:
-        self.get_size = fn
-
-
-class StatisticsManager:
-    """reference: SiddhiStatisticsManager — registry + reporter thread."""
-
-    def __init__(self, app_name: str, reporter: str = "console", interval_s: float = 60.0):
-        self.app_name = app_name
-        self.reporter = reporter
-        self.interval_s = float(interval_s)
-        self.throughput: dict[str, ThroughputTracker] = {}
-        self.latency: dict[str, LatencyTracker] = {}
-        self.buffered: dict[str, BufferedEventsTracker] = {}
-        # failed dispatches / sink publishes per component (reference analog:
-        # the error counters Siddhi's metrics registry keeps per junction)
-        self.errors: dict[str, ThroughputTracker] = {}
-        # name -> () -> bytes; the TPU-native analog of the reference's
-        # ObjectSizeCalculator memory metric (util/statistics/memory/):
-        # device-buffer bytes held by each component's carried state
-        self.memory: dict[str, callable] = {}
-        self.enabled = True
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-
-    def throughput_tracker(self, name: str) -> ThroughputTracker:
-        return self.throughput.setdefault(name, ThroughputTracker(name))
-
-    def latency_tracker(self, name: str) -> LatencyTracker:
-        return self.latency.setdefault(name, LatencyTracker(name))
-
-    def buffered_tracker(self, name: str) -> BufferedEventsTracker:
-        return self.buffered.setdefault(name, BufferedEventsTracker(name))
-
-    def error_tracker(self, name: str) -> ThroughputTracker:
-        return self.errors.setdefault(name, ThroughputTracker(name))
-
-    def register_memory(self, name: str, fn) -> None:
-        """fn() -> device bytes held by the named component's state."""
-        self.memory[name] = fn
-
-    # ---- reporting ---------------------------------------------------------
-
-    def report(self) -> dict:
-        mem = {}
-        for n, fn in self.memory.items():
-            try:
-                mem[n] = int(fn())
-            except Exception:
-                mem[n] = -1
-        return {
-            "app": self.app_name,
-            "throughput": {n: t.count for n, t in self.throughput.items()},
-            "latency_avg_ms": {
-                n: round(t.avg_ms, 3) for n, t in self.latency.items()
-            },
-            "buffered": {n: t.get_size() for n, t in self.buffered.items()},
-            "errors": {n: t.count for n, t in self.errors.items()},
-            "memory_bytes": mem,
-        }
-
-    def start_reporting(self) -> None:
-        if self._thread is not None or self.reporter not in ("console", "log"):
-            return
-        self._stop.clear()
-
-        def run():
-            import logging
-
-            log = logging.getLogger(f"siddhi_tpu.statistics.{self.app_name}")
-            while not self._stop.wait(self.interval_s):
-                if self.enabled:
-                    rep = self.report()
-                    if self.reporter == "console":
-                        print(f"[siddhi_tpu stats] {rep}", flush=True)
-                    else:
-                        log.info("%s", rep)
-
-        self._thread = threading.Thread(target=run, daemon=True)
-        self._thread.start()
-
-    def stop_reporting(self) -> None:
-        self._stop.set()
-        t = self._thread
-        if t is not None and t is not threading.current_thread():
-            t.join(timeout=2.0)
-        self._thread = None
+__all__ = [
+    "ThroughputTracker",
+    "LatencyTracker",
+    "LogHistogram",
+    "BufferedEventsTracker",
+    "StatisticsManager",
+    "JunctionDeviceStats",
+]

@@ -34,7 +34,9 @@ class StreamJunction:
         self.interner = interner
         self.batch_size = batch_size
         self.subscribers: list[Subscriber] = []
+        self.subscriber_names: list[str] = []
         self.stream_callbacks: list[Callable] = []
+        self.stream_callback_names: list[str] = []
         # fused-ingest wiring (core/ingest.py): subscribers that also register
         # a FuseEndpoint here can be run K-batches-per-dispatch by send_columns
         self.fuse_candidates: list = []
@@ -44,6 +46,16 @@ class StreamJunction:
         self.lock = threading.RLock()
         self.on_publish_stats: Callable[[int], None] | None = None
         self.on_error_stats: Callable[[int], None] | None = None
+        # per-subscriber error attribution: factory(subscriber_name) -> add fn
+        # for the `stream.<id>.subscriber.<name>` counter; adders cached here
+        self.error_stats_factory: Callable[[str], Callable[[int], None]] | None = None
+        self._sub_error_stats: dict[str, Callable[[int], None]] = {}
+        # sampled event tracing (observability.tracing.Tracer); spans are
+        # recorded per publish + per named subscriber when a trace is active
+        self.tracer = None
+        # device-budget trackers (JunctionDeviceStats) used by the fused
+        # ingest path: step dispatch time, h2d bytes/chunks, sync stalls
+        self.device_stats = None
         # user hook for subscriber failures (reference: the pluggable
         # Disruptor ExceptionHandler, SiddhiAppRuntime.java:664)
         self.exception_handler: Callable[[Exception], None] | None = None
@@ -56,11 +68,19 @@ class StreamJunction:
         self.error_store_fn: Callable[[], object] | None = None
         self.app_name: str = ""
 
-    def subscribe(self, fn: Subscriber) -> None:
+    def subscribe(self, fn: Subscriber, name: str | None = None) -> None:
+        """`name` labels this subscriber in error attribution and trace spans
+        (e.g. 'query.q'); unnamed subscribers get a positional label."""
         self.subscribers.append(fn)
+        self.subscriber_names.append(
+            name if name else f"subscriber{len(self.subscribers) - 1}"
+        )
 
-    def add_stream_callback(self, fn: Callable) -> None:
+    def add_stream_callback(self, fn: Callable, name: str | None = None) -> None:
         self.stream_callbacks.append(fn)
+        self.stream_callback_names.append(
+            name if name else f"callback{len(self.stream_callbacks) - 1}"
+        )
 
     # ---- @async ingress (reference: StreamJunction.java:262-298 Disruptor
     # ring + StreamHandler batching into EventExchangeHolders) --------------
@@ -245,45 +265,86 @@ class StreamJunction:
     def publish_batch(self, batch: EventBatch, now: int) -> None:
         """Fan a device batch out to all subscribers (already this stream's schema)."""
         with self.lock:
+            n_valid = -1
             if self.on_publish_stats is not None:
-                self.on_publish_stats(int(np.asarray(batch.valid).sum()))
-            guarded = (
-                self.exception_handler is not None or self.fault_policy is not None
+                n_valid = int(np.asarray(batch.valid).sum())
+                self.on_publish_stats(n_valid)
+            tr = self.tracer
+            root = (
+                tr.start_span(f"stream.{self.schema.stream_id}", n_valid)
+                if tr is not None
+                else None
             )
-            # one STREAM/STORE routing per batch even when several subscribers
-            # fail on it — fault consumers must not double-count a failure
-            routed = False
-            for fn in self.subscribers:
-                if not guarded:
-                    fn(batch, now)
-                else:
+            try:
+                guarded = (
+                    self.exception_handler is not None or self.fault_policy is not None
+                )
+                # one STREAM/STORE routing per batch even when several subscribers
+                # fail on it — fault consumers must not double-count a failure
+                routed = False
+                for i, fn in enumerate(self.subscribers):
+                    sp = (
+                        tr.start_span(self.subscriber_names[i], n_valid)
+                        if tr is not None
+                        else None
+                    )
                     try:
-                        fn(batch, now)
-                    except Exception as e:  # user-owned failure policy
-                        routed |= self._on_dispatch_error(batch, now, e, routed)
-            if self.stream_callbacks:
-                try:
-                    events = self.schema.from_batch(batch, self.interner)
-                except Exception as e:
-                    if not guarded:
-                        raise
-                    self._on_dispatch_error(batch, now, e, routed)
-                    return
-                if events:
-                    rows = [(ts, data) for ts, kind, data in events]
-                    for cb in self.stream_callbacks:
                         if not guarded:
-                            cb(rows)
+                            fn(batch, now)
                         else:
                             try:
-                                cb(rows)
-                            except Exception as e:
+                                fn(batch, now)
+                            except Exception as e:  # user-owned failure policy
                                 routed |= self._on_dispatch_error(
-                                    batch, now, e, routed
+                                    batch, now, e, routed,
+                                    subscriber=self.subscriber_names[i],
                                 )
+                    finally:
+                        if sp is not None:
+                            tr.end_span(sp)
+                if self.stream_callbacks:
+                    try:
+                        events = self.schema.from_batch(batch, self.interner)
+                    except Exception as e:
+                        if not guarded:
+                            raise
+                        self._on_dispatch_error(batch, now, e, routed)
+                        return
+                    if events:
+                        rows = [(ts, data) for ts, kind, data in events]
+                        for i, cb in enumerate(self.stream_callbacks):
+                            sp = (
+                                tr.start_span(
+                                    self.stream_callback_names[i], len(rows)
+                                )
+                                if tr is not None
+                                else None
+                            )
+                            try:
+                                if not guarded:
+                                    cb(rows)
+                                else:
+                                    try:
+                                        cb(rows)
+                                    except Exception as e:
+                                        routed |= self._on_dispatch_error(
+                                            batch, now, e, routed,
+                                            subscriber=self.stream_callback_names[i],
+                                        )
+                            finally:
+                                if sp is not None:
+                                    tr.end_span(sp)
+            finally:
+                if root is not None:
+                    tr.end_span(root)
 
     def _on_dispatch_error(
-        self, batch: EventBatch, now: int, exc: Exception, routed: bool = False
+        self,
+        batch: EventBatch,
+        now: int,
+        exc: Exception,
+        routed: bool = False,
+        subscriber: str | None = None,
     ) -> bool:
         """Apply the stream's failure policy to one failed dispatch; returns
         True when the batch's events were routed (fault stream / error store).
@@ -296,6 +357,12 @@ class StreamJunction:
         log = logging.getLogger(__name__)
         if self.on_error_stats is not None:
             self.on_error_stats(1)
+        factory = self.error_stats_factory
+        if factory is not None and subscriber is not None:
+            add = self._sub_error_stats.get(subscriber)
+            if add is None:
+                add = self._sub_error_stats[subscriber] = factory(subscriber)
+            add(1)
         if self.exception_handler is not None:
             try:
                 self.exception_handler(exc)

@@ -115,6 +115,11 @@ class InMemoryTable:
 
         self.lock = threading.RLock()
         self.state = self.init_state()
+        # observability hooks (wired by the app runtime when @app:statistics
+        # is on): mutation_stats counts mutating steps committed to this
+        # table; flush_latency times record-store write-through snapshots
+        self.mutation_stats = None
+        self.flush_latency = None
 
         # @store(type='...'): external record store — load initial contents,
         # write a snapshot through after each mutation (reference:
@@ -155,6 +160,8 @@ class InMemoryTable:
         """Mark dirty; snapshots coalesce to at most one per second (the
         full-table host decode would otherwise stall the dispatch pipeline on
         every mutating step). flush_record_store() forces the write."""
+        if self.mutation_stats is not None:
+            self.mutation_stats(1)
         if self.record_store is None:
             return
         if self.lazy:
@@ -196,8 +203,11 @@ class InMemoryTable:
             if self._flush_timer is not None:
                 self._flush_timer.cancel()
                 self._flush_timer = None
-            rows = self.rows()
-            store.on_change(rows)
+            from siddhi_tpu.observability.metrics import timed
+
+            with timed(self.flush_latency):
+                rows = self.rows()
+                store.on_change(rows)
             self._dirty = False
             self._last_flush = _time.monotonic()
 

@@ -1,0 +1,49 @@
+"""siddhi_tpu.observability — engine-wide metrics, exposition, and tracing.
+
+Histogram metrics (log-bucketed p50/p95/p99/p999 + EWMA rates), a pluggable
+reporter SPI with console/log/JSON-lines/Prometheus exposition, sampled
+event tracing across junction -> query -> sink, and device-budget profiling
+hooks (dispatch step time, h2d wire traffic, truth-sync stalls).
+
+`siddhi_tpu.core.statistics` is a back-compat shim over this package.
+"""
+
+from siddhi_tpu.observability.metrics import (  # noqa: F401
+    BufferedEventsTracker,
+    EWMA,
+    LatencyTracker,
+    LogHistogram,
+    ThroughputTracker,
+    timed,
+)
+from siddhi_tpu.observability.registry import (  # noqa: F401
+    JunctionDeviceStats,
+    StatisticsManager,
+)
+from siddhi_tpu.observability.reporters import (  # noqa: F401
+    ConsoleReporter,
+    JsonLinesReporter,
+    LogReporter,
+    Reporter,
+    register_reporter,
+    render_prometheus,
+)
+from siddhi_tpu.observability.tracing import Tracer  # noqa: F401
+
+__all__ = [
+    "LogHistogram",
+    "EWMA",
+    "ThroughputTracker",
+    "LatencyTracker",
+    "BufferedEventsTracker",
+    "StatisticsManager",
+    "JunctionDeviceStats",
+    "Reporter",
+    "ConsoleReporter",
+    "LogReporter",
+    "JsonLinesReporter",
+    "register_reporter",
+    "render_prometheus",
+    "timed",
+    "Tracer",
+]

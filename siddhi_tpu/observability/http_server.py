@@ -1,0 +1,88 @@
+"""Metrics exposition endpoint: a tiny stdlib `http.server` serving every
+app registered on a SiddhiManager.
+
+Routes:
+  /metrics        Prometheus text format (version 0.0.4) — scrape this
+  /metrics.json   the raw report() dicts, one per app
+  /traces         sampled trace spans per app (JSON)
+
+Started by `manager.serve_metrics(port)` (idempotent; port 0 picks an
+ephemeral port and returns it). No dependency beyond the stdlib — the
+environment bakes no prometheus_client, and the text format is stable.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MetricsServer:
+    def __init__(self, manager, host: str = "127.0.0.1", port: int = 9464):
+        self.manager = manager
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # keep scrapes out of stderr
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path in ("/metrics", "/"):
+                        body = outer._prometheus().encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif path == "/metrics.json":
+                        body = json.dumps(
+                            outer._reports(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/traces":
+                        body = json.dumps(
+                            outer._traces(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # a bad metric must not 500 forever
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"siddhi-metrics:{self.port}",
+        )
+        self._thread.start()
+
+    def _reports(self) -> list[dict]:
+        return self.manager.observability_reports()
+
+    def _prometheus(self) -> str:
+        from siddhi_tpu.observability.reporters import render_prometheus
+
+        return render_prometheus(self._reports())
+
+    def _traces(self) -> dict:
+        return {
+            rt.name: rt.traces()
+            for rt in list(self.manager._runtimes.values())
+            if getattr(rt, "tracer", None) is not None
+        }
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=2.0)

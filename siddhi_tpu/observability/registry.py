@@ -1,0 +1,235 @@
+"""StatisticsManager: per-app metric registry + periodic reporter thread.
+
+Reference: util/statistics/metrics/SiddhiStatisticsManager.java:35-80
+(Dropwizard MetricRegistry + reporters), enabled by
+`@app:statistics(reporter=..., interval=..., trace.sample=...)`
+(SiddhiAppParser.java:106-142) and toggled at runtime
+(SiddhiAppRuntime.enableStats :682). Metric naming follows
+util/SiddhiConstants.java METRIC_* conventions (`stream.S`, `query.q`,
+`table.T`, `sink.S`, ...).
+
+The registry IS the enable gate: every tracker it hands out checks
+`registry.enabled` on the hot path, so `enable_stats(False)` stops
+collection (not just reporting) with one attribute read per event batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from siddhi_tpu.observability.metrics import (
+    BufferedEventsTracker,
+    LatencyTracker,
+    ThroughputTracker,
+)
+
+
+class JunctionDeviceStats:
+    """Device-budget trackers for one junction's dispatch path: fused-step
+    dispatch time, h2d wire traffic, and d2h truth-sync stalls (the engine's
+    live version of what bench.py's `timebudget` leg reconstructs offline)."""
+
+    __slots__ = ("step", "h2d_bytes", "h2d_chunks", "sync_stall")
+
+    def __init__(self, registry: "StatisticsManager", component: str) -> None:
+        self.step = registry.device_time_tracker(component, "fused_step")
+        self.h2d_bytes = registry.device_counter(component, "h2d_bytes")
+        self.h2d_chunks = registry.device_counter(component, "h2d_chunks")
+        self.sync_stall = registry.device_time_tracker(component, "sync_stall")
+
+
+class StatisticsManager:
+    """Registry of trackers + reporter thread (one per app runtime)."""
+
+    def __init__(
+        self,
+        app_name: str,
+        reporter: str = "console",
+        interval_s: float = 60.0,
+        options: Optional[dict] = None,
+        tracer=None,
+    ):
+        self.app_name = app_name
+        self.reporter = reporter
+        self.interval_s = float(interval_s)
+        self.options = dict(options or {})
+        self.tracer = tracer
+        self.throughput: dict[str, ThroughputTracker] = {}
+        self.latency: dict[str, LatencyTracker] = {}
+        self.buffered: dict[str, BufferedEventsTracker] = {}
+        # failed dispatches / sink publishes per component; per-subscriber
+        # attribution keys are `<component>.subscriber.<name>` with the
+        # structured (component, subscriber) pair kept on the tracker
+        self.errors: dict[str, ThroughputTracker] = {}
+        # name -> () -> bytes; the TPU-native analog of the reference's
+        # ObjectSizeCalculator memory metric (util/statistics/memory/):
+        # device-buffer bytes held by each component's carried state
+        self.memory: dict[str, callable] = {}
+        # device-time budget: `<component>.<op>` -> histogram / counter
+        self.device_time: dict[str, LatencyTracker] = {}
+        self.device_counters: dict[str, ThroughputTracker] = {}
+        self.enabled = True
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reporter_obj = None
+
+    # ---- tracker factories -------------------------------------------------
+
+    def throughput_tracker(self, name: str) -> ThroughputTracker:
+        t = self.throughput.get(name)
+        if t is None:
+            t = self.throughput[name] = ThroughputTracker(name, gate=self)
+        return t
+
+    def latency_tracker(self, name: str) -> LatencyTracker:
+        t = self.latency.get(name)
+        if t is None:
+            t = self.latency[name] = LatencyTracker(name, gate=self)
+        return t
+
+    def buffered_tracker(self, name: str) -> BufferedEventsTracker:
+        return self.buffered.setdefault(name, BufferedEventsTracker(name))
+
+    def error_tracker(
+        self, name: str, subscriber: Optional[str] = None
+    ) -> ThroughputTracker:
+        key = f"{name}.subscriber.{subscriber}" if subscriber else name
+        t = self.errors.get(key)
+        if t is None:
+            t = self.errors[key] = ThroughputTracker(key, gate=self)
+            t.component = name
+            t.subscriber = subscriber
+        return t
+
+    def register_memory(self, name: str, fn) -> None:
+        """fn() -> device bytes held by the named component's state."""
+        self.memory[name] = fn
+
+    def device_time_tracker(self, component: str, op: str) -> LatencyTracker:
+        key = f"{component}.{op}"
+        t = self.device_time.get(key)
+        if t is None:
+            t = self.device_time[key] = LatencyTracker(key, gate=self)
+            t.component = component
+            t.op = op
+        return t
+
+    def device_counter(self, component: str, op: str) -> ThroughputTracker:
+        key = f"{component}.{op}"
+        t = self.device_counters.get(key)
+        if t is None:
+            t = self.device_counters[key] = ThroughputTracker(key, gate=self)
+            t.component = component
+            t.subscriber = None
+            t.op = op
+        return t
+
+    def junction_device_stats(self, component: str) -> JunctionDeviceStats:
+        return JunctionDeviceStats(self, component)
+
+    # ---- reporting ---------------------------------------------------------
+
+    def report(self) -> dict:
+        # snapshot each registry dict with one atomic list() first: trackers
+        # are created lazily from dispatch threads (first subscriber failure,
+        # first store query, ...) while scrape/reporter threads read, and a
+        # Python-level comprehension over a mutating dict raises
+        mem = {}
+        for n, fn in list(self.memory.items()):
+            try:
+                mem[n] = int(fn())
+            except Exception:
+                mem[n] = -1
+        throughput = list(self.throughput.items())
+        latency = list(self.latency.items())
+        buffered = list(self.buffered.items())
+        errors = list(self.errors.items())
+        device_time = list(self.device_time.items())
+        device_counters = list(self.device_counters.items())
+        rep = {
+            "app": self.app_name,
+            "throughput": {n: t.count for n, t in throughput},
+            "rates": {
+                n: {"m1": round(t.rate_1m, 3), "m5": round(t.rate_5m, 3)}
+                for n, t in throughput
+            },
+            # back-compat key (pre-histogram shape) beside the summaries
+            "latency_avg_ms": {
+                n: round(t.avg_ms, 3) for n, t in latency
+            },
+            "latency_ms": {
+                n: t.summary_ms() for n, t in latency
+            },
+            "buffered": {n: t.get_size() for n, t in buffered},
+            "errors": {n: t.count for n, t in errors},
+            "errors_detail": {
+                n: {
+                    "component": t.component or n,
+                    "subscriber": t.subscriber,
+                    "count": t.count,
+                }
+                for n, t in errors
+            },
+            "memory_bytes": mem,
+            "device": {
+                "time_ms": {
+                    n: {
+                        "component": t.component,
+                        "op": t.op,
+                        "summary": t.summary_ms(),
+                    }
+                    for n, t in device_time
+                },
+                "counters": {
+                    n: {"component": t.component, "op": t.op, "count": t.count}
+                    for n, t in device_counters
+                },
+            },
+            "traces_sampled": (
+                self.tracer.sampled_count if self.tracer is not None else 0
+            ),
+        }
+        return rep
+
+    def prometheus_text(self) -> str:
+        from siddhi_tpu.observability.reporters import render_prometheus
+
+        return render_prometheus([self.report()])
+
+    def start_reporting(self) -> None:
+        if self._thread is not None:
+            return
+        from siddhi_tpu.observability.reporters import make_reporter
+
+        self._reporter_obj = make_reporter(
+            self.reporter, self.app_name, self.options
+        )
+        if self._reporter_obj is None:
+            return  # pull-based (prometheus) or disabled (none)
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.interval_s):
+                if self.enabled:
+                    try:
+                        self._reporter_obj.emit(self.report())
+                    except Exception:
+                        import logging
+
+                        logging.getLogger(__name__).exception(
+                            "stats reporter for app '%s' raised", self.app_name
+                        )
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop_reporting(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        self._thread = None
+        if self._reporter_obj is not None:
+            self._reporter_obj.close()
+            self._reporter_obj = None

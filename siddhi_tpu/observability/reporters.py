@@ -1,0 +1,207 @@
+"""Reporter SPI + exposition formats (console/log back-compat, JSON lines,
+Prometheus text format).
+
+Reference: util/statistics/metrics/SiddhiStatisticsManager.java:35-80 wires
+Dropwizard Console/JMX reporters behind `@app:statistics(reporter=...)`;
+here the SPI is a tiny `emit(report)` object so deployments can register
+their own (`register_reporter`). The Prometheus reporter is pull-based: it
+registers nothing periodic — `manager.serve_metrics(port)` serves the text
+exposition for every app on the manager (see http_server.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, Optional
+
+
+class Reporter:
+    """SPI: one `emit(report)` per interval; `close()` at shutdown."""
+
+    def emit(self, report: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class ConsoleReporter(Reporter):
+    def emit(self, report: dict) -> None:
+        print(f"[siddhi_tpu stats] {report}", flush=True)
+
+
+class LogReporter(Reporter):
+    def __init__(self, app_name: str) -> None:
+        self._log = logging.getLogger(f"siddhi_tpu.statistics.{app_name}")
+
+    def emit(self, report: dict) -> None:
+        self._log.info("%s", report)
+
+
+class JsonLinesReporter(Reporter):
+    """Appends one JSON object per interval to `file` (default
+    `<app>.metrics.jsonl` in the working directory)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def emit(self, report: dict) -> None:
+        self._fh.write(json.dumps(report, default=str) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except Exception:
+            pass
+
+
+# name -> factory(app_name, options) -> Reporter | None (None = pull-based /
+# disabled: no periodic thread is started)
+_REPORTERS: dict[str, Callable[[str, dict], Optional[Reporter]]] = {
+    "console": lambda app, opts: ConsoleReporter(),
+    "log": lambda app, opts: LogReporter(app),
+    "jsonl": lambda app, opts: JsonLinesReporter(
+        opts.get("file", f"{app}.metrics.jsonl")
+    ),
+    "none": lambda app, opts: None,
+    # pull-based: the app runtime asks the manager to serve /metrics instead
+    "prometheus": lambda app, opts: None,
+}
+
+
+def register_reporter(name: str, factory) -> None:
+    """Plug a custom reporter: factory(app_name, options) -> Reporter."""
+    _REPORTERS[name.lower()] = factory
+
+
+def make_reporter(name: str, app_name: str, options: dict) -> Optional[Reporter]:
+    factory = _REPORTERS.get(str(name).lower())
+    if factory is None:
+        logging.getLogger(__name__).warning(
+            "unknown @app:statistics reporter '%s'; metrics are collected "
+            "but not periodically reported (known: %s)",
+            name, sorted(_REPORTERS),
+        )
+        return None
+    return factory(app_name, options)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (format version 0.0.4)
+# ---------------------------------------------------------------------------
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels(**kv) -> str:
+    inner = ",".join(
+        f'{k}="{_esc(v)}"' for k, v in kv.items() if v is not None and v != ""
+    )
+    return "{" + inner + "}" if inner else ""
+
+
+_FAMILIES = {
+    "siddhi_events_total": ("counter", "Events published per component"),
+    "siddhi_event_rate": (
+        "gauge", "EWMA event rate in events/second (window label: 1m/5m)"),
+    "siddhi_latency_ms": (
+        "summary", "Processing latency quantiles per component (ms)"),
+    "siddhi_buffered_events": (
+        "gauge", "Queued depth of async ingress buffers"),
+    "siddhi_errors_total": (
+        "counter",
+        "Failed dispatches/publishes per component "
+        "(subscriber label: per-subscriber attribution)"),
+    "siddhi_memory_bytes": (
+        "gauge", "Device buffer bytes held by each component's carried state"),
+    "siddhi_device_time_ms": (
+        "summary",
+        "Device-time budget per component (op label: step/fused_step/"
+        "sync_stall) in ms"),
+    "siddhi_h2d_bytes_total": (
+        "counter", "Host-to-device wire bytes shipped per junction"),
+    "siddhi_h2d_chunks_total": (
+        "counter", "Host-to-device transfer chunks per junction"),
+    "siddhi_traces_sampled_total": ("counter", "Traces sampled per app"),
+}
+
+
+def _summary_lines(out, family, app, component, summ, **extra) -> None:
+    for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"),
+                   ("0.999", "p999")):
+        out.append(
+            f"{family}{_labels(app=app, component=component, quantile=q, **extra)}"
+            f" {summ[key]}"
+        )
+    out.append(
+        f"{family}_sum{_labels(app=app, component=component, **extra)} {summ['sum']}"
+    )
+    out.append(
+        f"{family}_count{_labels(app=app, component=component, **extra)} {summ['count']}"
+    )
+
+
+def render_prometheus(reports: list[dict]) -> str:
+    """Render the Prometheus text exposition for a list of `report()` dicts
+    (one per app). Families are emitted once each with HELP/TYPE headers."""
+    body: dict[str, list[str]] = {f: [] for f in _FAMILIES}
+    for rep in reports:
+        app = rep.get("app", "")
+        for n, v in rep.get("throughput", {}).items():
+            body["siddhi_events_total"].append(
+                f"siddhi_events_total{_labels(app=app, component=n)} {v}"
+            )
+        for n, r in rep.get("rates", {}).items():
+            for window, key in (("1m", "m1"), ("5m", "m5")):
+                body["siddhi_event_rate"].append(
+                    f"siddhi_event_rate{_labels(app=app, component=n, window=window)}"
+                    f" {r[key]}"
+                )
+        for n, summ in rep.get("latency_ms", {}).items():
+            _summary_lines(body["siddhi_latency_ms"], "siddhi_latency_ms",
+                           app, n, summ)
+        for n, v in rep.get("buffered", {}).items():
+            body["siddhi_buffered_events"].append(
+                f"siddhi_buffered_events{_labels(app=app, component=n)} {v}"
+            )
+        for n, ent in rep.get("errors_detail", {}).items():
+            body["siddhi_errors_total"].append(
+                "siddhi_errors_total"
+                f"{_labels(app=app, component=ent['component'], subscriber=ent.get('subscriber'))}"
+                f" {ent['count']}"
+            )
+        for n, v in rep.get("memory_bytes", {}).items():
+            body["siddhi_memory_bytes"].append(
+                f"siddhi_memory_bytes{_labels(app=app, component=n)} {v}"
+            )
+        dev = rep.get("device", {})
+        for n, ent in dev.get("time_ms", {}).items():
+            _summary_lines(
+                body["siddhi_device_time_ms"], "siddhi_device_time_ms",
+                app, ent["component"], ent["summary"], op=ent["op"],
+            )
+        for n, ent in dev.get("counters", {}).items():
+            fam = f"siddhi_{ent['op']}_total"
+            if fam in body:
+                body[fam].append(
+                    f"{fam}{_labels(app=app, component=ent['component'])}"
+                    f" {ent['count']}"
+                )
+        body["siddhi_traces_sampled_total"].append(
+            "siddhi_traces_sampled_total"
+            f"{_labels(app=app)} {rep.get('traces_sampled', 0)}"
+        )
+    out: list[str] = []
+    for family, lines in body.items():
+        if not lines:
+            continue
+        ftype, help_ = _FAMILIES[family]
+        out.append(f"# HELP {family} {help_}")
+        out.append(f"# TYPE {family} {ftype}")
+        out.extend(lines)
+    return "\n".join(out) + "\n"

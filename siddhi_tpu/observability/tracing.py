@@ -1,0 +1,133 @@
+"""Sampled event tracing: spans across junction -> query -> sink dispatch.
+
+A trace is rooted at an ingress dispatch (the first junction publish on a
+thread with no active trace) and carries through every synchronous hop the
+event chunk makes — downstream junction publishes, query steps, and sink
+callbacks each record a child span. The sampling decision is made ONCE at
+the root with a seeded RNG (`trace.sample` probability, `trace.seed` for
+deterministic runs); an unsampled root parks a sentinel on the thread so
+every nested span call is a single attribute check. Completed traces land
+in a bounded ring readable at runtime (`runtime.traces()`).
+
+Async ingress severs the sender's thread context by design; traces for
+`@async` streams root at the drain worker's junction dispatch instead —
+the device-side path is identical.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+import threading
+import time
+from collections import deque
+
+# span layout: [component, depth, n_events, t0_ns, t1_ns]
+_SKIP = object()  # token for spans inside an unsampled trace
+
+
+class _Trace:
+    __slots__ = ("trace_id", "wall_ms", "t0_ns", "spans", "open")
+
+    def __init__(self, trace_id: int) -> None:
+        self.trace_id = trace_id
+        self.wall_ms = int(time.time() * 1000)
+        self.t0_ns = time.perf_counter_ns()
+        self.spans: list[list] = []
+        self.open: list[list] = []
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "wall_ms": self.wall_ms,
+            "spans": [
+                {
+                    "component": s[0],
+                    "depth": s[1],
+                    "events": s[2],
+                    "start_us": round((s[3] - self.t0_ns) / 1e3, 1),
+                    "duration_us": round((s[4] - s[3]) / 1e3, 1),
+                }
+                for s in self.spans
+            ],
+        }
+
+
+class Tracer:
+    """Per-app tracer: sampling decision + span stack + bounded trace ring."""
+
+    def __init__(
+        self,
+        sample: float,
+        capacity: int = 256,
+        seed: int | None = None,
+    ) -> None:
+        if not 0.0 <= sample <= 1.0:
+            raise ValueError("trace.sample must be in [0, 1]")
+        self.sample = float(sample)
+        self.capacity = int(capacity)
+        self._rng = random.Random(seed)
+        self._ring: deque[_Trace] = deque(maxlen=self.capacity)
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self.sampled_count = 0
+        self.enabled = True
+
+    # ---- span recording (hot path) ---------------------------------------
+
+    def start_span(self, component: str, n_events: int = -1):
+        """Open a span; returns a token for `end_span`. On a thread with no
+        active trace this IS the root: the sampling decision happens here."""
+        tls = self._tls
+        cur = getattr(tls, "cur", None)
+        if cur is None:
+            if not self.enabled or (
+                self.sample < 1.0 and self._rng.random() >= self.sample
+            ):
+                tls.cur = _SKIP
+                tls.skip_depth = 1
+                return _SKIP
+            cur = tls.cur = _Trace(next(self._ids))
+            with self._lock:
+                self.sampled_count += 1
+        elif cur is _SKIP:
+            tls.skip_depth += 1
+            return _SKIP
+        span = [component, len(cur.open), n_events, time.perf_counter_ns(), 0]
+        cur.spans.append(span)
+        cur.open.append(span)
+        return span
+
+    def end_span(self, token) -> None:
+        tls = self._tls
+        if token is _SKIP:
+            tls.skip_depth -= 1
+            if tls.skip_depth <= 0:
+                tls.cur = None
+            return
+        token[4] = time.perf_counter_ns()
+        cur = getattr(tls, "cur", None)
+        if cur is None or cur is _SKIP:
+            return  # unbalanced end (shutdown race): drop silently
+        if cur.open and cur.open[-1] is token:
+            cur.open.pop()
+        if not cur.open:  # root closed: commit the trace
+            tls.cur = None
+            with self._lock:
+                self._ring.append(cur)
+
+    # ---- reading ----------------------------------------------------------
+
+    def traces(self) -> list[dict]:
+        """Completed traces, oldest first (bounded by `trace.capacity`)."""
+        with self._lock:
+            return [t.to_dict() for t in self._ring]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def to_json(self, indent: int | None = None) -> str:
+        return json.dumps(self.traces(), indent=indent)

@@ -1,0 +1,93 @@
+"""CI smoke test for the Prometheus exposition path (tier1.yml).
+
+Boots a small app with `@app:statistics(reporter='prometheus')` (which makes
+the manager serve `/metrics`), drives a little traffic, scrapes the endpoint
+with curl (urllib fallback), and asserts the exposition is non-empty and
+well-formed: every sample line parses, every family is typed, and the
+acceptance families (throughput, latency quantiles, buffered depth, device
+budget) are present. Exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+]?[0-9.eE+-]+$"
+)
+
+REQUIRED_FAMILIES = (
+    "siddhi_events_total",
+    "siddhi_latency_ms",
+    "siddhi_buffered_events",
+    "siddhi_device_time_ms",
+    "siddhi_traces_sampled_total",
+)
+
+
+def scrape(url: str) -> str:
+    try:
+        out = subprocess.run(
+            ["curl", "-sf", url], capture_output=True, text=True, timeout=10
+        )
+        if out.returncode == 0 and out.stdout:
+            return out.stdout
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        pass
+    import urllib.request
+
+    return urllib.request.urlopen(url, timeout=10).read().decode()
+
+
+def main() -> int:
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+    @app:statistics(reporter='prometheus', port='0', trace.sample='1.0')
+    define stream S (symbol string, price float);
+    @info(name='q')
+    from S[price > 10]#window.length(8)
+    select symbol, avg(price) as ap insert into Out;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(32):
+        h.send(("A", float(i)))
+    port = mgr.metrics_port
+    assert port, "reporter='prometheus' must start the metrics endpoint"
+    text = scrape(f"http://127.0.0.1:{port}/metrics")
+    assert text.strip(), "empty exposition"
+
+    typed: set = set()
+    samples = 0
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        if line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), f"malformed line: {line!r}"
+        name = re.split(r"[{ ]", line, 1)[0]
+        base = re.sub(r"_(sum|count)$", "", name)
+        assert base in typed or name in typed, f"untyped family: {name}"
+        samples += 1
+    missing = [f for f in REQUIRED_FAMILIES if f not in typed]
+    assert not missing, f"missing families: {missing}"
+    for q in ('quantile="0.5"', 'quantile="0.95"', 'quantile="0.99"'):
+        assert q in text, f"missing latency {q}"
+    assert rt.traces(), "trace.sample='1.0' must produce sampled traces"
+    mgr.shutdown()
+    print(f"metrics smoke OK: {samples} samples, {len(typed)} families")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
