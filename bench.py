@@ -16,7 +16,9 @@ Methodology (round 3 — honest completion-rate timing):
 - `timebudget` (in detail) publishes a PER-LEG budget of the fused-ingest
   program itself: wire bytes/event, host encode rate, effective per-chunk
   h2d cost, device rate, the predicted bound, and the leg's binding wall —
-  plus the shared sync floor (the p99 denominator) and bulk h2d bandwidth.
+  plus the shared sync floor (the p99 denominator), bulk h2d bandwidth,
+  and a pipelined-vs-serial A/B of the real engine send path
+  (`*_overlap_meas` vs `*_overlap_pred`, see core/pipeline.py).
 
 The baseline denominator is the reference's published production throughput
 claim — 20B events/day ~= 300k events/s on a JVM cluster
@@ -475,6 +477,32 @@ def _leg_timebudget(batch=32768) -> dict:
         out[f"{name}_floor_mev_s"] = round(
             ev / (t_encode + t_h2d + t_dev) / 1e6, 2)
         out[f"{name}_wall"] = max(walls, key=walls.get)
+        # pipelined-vs-serial A/B through the REAL engine send path: the
+        # same four-chunk send, once fully serialized and once with the
+        # chunk pipeline (core/pipeline.py), so the measured overlap can be
+        # compared against the budget's predicted interval — overlap_pred =
+        # serial-sum / slowest-stage is the ceiling a perfect pipeline
+        # could reach, overlap_meas = t_serial / t_pipelined is what the
+        # engine actually got (four chunks: the first chunk has nothing to
+        # overlap with, so a two-chunk send under-reports the steady state).
+        data2 = _make_stock_data(bsz * K * 4)
+        cols2 = {k: v for k, v in data2.items() if k not in ("ts", "names")}
+        h = rt.get_input_handler(stream)
+        ab = {}
+        for mode, pipe_on in (("serial", False), ("pipe", True)):
+            fi.pipeline_enabled = pipe_on
+            h.send_columns(data2["ts"], cols2)  # warm this mode's path
+            _truth_sync(rt)
+            t0 = time.perf_counter()
+            h.send_columns(data2["ts"], cols2)
+            _truth_sync(rt)
+            ab[mode] = time.perf_counter() - t0
+        ev2 = bsz * K * 4
+        out[f"{name}_serial_mev_s"] = round(ev2 / ab["serial"] / 1e6, 2)
+        out[f"{name}_pipe_mev_s"] = round(ev2 / ab["pipe"] / 1e6, 2)
+        out[f"{name}_overlap_meas"] = round(ab["serial"] / ab["pipe"], 2)
+        out[f"{name}_overlap_pred"] = round(
+            (t_encode + t_h2d + t_dev) / max(walls.values()), 2)
         rt.shutdown()
         mgr.shutdown()
     return out
@@ -539,9 +567,20 @@ VERIFY_TABLE_CASES = {
 
 
 def _leg_verify() -> dict:
-    """Run every verify case on the CURRENT backend and return its rows."""
+    """Run every verify case on the CURRENT backend and return its rows.
+
+    With SIDDHI_TPU_VERIFY_COLUMNAR=1 the same events are ingested
+    COLUMNARLY (one send_columns call, symbols pre-interned) so the fused
+    path actually engages — the CI parity step runs the leg twice in this
+    mode, SIDDHI_TPU_PIPELINE=1 vs =0, and diffs the rows; holding the
+    ingestion mode fixed isolates the pipeline (row-by-row vs columnar
+    feeds legitimately batch differently), and a per-row feed would never
+    reach try_send at all."""
     from siddhi_tpu import SiddhiManager
 
+    columnar = os.environ.get("SIDDHI_TPU_VERIFY_COLUMNAR", "").lower() in (
+        "1", "on", "true",
+    )
     rng = np.random.default_rng(99)
     n = 96
     ts = np.arange(n, dtype=np.int64) * 7 + 1_700_000_000_000
@@ -553,6 +592,21 @@ def _leg_verify() -> dict:
         )
         for _ in range(n)
     ]
+
+    def feed(mgr, h):
+        if columnar:
+            cols = {
+                "symbol": np.array(
+                    [mgr.interner.intern(r[0]) for r in rows], np.int32
+                ),
+                "price": np.array([r[1] for r in rows], np.float32),
+                "volume": np.array([r[2] for r in rows], np.int64),
+            }
+            h.send_columns(ts, cols, now=int(ts[-1]))
+        else:
+            for i, r in enumerate(rows):
+                h.send(r, timestamp=int(ts[i]))
+
     out: dict = {}
     for name, ql in VERIFY_CASES.items():
         try:
@@ -566,9 +620,7 @@ def _leg_verify() -> dict:
                 )
             )
             rt.start()
-            h = rt.get_input_handler("S")
-            for i, r in enumerate(rows):
-                h.send(r, timestamp=int(ts[i]))
+            feed(mgr, rt.get_input_handler("S"))
             rt.shutdown()
             mgr.shutdown()
             out[name] = got
@@ -579,9 +631,7 @@ def _leg_verify() -> dict:
             mgr = SiddhiManager()
             rt = mgr.create_siddhi_app_runtime(ql)
             rt.start()
-            h = rt.get_input_handler("S")
-            for i, r in enumerate(rows):
-                h.send(r, timestamp=int(ts[i]))
+            feed(mgr, rt.get_input_handler("S"))
             out[name] = sorted(
                 tuple(e.data) for e in rt.query(sq)
             )
@@ -686,9 +736,12 @@ def main():
     ap.add_argument("--leg", help="run ONE leg in-process and print its JSON")
     ap.add_argument(
         "--deadline", type=float,
-        default=float(os.environ.get("SIDDHI_BENCH_DEADLINE_S", "0") or 0),
-        help="overall wall-clock budget in seconds (0 = none); legs that "
-        "would not fit are skipped so the final JSON line always prints",
+        default=float(os.environ.get("SIDDHI_BENCH_DEADLINE_S", "") or 2700),
+        help="overall wall-clock budget in seconds (default 2700 — safely "
+        "under the harness's outer timeout, so the final JSON line lands "
+        "before any `timeout -k` kills the driver; BENCH_r05 recorded "
+        "rc=124 with no JSON at all. Pass 0 to opt out; legs that would "
+        "not fit are skipped so the final JSON line always prints",
     )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()

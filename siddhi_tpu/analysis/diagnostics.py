@@ -33,6 +33,7 @@ CODES: dict[str, str] = {
     "SA109": "duplicate attribute name in a definition",
     "SA110": "invalid @OnError action",
     "SA111": "reserved attribute name",
+    "SA112": "invalid @pipeline annotation (unknown key / bad depth / bad disable)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

@@ -72,6 +72,22 @@ def _attrs_schema(definition, diags: list[Diagnostic], what: str) -> Schema:
     return schema
 
 
+def _check_pipeline_annotation(
+    sid: str, d, ann, diags: list[Diagnostic]
+) -> None:
+    """Validate `@pipeline(depth='N', disable='true|false')` — the fused
+    ingest pipeline's stream-level config. One SA112 per malformed element,
+    using the SAME rule set the runtime resolver enforces
+    (core/pipeline.py iter_pipeline_annotation_problems)."""
+    from siddhi_tpu.core.pipeline import iter_pipeline_annotation_problems
+
+    line, col = getattr(d, "line", None), getattr(d, "col", None)
+    for problem in iter_pipeline_annotation_problems(ann):
+        diags.append(Diagnostic(
+            "SA112", f"stream '{sid}': {problem}", line, col,
+        ))
+
+
 def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
     sym = SymbolTable()
 
@@ -81,6 +97,9 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
             sym.sourced.add(sid)
         if find_annotation(d.annotations, "sink") is not None:
             sym.sinked.add(sid)
+        pa = find_annotation(d.annotations, "pipeline")
+        if pa is not None:
+            _check_pipeline_annotation(sid, d, pa, diags)
         oe = find_annotation(d.annotations, "OnError")
         if oe is None:
             continue

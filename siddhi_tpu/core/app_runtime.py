@@ -170,10 +170,23 @@ class SiddhiAppRuntime:
                     + [("_error", _AttrType.STRING)],
                 )
 
+        # @pipeline(depth='N', disable='true') — per-stream config of the
+        # double-buffered fused-ingest pipeline (core/pipeline.py); resolved
+        # here (with the SIDDHI_TPU_PIPELINE env override) and applied when
+        # start() builds the junction's FusedJunctionIngest
+        from siddhi_tpu.core.pipeline import resolve_pipeline_annotation
+
+        self._pipeline_conf: dict[str, tuple[bool, int]] = {}
         for sid, d in app.stream_definitions.items():
             self.stream_schemas[sid] = StreamSchema(
                 sid, [(a.name, a.type) for a in d.attributes]
             )
+            try:
+                self._pipeline_conf[sid] = resolve_pipeline_annotation(
+                    find_annotation(d.annotations, "pipeline")
+                )
+            except SiddhiAppCreationError as e:
+                raise SiddhiAppCreationError(f"stream '{sid}': {e}") from e
             # @async(buffer.size, workers, batch.size.max) — buffered ingress
             # ring with worker batching (reference: StreamJunction.java:87-117)
             a = find_annotation(d.annotations, "async")
@@ -200,6 +213,8 @@ class SiddhiAppRuntime:
                 )
                 # live device budget for this junction's fused dispatch path
                 j.device_stats = sm.junction_device_stats(f"stream.{sid}")
+                # pipelined-ingest stage budget + occupancy overlap gauge
+                j.pipeline_stats = sm.pipeline_stats(f"stream.{sid}")
 
         for sid, action in self.on_error_actions.items():
             j = self._junction(sid)
@@ -1049,12 +1064,17 @@ class SiddhiAppRuntime:
         # build per-junction fused ingest engines (core/ingest.py) for
         # junctions where every subscriber registered a FuseEndpoint
         from siddhi_tpu.core.ingest import FusedJunctionIngest
+        from siddhi_tpu.core.pipeline import resolve_pipeline_annotation
 
         chunk = self._capacity_annotation("app:ingestChunk", 32)
         for j in self.junctions.values():
             if j.fuse_candidates and len(j.fuse_candidates) == len(j.subscribers):
+                pipe_on, pipe_depth = self._pipeline_conf.get(
+                    j.schema.stream_id, resolve_pipeline_annotation(None)
+                )
                 j.fused_ingest = FusedJunctionIngest(
-                    self, j, j.fuse_candidates, chunk_batches=chunk
+                    self, j, j.fuse_candidates, chunk_batches=chunk,
+                    pipeline_enabled=pipe_on, pipeline_depth=pipe_depth,
                 )
         if self.statistics_manager is not None:
             # device-memory metric per component (reference analog:
@@ -1127,6 +1147,8 @@ class SiddhiAppRuntime:
         for j in self.junctions.values():
             if j.is_async:
                 j.stop_async()
+            if j.fused_ingest is not None:
+                j.fused_ingest.close()  # stops the pipeline drain worker
         for sink in self.sinks:
             sink.stop()
         if self.statistics_manager is not None:

@@ -1,0 +1,363 @@
+"""Double-buffered ingest pipeline: overlap encode, h2d, dispatch, drain.
+
+The fused ingest path (core/ingest.py) runs three host-visible stages per
+chunk — host encode, host->device transfer, jitted dispatch — plus, in
+deliver mode, a blocking d2h readback + decode + callback delivery. Run
+strictly serialized, the sender's wall-clock per chunk is
+`encode + h2d + device + d2h` even though the stages use disjoint resources
+(Python/numpy on the host, the wire, the device, and the readback path).
+
+This module keeps those stages concurrently busy (the Hazelcast Jet
+"pipeline stages must stay busy" argument, PAPERS.md):
+
+1. host encode writes into one of `depth` POOLED wire buffers, so chunk
+   N+1's encode can start while chunk N's buffer is still being shipped
+   (a slot is reused only after its transfer completed);
+2. chunk N+1 is encoded and `jax.device_put` while chunk N's donated-state
+   dispatch is still in flight — JAX dispatch is already async, so the win
+   is moving encode (and the transfer submit) off the dispatch critical
+   path;
+3. a bounded background drain worker syncs each chunk's packed output
+   buffer, decodes it, and runs query-callback delivery in chunk order,
+   with backpressure (at most `depth` undrained chunks in flight) so state
+   donation stays safe and device memory for packed outputs is bounded.
+
+Ordering and failure semantics are preserved exactly:
+
+* `try_send` still BARRIERS on the drain before returning, so callbacks
+  fire in chunk order and complete before `send_columns` returns — any
+  later per-batch `send` observes the same ordering as the serial path;
+* a delivery failure on the drain worker goes through the junction's
+  existing failure machinery (`_on_worker_error`: log + error stats +
+  exception handler), mirroring the @async drain workers; when the
+  junction has NO handler and NO @OnError policy the error is re-raised
+  to the sender at the barrier, like the serial path's in-line drain.
+
+On backends where a device->host read from a non-main thread permanently
+degrades dispatch (tunneled PJRT relays — see
+utils/backend.transfer_degrades_dispatch), the drain worker is not used:
+drains run on the caller's thread one chunk late, which still overlaps the
+decode with the next chunk's device compute.
+
+Configuration: the `@pipeline(depth='N', disable='true')` stream
+annotation, overridden process-wide by SIDDHI_TPU_PIPELINE=1 (force on) /
+SIDDHI_TPU_PIPELINE=0 (force off).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Optional
+
+import numpy as np
+
+DEFAULT_DEPTH = 2
+_MAX_DEPTH = 8
+
+PIPELINE_ENV = "SIDDHI_TPU_PIPELINE"
+
+_TRUE = ("1", "on", "true", "force")
+_FALSE = ("0", "off", "false")
+
+
+def pipeline_env_override() -> Optional[bool]:
+    """Process-wide pipeline toggle: True (forced on), False (forced off),
+    or None (defer to the stream's @pipeline annotation)."""
+    v = os.environ.get(PIPELINE_ENV, "").strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    return None
+
+
+def iter_pipeline_annotation_problems(ann):
+    """Yield one message per malformed `@pipeline` element — THE validation
+    rules, shared by the runtime resolver (raises on the first) and the
+    analyzer's SA112 diagnostics (reports them all), so the two can never
+    drift."""
+    for k, v in ann.elements:
+        if k == "depth":
+            try:
+                ok = 1 <= int(v) <= _MAX_DEPTH
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                yield (
+                    f"@pipeline depth '{v}' must be an integer in "
+                    f"1..{_MAX_DEPTH}"
+                )
+        elif k == "disable":
+            if str(v).strip().lower() not in ("true", "false"):
+                yield f"@pipeline disable '{v}' must be true or false"
+        else:
+            yield (
+                f"unknown @pipeline option '{k if k is not None else v}' "
+                "(expected depth, disable)"
+            )
+
+
+def resolve_pipeline_annotation(ann) -> tuple[bool, int]:
+    """(enabled, depth) for one stream from its `@pipeline` annotation (or
+    None) plus the SIDDHI_TPU_PIPELINE env override. Raises
+    SiddhiAppCreationError on malformed options — the runtime analog of the
+    analyzer's SA112 diagnostic."""
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    enabled = True
+    depth = DEFAULT_DEPTH
+    if ann is not None:
+        for problem in iter_pipeline_annotation_problems(ann):
+            raise SiddhiAppCreationError(problem)
+        depth = int(ann.element("depth", str(DEFAULT_DEPTH)))
+        enabled = (
+            str(ann.element("disable", "false")).strip().lower() != "true"
+        )
+    env = pipeline_env_override()
+    if env is not None:
+        enabled = env
+    return enabled, depth
+
+
+class _WireSlot:
+    """One pooled host wire buffer + the device array gating its reuse.
+
+    `jax.device_put` of a numpy array may ALIAS the host buffer instead of
+    copying (the CPU backend does, depending on the buffer's size and
+    alignment — so it cannot be probed once globally). ship() detects it
+    per shipment by comparing buffer POINTERS (no device->host transfer,
+    which would flip tunneled relays out of their fast mode):
+
+    * copied: `ref` is the shipped device array — reuse is safe once the
+      TRANSFER completed;
+    * aliased (or unknown): retire() swaps `ref` for a completion array of
+      the dispatch that READ the wire — only the program finishing frees
+      the buffer for overwrite."""
+
+    __slots__ = ("buf", "ref", "aliased")
+
+    def __init__(self, shape):
+        self.buf = np.zeros(shape, dtype=np.uint8)
+        self.ref = None
+        self.aliased = True
+
+
+class IngestPipeline:
+    """Per-junction pipeline engine owned by a FusedJunctionIngest.
+
+    Senders are serialized by the ingest's send lock, so acquire/ship run
+    from one thread at a time; the drain worker is the only other thread
+    touching this object (via the queue/condvar only).
+    """
+
+    def __init__(self, junction, depth: int = DEFAULT_DEPTH, drain_fn=None):
+        self.junction = junction
+        self.depth = max(1, int(depth))
+        self.drain_fn = drain_fn  # fn(packs, K): the ingest's _drain
+        self.stats = None  # PipelineStats | None, set by the owner
+        self._pool: dict[tuple, dict] = {}  # (K, nb) -> {slots, next}
+        self._cv = threading.Condition()
+        self._inflight = 0  # submitted, not yet drained (thread mode)
+        self._error: Optional[BaseException] = None
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._pending_inline = None  # (packs, K) in inline-drain mode
+        self._closed = False
+        self._use_thread: Optional[bool] = None
+
+    # ---- wire buffer pool ------------------------------------------------
+
+    def acquire(self, K: int, wire_bytes: int) -> _WireSlot:
+        """A host buffer for one [K, wire_bytes] chunk, safe to overwrite:
+        pooled, blocking on the slot's reuse gate (see _WireSlot)."""
+        key = (int(K), int(wire_bytes))
+        ent = self._pool.get(key)
+        if ent is None:
+            ent = self._pool[key] = {
+                "slots": [
+                    _WireSlot(key) for _ in range(max(2, self.depth))
+                ],
+                "next": 0,
+            }
+        slots = ent["slots"]
+        slot = slots[ent["next"]]
+        ent["next"] = (ent["next"] + 1) % len(slots)
+        if slot.ref is not None:
+            try:
+                slot.ref.block_until_ready()
+            except Exception:
+                # failed execution: the gating work is no longer running,
+                # so the buffer is free (gate arrays are never donated —
+                # see _dispatch_chunk's completion contract — so deletion
+                # cannot race this wait)
+                pass
+            slot.ref = None
+        return slot
+
+    def ship(self, slot: _WireSlot):
+        """Start the async host->device transfer of the slot's buffer and
+        return the device array; detects per shipment whether the backend
+        aliased the host buffer (see _WireSlot) and gates the slot
+        accordingly."""
+        import jax
+
+        dev = jax.device_put(slot.buf)
+        try:
+            slot.aliased = (
+                dev.unsafe_buffer_pointer() == slot.buf.ctypes.data
+            )
+        except Exception:
+            slot.aliased = True  # can't tell: assume the worst
+        slot.ref = dev
+        return dev
+
+    def retire(self, slot: _WireSlot, completion) -> None:
+        """For an ALIASED shipment, swap the slot's reuse gate for an
+        output array of the dispatch that consumed the wire (acquire()
+        then waits for the program, not the no-op transfer). With no
+        non-donated completion available (None: the dispatch failed at
+        submit, or its only outputs are donated query states) there is
+        nothing safe to gate on — the aliased buffer is ABANDONED to the
+        shipped array's reference and the slot gets a virgin buffer, so a
+        still-running program can never see the next chunk's bytes. No-op
+        for copied shipments: ship()'s transfer gate suffices."""
+        if not slot.aliased:
+            return
+        if completion is not None:
+            slot.ref = completion
+        else:
+            slot.buf = np.zeros_like(slot.buf)
+            slot.ref = None
+
+    # ---- drain -----------------------------------------------------------
+
+    def is_drain_thread(self) -> bool:
+        return (
+            self._thread is not None
+            and threading.current_thread() is self._thread
+        )
+
+    def _thread_ok(self) -> bool:
+        if self._use_thread is None:
+            from siddhi_tpu.utils.backend import transfer_degrades_dispatch
+
+            # a non-main-thread d2h read permanently degrades dispatch on
+            # tunneled relays: drain inline (one chunk late) there instead
+            self._use_thread = not transfer_degrades_dispatch()
+        return self._use_thread
+
+    def submit(self, packs, K: int) -> None:
+        """Queue one chunk's packed outputs for ordered delivery. Blocks
+        while `depth` chunks are already in flight (backpressure)."""
+        if self._thread_ok():
+            if self._thread is None:
+                self._start_thread()
+            with self._cv:
+                while self._inflight >= self.depth and not self._closed:
+                    self._cv.wait()
+                self._inflight += 1
+            self._q.put((packs, K))
+        else:
+            prev = self._pending_inline
+            self._pending_inline = (packs, K)
+            if prev is not None:
+                self._drain_inline(*prev)
+
+    def pending_error(self) -> bool:
+        """True once an unguarded drain failure is stashed for barrier():
+        the sender polls this per chunk and stops ingesting, bounding the
+        extra chunks committed past a poisoned delivery to the pipeline
+        depth (the serial path's drain-one-late commits one extra)."""
+        with self._cv:
+            return self._error is not None
+
+    def barrier(self) -> None:
+        """Wait until every submitted chunk has been delivered; re-raise a
+        drain failure here when the junction has no handler/policy to own it
+        (the pipelined analog of the serial path's in-line drain raising)."""
+        if self._pending_inline is not None:
+            prev, self._pending_inline = self._pending_inline, None
+            self._drain_inline(*prev)
+        if self._thread is not None:
+            with self._cv:
+                while self._inflight > 0:
+                    self._cv.wait()
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def _start_thread(self) -> None:
+        self._q = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._drain_loop,
+            daemon=True,
+            name=f"siddhi-pipeline-{self.junction.schema.stream_id}",
+        )
+        self._thread.start()
+
+    def _drain_loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            packs, K = item
+            try:
+                self._drain_one(packs, K)
+            except Exception as exc:  # must not kill the worker
+                self._on_drain_error(exc)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _drain_one(self, packs, K: int) -> None:
+        import time
+
+        ps = self.stats
+        t0 = time.perf_counter_ns() if ps is not None else 0
+        try:
+            self.drain_fn(packs, K)
+        finally:
+            if t0:
+                ps.drain.record_ns(time.perf_counter_ns() - t0)
+
+    def _route_drain_error(self, exc: Exception) -> bool:
+        """True when the junction's failure machinery owned the error —
+        same machinery as the @async drain workers (log + error stats +
+        exception handler); unguarded junctions get False and the failure
+        goes back to the sender."""
+        j = self.junction
+        if j.exception_handler is not None or j.fault_policy is not None:
+            j._on_worker_error(exc, "pipeline drain")
+            return True
+        return False
+
+    def _drain_inline(self, packs, K: int) -> None:
+        """Caller-thread drain (degraded-transfer backends) with the same
+        error contract as the worker: guarded junctions route, unguarded
+        ones re-raise to the sender."""
+        try:
+            self._drain_one(packs, K)
+        except Exception as exc:
+            if not self._route_drain_error(exc):
+                raise
+
+    def _on_drain_error(self, exc: Exception) -> None:
+        if self._route_drain_error(exc):
+            return
+        with self._cv:
+            if self._error is None:
+                self._error = exc  # surfaces to the sender at barrier()
+
+    def close(self) -> None:
+        """Flush nothing (callers barrier first); stop the drain worker."""
+        self._closed = True
+        with self._cv:
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            self._q.put(None)
+            t.join(timeout=2.0)
+        self._thread = None

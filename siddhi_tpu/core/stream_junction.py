@@ -56,6 +56,9 @@ class StreamJunction:
         # device-budget trackers (JunctionDeviceStats) used by the fused
         # ingest path: step dispatch time, h2d bytes/chunks, sync stalls
         self.device_stats = None
+        # pipelined-ingest stage budget (PipelineStats): encode/h2d/dispatch/
+        # drain histograms + the pipeline.occupancy overlap gauge
+        self.pipeline_stats = None
         # user hook for subscriber failures (reference: the pluggable
         # Disruptor ExceptionHandler, SiddhiAppRuntime.java:664)
         self.exception_handler: Callable[[Exception], None] | None = None

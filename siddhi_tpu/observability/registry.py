@@ -39,6 +39,52 @@ class JunctionDeviceStats:
         self.sync_stall = registry.device_time_tracker(component, "sync_stall")
 
 
+class PipelineStats:
+    """Per-stage budget of one junction's pipelined fused ingest
+    (core/pipeline.py): encode / h2d / dispatch / drain histograms plus the
+    measured overlap ratio `pipeline.occupancy` — summed stage busy time
+    over send wall time, so 1.0 means fully serial stages and values above
+    1.0 mean the pipeline genuinely overlapped them (upper bound: the
+    number of concurrently busy stages)."""
+
+    __slots__ = (
+        "encode", "h2d", "dispatch", "drain", "depth", "_wall_ns", "_lock",
+        "_gate",
+    )
+
+    def __init__(self, registry: "StatisticsManager", component: str) -> None:
+        self.encode = registry.device_time_tracker(component, "pipeline.encode")
+        self.h2d = registry.device_time_tracker(component, "pipeline.h2d")
+        self.dispatch = registry.device_time_tracker(
+            component, "pipeline.dispatch"
+        )
+        self.drain = registry.device_time_tracker(component, "pipeline.drain")
+        self.depth = 0  # configured max in-flight chunks (0 = pipeline off)
+        self._wall_ns = 0
+        self._lock = threading.Lock()
+        self._gate = registry
+
+    def add_wall(self, ns: int) -> None:
+        """Accumulate one pipelined send's wall-clock (the occupancy
+        denominator)."""
+        if not self._gate.enabled:
+            return
+        with self._lock:
+            self._wall_ns += int(ns)
+
+    def occupancy(self) -> float:
+        wall = self._wall_ns
+        if wall <= 0:
+            return 0.0
+        busy = (
+            self.encode.total_ns
+            + self.h2d.total_ns
+            + self.dispatch.total_ns
+            + self.drain.total_ns
+        )
+        return busy / wall
+
+
 class StatisticsManager:
     """Registry of trackers + reporter thread (one per app runtime)."""
 
@@ -69,6 +115,9 @@ class StatisticsManager:
         # device-time budget: `<component>.<op>` -> histogram / counter
         self.device_time: dict[str, LatencyTracker] = {}
         self.device_counters: dict[str, ThroughputTracker] = {}
+        # pipelined fused ingest: component -> PipelineStats (stage
+        # histograms ride device_time; occupancy/depth are gauges here)
+        self.pipeline: dict[str, PipelineStats] = {}
         self.enabled = True
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -128,6 +177,12 @@ class StatisticsManager:
     def junction_device_stats(self, component: str) -> JunctionDeviceStats:
         return JunctionDeviceStats(self, component)
 
+    def pipeline_stats(self, component: str) -> PipelineStats:
+        p = self.pipeline.get(component)
+        if p is None:
+            p = self.pipeline[component] = PipelineStats(self, component)
+        return p
+
     # ---- reporting ---------------------------------------------------------
 
     def report(self) -> dict:
@@ -147,6 +202,7 @@ class StatisticsManager:
         errors = list(self.errors.items())
         device_time = list(self.device_time.items())
         device_counters = list(self.device_counters.items())
+        pipeline = list(self.pipeline.items())
         rep = {
             "app": self.app_name,
             "throughput": {n: t.count for n, t in throughput},
@@ -185,6 +241,10 @@ class StatisticsManager:
                     n: {"component": t.component, "op": t.op, "count": t.count}
                     for n, t in device_counters
                 },
+            },
+            "pipeline": {
+                n: {"occupancy": round(p.occupancy(), 3), "depth": p.depth}
+                for n, p in pipeline
             },
             "traces_sampled": (
                 self.tracer.sampled_count if self.tracer is not None else 0

@@ -127,6 +127,14 @@ _FAMILIES = {
         "counter", "Host-to-device wire bytes shipped per junction"),
     "siddhi_h2d_chunks_total": (
         "counter", "Host-to-device transfer chunks per junction"),
+    "siddhi_pipeline_occupancy": (
+        "gauge",
+        "Measured overlap ratio of the pipelined fused ingest (summed "
+        "stage busy time / send wall time; 1.0 = fully serial stages)"),
+    "siddhi_pipeline_depth": (
+        "gauge",
+        "Configured max in-flight chunks of the pipelined fused ingest "
+        "(0 = pipeline disabled)"),
     "siddhi_traces_sampled_total": ("counter", "Traces sampled per app"),
 }
 
@@ -192,6 +200,15 @@ def render_prometheus(reports: list[dict]) -> str:
                     f"{fam}{_labels(app=app, component=ent['component'])}"
                     f" {ent['count']}"
                 )
+        for n, ent in rep.get("pipeline", {}).items():
+            body["siddhi_pipeline_occupancy"].append(
+                f"siddhi_pipeline_occupancy{_labels(app=app, component=n)}"
+                f" {ent['occupancy']}"
+            )
+            body["siddhi_pipeline_depth"].append(
+                f"siddhi_pipeline_depth{_labels(app=app, component=n)}"
+                f" {ent['depth']}"
+            )
         body["siddhi_traces_sampled_total"].append(
             "siddhi_traces_sampled_total"
             f"{_labels(app=app)} {rep.get('traces_sampled', 0)}"

@@ -58,7 +58,8 @@ def compact_set_at(
 ) -> jnp.ndarray:
     """Scatter-set with a LARGE sparse index vector into a SMALL target:
     `dst[G].at[idx[B]].set(src[B])` where at most one live writer exists per
-    slot and dead lanes carry idx == G.
+    slot and dead lanes carry idx >= G (any out-of-range index is dead, not
+    just the == G sentinel).
 
     XLA:TPU executes scatter at ~one UPDATE per scalar-core step, so a [B]
     index vector costs ~B regardless of how few writers are live. One

@@ -75,11 +75,14 @@ def shard_partitioned_query(
 
     routed=True (default): the BATCH AXIS is sharded too. A replicated
     routing pre-pass (key extraction + slot assignment over the small [B]
-    batch) computes each event's owning device (slot // per-device-slots),
-    packs per-device sub-batches [D, B] sharded on the mesh axis, and a
-    shard_map advances each device's LOCAL partition slice against only its
-    own events — each chip decodes B rows, not D*B (the TPU-native analog of
-    the reference's per-key routing, PartitionStreamReceiver.java:81-140).
+    batch) computes each event's owning device by STRIPING slots across the
+    mesh — device = slot % D, local state row = slot // D, so the first D
+    live keys land on D different chips instead of filling device 0's block
+    first — packs per-device sub-batches [D, B] sharded on the mesh axis,
+    and a shard_map advances each device's LOCAL partition slice against
+    only its own events — each chip decodes B rows, not D*B (the TPU-native
+    analog of the reference's per-key routing,
+    PartitionStreamReceiver.java:81-140).
     Timer rows are broadcast to every device, interleaved at their original
     row positions so time-driven operators fire in the unsharded order.
 

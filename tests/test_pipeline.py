@@ -1,0 +1,329 @@
+"""Pipelined fused ingest (core/pipeline.py) must be observationally
+identical to the serial fused path: byte-identical outputs, identical
+delivery order and per-chunk callback grouping, identical failure-policy
+semantics when delivery fails on the drain worker.
+
+Each parity case runs the same columnar feed twice — pipelined (the
+default) and serial (`@pipeline(disable='true')`) — plus configuration,
+error-routing, and observability coverage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+@pytest.fixture(autouse=True)
+def _isolate_pipeline_env(monkeypatch):
+    """CI runs part of the suite under SIDDHI_TPU_PIPELINE=1; these tests
+    assert annotation-level behavior, so the outer override must not leak
+    in (tests that want the env toggle set it themselves)."""
+    monkeypatch.delenv("SIDDHI_TPU_PIPELINE", raising=False)
+
+
+HEAD = "@app:batch(size='64')\ndefine stream S (symbol string, price float, volume long);\n"
+SERIAL_HEAD = (
+    "@app:batch(size='64')\n@pipeline(disable='true')\n"
+    "define stream S (symbol string, price float, volume long);\n"
+)
+
+
+def _feed(n, seed=42):
+    rng = np.random.default_rng(seed)
+    return (
+        np.arange(n, dtype=np.int64) + 1_700_000_000_000,
+        {
+            "symbol": rng.integers(1, 5, size=n).astype(np.int32),
+            "price": rng.uniform(0.0, 100.0, size=n).astype(np.float32),
+            "volume": rng.integers(1, 100, size=n).astype(np.int64),
+        },
+    )
+
+
+def _boot(ql, callback=None):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    if callback is not None:
+        rt.add_callback("q", callback)
+    for s in ["A", "B", "C", "D"]:
+        mgr.interner.intern(s)
+    rt.start()
+    return mgr, rt
+
+
+def _run_rows(ql, n, store_q="from T select *"):
+    mgr, rt = _boot(ql)
+    ts, cols = _feed(n)
+    rt.get_input_handler("S").send_columns(ts, cols)
+    rows = sorted(map(repr, rt.query(store_q)))
+    rt.shutdown()
+    mgr.shutdown()
+    return rows
+
+
+TABLE_BODY = """
+    @capacity(size='4096') define table T (symbol string, total long);
+    @info(name='q') from S[price > 10]#window.lengthBatch(32)
+    select symbol, sum(volume) as total group by symbol insert into T;
+"""
+
+CB_BODY = """@info(name='q') from S#window.length(16)
+    select symbol, avg(price) as ap insert into Out;"""
+
+
+def test_pipelined_matches_serial_table():
+    n = 64 * 40
+    assert _run_rows(HEAD + TABLE_BODY, n) == _run_rows(
+        SERIAL_HEAD + TABLE_BODY, n
+    )
+
+
+def _run_cb(ql, n):
+    got = []
+    mgr, rt = _boot(
+        ql,
+        callback=lambda ts, ins, rem: got.append(
+            (
+                ts,
+                [tuple(e.data) for e in (ins or [])],
+                [tuple(e.data) for e in (rem or [])],
+            )
+        ),
+    )
+    ts, cols = _feed(n)
+    rt.get_input_handler("S").send_columns(ts, cols)
+    rt.shutdown()
+    mgr.shutdown()
+    return got
+
+
+def test_pipelined_delivery_matches_serial():
+    """Drain-worker delivery: identical events, identical per-micro-batch
+    grouping, identical order."""
+    n = 64 * 40
+    pipelined = _run_cb(HEAD + CB_BODY, n)
+    serial = _run_cb(SERIAL_HEAD + CB_BODY, n)
+    assert pipelined == serial
+    assert sum(len(i) for _t, i, _r in pipelined) > 50
+
+
+def test_callbacks_complete_before_send_returns():
+    """try_send barriers on the drain, so a per-row send AFTER a pipelined
+    send_columns observes every pipelined callback already delivered."""
+    order = []
+    mgr, rt = _boot(
+        HEAD + "@info(name='q') from S[price >= 0] select symbol, price "
+        "insert into Out;",
+        callback=lambda ts, ins, rem: order.extend(
+            p for _s, p in (e.data for e in (ins or []))
+        ),
+    )
+    h = rt.get_input_handler("S")
+    ts, cols = _feed(64 * 8)
+    cols["price"] = np.arange(64 * 8, dtype=np.float32)
+    h.send_columns(ts, cols)
+    n_before = len(order)
+    assert n_before == 64 * 8  # everything drained before send returned
+    h.send(("A", 1e6, 1))
+    assert order[-1] == 1e6 and len(order) == n_before + 1
+    rt.shutdown()
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+
+def _fused(rt):
+    fi = rt.junctions["S"].fused_ingest
+    assert fi is not None
+    return fi
+
+
+def test_pipeline_annotation_depth_and_disable():
+    mgr, rt = _boot(
+        "@app:batch(size='64')\n@pipeline(depth='3')\n"
+        "define stream S (symbol string, price float, volume long);\n"
+        + CB_BODY
+    )
+    fi = _fused(rt)
+    assert fi.pipeline_enabled and fi.pipeline_depth == 3
+    rt.shutdown()
+    mgr.shutdown()
+
+    mgr, rt = _boot(SERIAL_HEAD + CB_BODY)
+    assert not _fused(rt).pipeline_enabled
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_pipeline_annotation_rejects_bad_options():
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    for ann in ("@pipeline(depth='x')", "@pipeline(depth='0')",
+                "@pipeline(depth='64')", "@pipeline(disable='maybe')",
+                "@pipeline(bogus='1')"):
+        with pytest.raises(SiddhiAppCreationError):
+            SiddhiManager().create_siddhi_app_runtime(
+                f"@app:batch(size='64')\n{ann}\n"
+                "define stream S (symbol string, price float, volume long);\n"
+                + CB_BODY
+            )
+
+
+def test_pipeline_env_override(monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_PIPELINE", "0")
+    mgr, rt = _boot(HEAD + CB_BODY)
+    assert not _fused(rt).pipeline_enabled
+    rt.shutdown()
+    mgr.shutdown()
+
+    monkeypatch.setenv("SIDDHI_TPU_PIPELINE", "1")
+    mgr, rt = _boot(SERIAL_HEAD + CB_BODY)  # env wins over disable='true'
+    assert _fused(rt).pipeline_enabled
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_prewarm_env_compiles_tail_variant(monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_PREWARM_TAIL", "1")
+    got = _run_cb(HEAD + CB_BODY, 64 * 8)
+    monkeypatch.delenv("SIDDHI_TPU_PREWARM_TAIL")
+    assert got == _run_cb(HEAD + CB_BODY, 64 * 8)
+
+
+def test_wire_slot_reuse_gated_per_shipment():
+    """device_put may alias the host buffer (size/alignment-dependent on
+    CPU): an aliased slot must be gated on the consuming dispatch
+    (retire), a copied one on its transfer (ship)."""
+    import numpy as np
+
+    import jax
+
+    from siddhi_tpu.core.pipeline import IngestPipeline
+
+    class _Schema:
+        stream_id = "S"
+
+    class _Junction:
+        schema = _Schema()
+        exception_handler = None
+        fault_policy = None
+
+    pl = IngestPipeline(_Junction(), depth=2)
+    for wire_bytes in (64, 1 << 20):  # small: alias candidate; big: copied
+        slot = pl.acquire(2, wire_bytes)
+        dev = pl.ship(slot)
+        want_alias = dev.unsafe_buffer_pointer() == slot.buf.ctypes.data
+        assert slot.aliased == want_alias
+        assert slot.ref is dev  # transfer gate until retired
+        completion = jax.numpy.zeros(())
+        pl.retire(slot, completion)
+        if want_alias:
+            assert slot.ref is completion  # program gate replaced it
+        else:
+            assert slot.ref is dev  # copy: transfer gate suffices
+    # no safe gate at all (only-donated-outputs dispatch): an aliased slot
+    # must abandon its buffer rather than ever reuse it
+    slot = pl.acquire(2, 64)
+    old_buf = slot.buf
+    pl.ship(slot)
+    was_aliased = slot.aliased
+    pl.retire(slot, None)
+    if was_aliased:
+        assert slot.buf is not old_buf and slot.ref is None
+    pl.close()
+
+
+# ---------------------------------------------------------------------------
+# drain-worker failure semantics
+# ---------------------------------------------------------------------------
+
+
+def _boom(ts, ins, rem):
+    raise RuntimeError("poisoned callback")
+
+
+def test_drain_error_routes_to_exception_handler():
+    """A delivery failure on the drain worker goes through the junction's
+    failure machinery (mirroring @async drain workers): the sender never
+    sees it once a handler owns the stream."""
+    mgr, rt = _boot(HEAD + CB_BODY, callback=_boom)
+    seen = []
+    rt.set_exception_handler(seen.append)
+    ts, cols = _feed(64 * 8)
+    rt.get_input_handler("S").send_columns(ts, cols)  # must not raise
+    assert seen and isinstance(seen[0], RuntimeError)
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_drain_error_with_onerror_policy_spares_sender():
+    """A stream-level @OnError policy owns drain-worker delivery failures:
+    the sender keeps sending, the junction's error counter ticks."""
+    mgr, rt = _boot(
+        "@app:statistics(reporter='none')\n@app:batch(size='64')\n"
+        "@OnError(action='LOG')\n"
+        "define stream S (symbol string, price float, volume long);\n"
+        + CB_BODY,
+        callback=_boom,
+    )
+    ts, cols = _feed(64 * 8)
+    rt.get_input_handler("S").send_columns(ts, cols)  # must not raise
+    assert rt.statistics_manager.error_tracker("stream.S").count > 0
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_drain_error_propagates_without_handler():
+    """No handler, no @OnError policy: the failure surfaces to the sender
+    at the end of the call, like the serial path's in-line drain."""
+    mgr, rt = _boot(HEAD + CB_BODY, callback=_boom)
+    ts, cols = _feed(64 * 8)
+    with pytest.raises(RuntimeError, match="poisoned callback"):
+        rt.get_input_handler("S").send_columns(ts, cols)
+    rt.shutdown()
+    mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_stage_metrics_and_occupancy():
+    mgr, rt = _boot(
+        "@app:statistics(reporter='none')\n" + HEAD + CB_BODY,
+        callback=lambda ts, ins, rem: None,  # deliver mode: drain runs
+    )
+    ts, cols = _feed(64 * 16)
+    rt.get_input_handler("S").send_columns(ts, cols)
+    sm = rt.statistics_manager
+    rep = sm.report()
+    ent = rep["pipeline"]["stream.S"]
+    assert ent["depth"] == 2  # default
+    assert ent["occupancy"] > 0.0
+    for op in ("encode", "h2d", "dispatch", "drain"):
+        assert sm.device_time[f"stream.S.pipeline.{op}"].samples > 0, op
+    text = sm.prometheus_text()
+    assert "siddhi_pipeline_occupancy" in text
+    assert "siddhi_pipeline_depth" in text
+    assert 'op="pipeline.encode"' in text
+    rt.shutdown()
+    mgr.shutdown()
+
+
+def test_stats_off_pays_one_gate_check():
+    """With statistics never configured the pipelined hot path must not
+    touch any tracker (junction.pipeline_stats stays None)."""
+    mgr, rt = _boot(HEAD + CB_BODY)
+    assert rt.junctions["S"].pipeline_stats is None
+    fi = _fused(rt)
+    ts, cols = _feed(64 * 8)
+    rt.get_input_handler("S").send_columns(ts, cols)
+    assert fi.pipeline is not None and fi.pipeline.stats is None
+    rt.shutdown()
+    mgr.shutdown()

@@ -28,6 +28,8 @@ REQUIRED_FAMILIES = (
     "siddhi_latency_ms",
     "siddhi_buffered_events",
     "siddhi_device_time_ms",
+    "siddhi_pipeline_occupancy",
+    "siddhi_pipeline_depth",
     "siddhi_traces_sampled_total",
 )
 
@@ -61,6 +63,17 @@ def main() -> int:
     h = rt.get_input_handler("S")
     for i in range(32):
         h.send(("A", float(i)))
+    # columnar send big enough to engage the PIPELINED fused ingest, so the
+    # pipeline stage histograms (op="pipeline.*") and occupancy gauge carry
+    # real samples in the exposition below
+    import numpy as np
+
+    n = 256
+    sym = np.full((n,), mgr.interner.intern("A"), dtype=np.int32)
+    h.send_columns(
+        np.arange(n, dtype=np.int64) + 1_700_000_000_000,
+        {"symbol": sym, "price": np.linspace(0.0, 99.0, n, dtype=np.float32)},
+    )
     port = mgr.metrics_port
     assert port, "reporter='prometheus' must start the metrics endpoint"
     text = scrape(f"http://127.0.0.1:{port}/metrics")
@@ -83,6 +96,8 @@ def main() -> int:
     assert not missing, f"missing families: {missing}"
     for q in ('quantile="0.5"', 'quantile="0.95"', 'quantile="0.99"'):
         assert q in text, f"missing latency {q}"
+    for op in ("pipeline.encode", "pipeline.h2d", "pipeline.dispatch"):
+        assert f'op="{op}"' in text, f"missing pipeline stage metric {op}"
     assert rt.traces(), "trace.sample='1.0' must produce sampled traces"
     mgr.shutdown()
     print(f"metrics smoke OK: {samples} samples, {len(typed)} families")
