@@ -88,6 +88,19 @@ def _truth_sync(rt):
     return float(np.asarray(acc))
 
 
+def _snapshot_status(rt):
+    """Steady-state engine shape at the end of a leg (runtime.snapshot_status
+    per the observability layer), stashed into the detail blob. Guarded: a
+    snapshot failure must never fail a leg."""
+    try:
+        return rt.snapshot_status()
+    except Exception:
+        return None
+
+
+_LAST_STATUS: list = [None]  # snapshot of the most recent _run_workload leg
+
+
 def _run_workload(ql, query_stream, data, n_events, batch_size, callback=None):
     """TRUE throughput of one SiddhiQL app: events/sec through the full
     engine (host pack -> h2d -> fused/step dispatch), timed to completion
@@ -122,6 +135,7 @@ def _run_workload(ql, query_stream, data, n_events, batch_size, callback=None):
         sent = end
     _truth_sync(rt)
     dt = time.perf_counter() - t0
+    _LAST_STATUS[0] = _snapshot_status(rt)
     rt.shutdown()
     mgr.shutdown()
     return sent / dt
@@ -282,10 +296,13 @@ def _leg_table_scaling(rows_list=(100_000, 1_000_000), batches=192) -> dict:
             h.send_columns(np.arange(batch * batches, dtype=np.int64), {"k": ks, "v": vs})
             _truth_sync(rt)
             dt = time.perf_counter() - t0
+            status = _snapshot_status(rt)
             rt.shutdown()
             mgr.shutdown()
             label = f"{n_rows // 1000}k" if n_rows < 1_000_000 else f"{n_rows // 1_000_000}m"
             out[f"table_update_{label}{label_sfx}"] = round(batch * batches / dt, 1)
+            if status is not None:
+                out[f"table_update_{label}{label_sfx}_status"] = status
     return out
 
 
@@ -345,6 +362,7 @@ def _leg_p99(batch=256, batches=60) -> dict:
         if i >= 5:  # skip compile warmup
             lat.append((t1 - t0) * 1000)
             floors.append((t3 - t2) * 1000)
+    status = _snapshot_status(rt)
     rt.shutdown()
     mgr.shutdown()
     # paired deltas isolate ENGINE overhead from relay weather: each
@@ -356,13 +374,16 @@ def _leg_p99(batch=256, batches=60) -> dict:
     lat.sort()
     floors.sort()
     p99 = lat[max(0, math.ceil(len(lat) * 0.99) - 1)]
-    return {
+    out = {
         "p99_detect_ms": round(p99, 2),
         "p99_floor_ms": round(floors[max(0, math.ceil(len(floors) * 0.99) - 1)], 2),
         "p50_floor_ms": round(floors[len(floors) // 2], 2),
         "p50_detect_ms": round(lat[len(lat) // 2], 2),
         "engine_overhead_p50_ms": round(deltas[len(deltas) // 2], 2),
     }
+    if status is not None:
+        out["p99_status"] = status
+    return out
 
 
 def _leg_timebudget(batch=32768) -> dict:
@@ -715,7 +736,10 @@ def _verify_tpu_vs_cpu(args) -> dict:
 def _run_leg(name: str, args) -> dict:
     if name in WORKLOADS or name.endswith("_delivered"):
         v = _leg_throughput(name, args.events, args.batch)
-        return {name: round(v, 1)}
+        out = {name: round(v, 1)}
+        if _LAST_STATUS[0] is not None:
+            out[f"{name}_status"] = _LAST_STATUS[0]
+        return out
     if name == "tables":
         return _leg_table_scaling()
     if name == "p99":

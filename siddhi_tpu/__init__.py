@@ -17,7 +17,10 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
-from siddhi_tpu.core.error_store import InMemoryErrorStore  # noqa: E402,F401
+from siddhi_tpu.core.error_store import (  # noqa: E402,F401
+    FileErrorStore,
+    InMemoryErrorStore,
+)
 from siddhi_tpu.core.manager import SiddhiManager  # noqa: E402,F401
 from siddhi_tpu.core.types import AttrType  # noqa: E402,F401
 
@@ -42,6 +45,7 @@ __all__ = [
     "SiddhiManager",
     "AttrType",
     "InMemoryErrorStore",
+    "FileErrorStore",
     "analyze",
     "AnalysisResult",
     "Diagnostic",

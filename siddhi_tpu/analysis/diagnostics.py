@@ -34,6 +34,8 @@ CODES: dict[str, str] = {
     "SA110": "invalid @OnError action",
     "SA111": "reserved attribute name",
     "SA112": "invalid @pipeline annotation (unknown key / bad depth / bad disable)",
+    "SA113": "invalid @app:selfmon annotation (bad interval / unknown key / reserved stream name)",
+    "SA114": "invalid @flightRecorder annotation (bad size / unknown key)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

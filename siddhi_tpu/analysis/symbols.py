@@ -88,6 +88,49 @@ def _check_pipeline_annotation(
         ))
 
 
+def _check_flight_annotation(
+    sid: str, d, ann, diags: list[Diagnostic]
+) -> None:
+    """Validate `@flightRecorder(size='N')` — the per-junction last-N-events
+    ring. One SA114 per malformed element, using the SAME rule set the
+    runtime resolver enforces (observability/flight.py)."""
+    from siddhi_tpu.observability.flight import (
+        iter_flight_annotation_problems,
+    )
+
+    line, col = getattr(d, "line", None), getattr(d, "col", None)
+    for problem in iter_flight_annotation_problems(ann):
+        diags.append(Diagnostic(
+            "SA114", f"stream '{sid}': {problem}", line, col,
+        ))
+
+
+def _apply_selfmon_annotation(
+    app: SiddhiApp, sym: SymbolTable, diags: list[Diagnostic]
+) -> None:
+    """`@app:selfmon(interval='...')`: validate (SA113, same rule set as
+    the runtime resolver — observability/selfmon.py) and inject the
+    engine-fed `SelfMonitorStream` system definition so queries over it
+    resolve — mirroring what `SiddhiAppRuntime.__init__` registers."""
+    ann = find_annotation(app.annotations, "app:selfmon")
+    if ann is None:
+        return
+    from siddhi_tpu.observability.selfmon import (
+        SELFMON_STREAM_ID,
+        iter_selfmon_annotation_problems,
+        selfmon_attrs,
+    )
+
+    problems = list(iter_selfmon_annotation_problems(
+        ann, defined_streams=app.stream_definitions
+    ))
+    for problem in problems:
+        diags.append(Diagnostic("SA113", problem))
+    if SELFMON_STREAM_ID not in sym.streams:
+        sym.streams[SELFMON_STREAM_ID] = dict(selfmon_attrs())
+        sym.sourced.add(SELFMON_STREAM_ID)  # engine-fed, never query-fed
+
+
 def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
     sym = SymbolTable()
 
@@ -100,6 +143,9 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
         pa = find_annotation(d.annotations, "pipeline")
         if pa is not None:
             _check_pipeline_annotation(sid, d, pa, diags)
+        fa = find_annotation(d.annotations, "flightRecorder")
+        if fa is not None:
+            _check_flight_annotation(sid, d, fa, diags)
         oe = find_annotation(d.annotations, "OnError")
         if oe is None:
             continue
@@ -142,5 +188,7 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
 
     for aid in app.aggregation_definitions:
         sym.aggregations[aid] = None  # bucket-view schema: leave open
+
+    _apply_selfmon_annotation(app, sym, diags)
 
     return sym

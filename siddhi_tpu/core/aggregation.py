@@ -644,6 +644,37 @@ class AggregationRuntime:
 
     # ---- host -------------------------------------------------------------
 
+    def describe_state(self) -> dict:
+        """Introspection: per-granularity bucket state — open (in-flight)
+        group count, the open bucket's start (the duration's watermark: all
+        coarser output up to it is final), and the duration table's closed
+        row count (see observability/introspect.py)."""
+        import numpy as np
+
+        from siddhi_tpu.observability.introspect import device_reads_ok
+
+        out: dict = {"group_capacity": self.g, "durations": {}}
+        if not device_reads_ok():
+            out["durations"] = None  # degraded relay: d2h poisons dispatch
+            return out
+        try:
+            for di, dur in enumerate(self.durations):
+                store = self.state["stores"][di]
+                bucket = int(np.asarray(store["bucket"]))
+                entry = {
+                    "open_groups": int(np.asarray(store["used"]).sum()),
+                    "watermark_ms": bucket if bucket >= 0 else None,
+                }
+                tbl = self.tables.get(dur)
+                if tbl is not None:
+                    entry["closed_rows"] = int(
+                        np.asarray(tbl.state["valid"]).sum()
+                    )
+                out["durations"][dur.name] = entry
+        except Exception:
+            out["durations"] = None  # mid-dispatch buffer churn: degrade
+        return out
+
     def receive(self, batch: EventBatch, now: int):
         tstates = {t.table_id: t.state for t in self.tables.values()}
         new_state, aux, tstates = self._step_full(batch, now, tstates)

@@ -175,6 +175,7 @@ class SiddhiAppRuntime:
         # here (with the SIDDHI_TPU_PIPELINE env override) and applied when
         # start() builds the junction's FusedJunctionIngest
         from siddhi_tpu.core.pipeline import resolve_pipeline_annotation
+        from siddhi_tpu.observability.flight import resolve_flight_annotation
 
         self._pipeline_conf: dict[str, tuple[bool, int]] = {}
         for sid, d in app.stream_definitions.items():
@@ -185,6 +186,16 @@ class SiddhiAppRuntime:
                 self._pipeline_conf[sid] = resolve_pipeline_annotation(
                     find_annotation(d.annotations, "pipeline")
                 )
+                # @flightRecorder(size='N') — bounded last-N-events ring on
+                # this stream's junction (observability/flight.py; the
+                # SIDDHI_TPU_FLIGHT env override is folded in by the
+                # resolver, and _junction() applies it to internal
+                # junctions too)
+                flight_size = resolve_flight_annotation(
+                    find_annotation(d.annotations, "flightRecorder")
+                )
+                if flight_size:
+                    self._junction(sid).enable_flight(flight_size)
             except SiddhiAppCreationError as e:
                 raise SiddhiAppCreationError(f"stream '{sid}': {e}") from e
             # @async(buffer.size, workers, batch.size.max) — buffered ingress
@@ -215,6 +226,30 @@ class SiddhiAppRuntime:
                 j.device_stats = sm.junction_device_stats(f"stream.{sid}")
                 # pipelined-ingest stage budget + occupancy overlap gauge
                 j.pipeline_stats = sm.pipeline_stats(f"stream.{sid}")
+
+        # @app:selfmon(interval='5 sec'): CEP-native self-monitoring — inject
+        # the SelfMonitorStream system schema (runtime-side only: the user's
+        # AST is not mutated; the analyzer injects the same definition from
+        # the annotation, analysis/symbols.py) and build the scheduler-fed
+        # monitor armed at start() (observability/selfmon.py)
+        self._selfmon = None
+        sm_ann = find_annotation(app.annotations, "app:selfmon")
+        if sm_ann is not None:
+            from siddhi_tpu.observability.selfmon import (
+                SELFMON_STREAM_ID,
+                SelfMonitor,
+                resolve_selfmon_annotation,
+            )
+
+            interval_ms = resolve_selfmon_annotation(
+                sm_ann, defined_streams=app.stream_definitions
+            )
+            from siddhi_tpu.observability.selfmon import selfmon_attrs
+
+            self.stream_schemas[SELFMON_STREAM_ID] = StreamSchema(
+                SELFMON_STREAM_ID, selfmon_attrs()
+            )
+            self._selfmon = SelfMonitor(self, interval_ms)
 
         for sid, action in self.on_error_actions.items():
             j = self._junction(sid)
@@ -459,6 +494,15 @@ class SiddhiAppRuntime:
             j = StreamJunction(schema, self.interner, self.batch_size)
             j.exception_handler = getattr(self, "_exception_handler", None)
             j.tracer = self.tracer
+            # SIDDHI_TPU_FLIGHT=N arms the flight recorder on EVERY junction
+            # — internal insert-into targets and fault streams included
+            # (explicit @flightRecorder sizes are applied after, and win
+            # when larger; see the stream-definition loop)
+            from siddhi_tpu.observability.flight import flight_env_size
+
+            env_n = flight_env_size()
+            if env_n:
+                j.enable_flight(env_n)
             self.junctions[stream_id] = j
         return j
 
@@ -498,6 +542,7 @@ class SiddhiAppRuntime:
                 not _t.subscribers
                 and not _t.stream_callbacks
                 and _t.on_publish_stats is None
+                and _t.flight is None
             ):
                 return  # nobody downstream: skip the transform dispatch
             _t.publish_batch(rename(transform(out_batch)), now)
@@ -971,6 +1016,72 @@ class SiddhiAppRuntime:
         `@app:statistics(trace.sample=...)` is not configured."""
         return self.tracer.traces() if self.tracer is not None else []
 
+    # ---- state introspection (observability/introspect.py) ----------------
+
+    def snapshot_status(self) -> dict:
+        """Live per-component state of this app: junction queue depths and
+        wiring, window type/fill/capacity, NFA active-instance counts,
+        aggregation buckets/watermarks, table row counts, ingest-pipeline
+        depth/occupancy/slots in flight. Pull-only: nothing is collected
+        until asked (served as `/status` + `/status.json` when
+        `manager.serve_metrics()` is up)."""
+        # list() snapshots: junctions are created lazily (selfmon's system
+        # junction arms from the scheduler thread, store-query targets from
+        # callers), and a plain dict iteration racing an insert raises
+        status: dict = {
+            "app": self.name,
+            "running": self._running,
+            "streams": {
+                sid: j.describe_state()
+                for sid, j in list(self.junctions.items())
+            },
+            "queries": {
+                qid: qr.describe_state() for qid, qr in self.queries.items()
+            },
+            "windows": {
+                wid: nw.describe_state()
+                for wid, nw in self.named_windows.items()
+            },
+            "tables": {
+                tid: t.describe_state() for tid, t in self.tables.items()
+            },
+            "aggregations": {
+                aid: ar.describe_state()
+                for aid, ar in self.aggregations.items()
+            },
+        }
+        if self._selfmon is not None:
+            status["selfmon"] = self._selfmon.describe_state()
+        return status
+
+    # ---- flight recorder (observability/flight.py) ------------------------
+
+    def flight_record(self, stream_id: str) -> list[tuple[int, tuple]]:
+        """The last-N events through `stream_id`'s junction, oldest first,
+        as (timestamp_ms, data_tuple) pairs. Raises when the stream has no
+        recorder (enable with @flightRecorder(size='N') or
+        SIDDHI_TPU_FLIGHT=N)."""
+        j = self.junctions.get(stream_id)
+        if j is None:
+            raise DefinitionNotExistError(
+                f"no stream '{stream_id}' in app '{self.name}'"
+            )
+        if j.flight is None:
+            raise SiddhiAppCreationError(
+                f"stream '{stream_id}' has no flight recorder — enable it "
+                "with @flightRecorder(size='N') or SIDDHI_TPU_FLIGHT=N"
+            )
+        return j.flight.events()
+
+    def flight_records(self) -> dict[str, list[tuple[int, tuple]]]:
+        """Every recorded junction's ring, keyed by stream id (empty dict
+        when no junction has a recorder)."""
+        return {
+            sid: j.flight.events()
+            for sid, j in list(self.junctions.items())
+            if j.flight is not None
+        }
+
     def dump_traces(self, path: str | None = None, indent: int = 1) -> str:
         """JSON dump of `traces()`; also written to `path` when given."""
         import json as _json
@@ -1128,6 +1239,15 @@ class SiddhiAppRuntime:
                     qr.host_next_timer(self.clock()), qr.timer_target
                 )
             self._arm_rate_limiter(qr)
+        # CEP-native self-monitoring: materialize the system junction NOW
+        # (its lazy creation would otherwise happen on the scheduler thread,
+        # racing concurrent junction-map readers) and arm the recurring feed
+        # (observability/selfmon.py) before sources start publishing
+        if self._selfmon is not None:
+            from siddhi_tpu.observability.selfmon import SELFMON_STREAM_ID
+
+            self._junction(SELFMON_STREAM_ID)
+            self._selfmon.start()
         # lifecycle ordering (reference: SiddhiAppRuntime.start:353-394):
         # sinks connect before sources so no event finds a dead egress;
         # triggers and sources begin last, into fully-wired queries

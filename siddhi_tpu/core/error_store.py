@@ -42,6 +42,10 @@ class ErroneousEvent:
     # identifies WHICH sink on stream_id failed (a stream can carry several
     # @sink annotations / @distribution destinations); replay targets it
     sink_ref: str = ""
+    # flight-recorder dump: the last-N events through the failing junction
+    # at capture time, as (timestamp_ms, data_tuple) pairs (None when the
+    # junction has no recorder — see observability/flight.py)
+    flight: Optional[list[tuple[int, tuple]]] = None
 
 
 class ErrorStore:
@@ -62,6 +66,15 @@ class ErrorStore:
 
     def purge(self, ids: Optional[list[int]] = None) -> int:
         raise NotImplementedError
+
+    def describe_state(self) -> dict:
+        """Introspection: depth + per-app breakdown (generic implementation
+        rides `load()`; bounded stores override with O(1) reads)."""
+        entries = self.load()
+        by_app: dict[str, int] = {}
+        for e in entries:
+            by_app[e.app_name] = by_app.get(e.app_name, 0) + 1
+        return {"depth": len(entries), "by_app": by_app}
 
 
 class InMemoryErrorStore(ErrorStore):
@@ -121,6 +134,249 @@ class InMemoryErrorStore(ErrorStore):
     def size(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def describe_state(self) -> dict:
+        with self._lock:
+            by_app: dict[str, int] = {}
+            for e in self._entries.values():
+                by_app[e.app_name] = by_app.get(e.app_name, 0) + 1
+            return {
+                "depth": len(self._entries),
+                "capacity": self.capacity,
+                "dropped": self.dropped,
+                "by_app": by_app,
+            }
+
+
+class FileErrorStore(ErrorStore):
+    """File-backed persistent store: one JSONL file per app under
+    `base_path` (layout mirrors `persistence.FileSystemPersistenceStore`'s
+    directory-per-concern shape), so error entries — including their
+    flight-recorder dumps — survive restart.
+
+    Serialization is plain JSON: `events`/`flight` row tuples become lists
+    on disk and are re-tupled on load (replay re-encodes them through the
+    input handler either way); the exception object itself (`cause`) does
+    not survive — its rendered `error` string does. Non-JSON payloads are
+    stringified rather than lost.
+    """
+
+    def __init__(self, base_path: str, capacity: int = 100_000):
+        import os
+
+        if capacity <= 0:
+            raise ValueError("error store capacity must be positive")
+        self.base_path = base_path
+        self.capacity = int(capacity)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        os.makedirs(base_path, exist_ok=True)
+        # ids must stay unique across restarts: resume after the max on disk
+        # (the same scan seeds the entry count so the capacity check is O(1)
+        # per store instead of re-reading the directory)
+        best = 0
+        n = 0
+        for e in self._iter_entries():
+            best = max(best, e.id)
+            n += 1
+        self._ids = itertools.count(best + 1)
+        self._count = n
+
+    def _files(self) -> list[str]:
+        import os
+
+        return sorted(
+            os.path.join(self.base_path, f)
+            for f in os.listdir(self.base_path)
+            if f.endswith(".jsonl")
+        )
+
+    def _app_file(self, app_name: str) -> str:
+        import os
+
+        # app names come from @app:name — keep the file name filesystem-safe
+        safe = "".join(
+            c if c.isalnum() or c in "._-" else "_" for c in app_name
+        )
+        return os.path.join(self.base_path, f"{safe}.jsonl")
+
+    @staticmethod
+    def _to_json(entry: ErroneousEvent) -> dict:
+        # built by hand, NOT dataclasses.asdict: asdict deep-copies every
+        # field first, and deep-copying the live exception in `cause` fails
+        # for exception classes with non-default __init__ signatures —
+        # raising from inside the very store() call that was capturing the
+        # failure. `error` already carries the rendered message.
+        d = {
+            "id": entry.id,
+            "stored_at_ms": entry.stored_at_ms,
+            "app_name": entry.app_name,
+            "origin": entry.origin,
+            "stream_id": entry.stream_id,
+            "error": entry.error,
+            "events": entry.events,
+            "payload": entry.payload,
+            "sink_ref": entry.sink_ref,
+            "flight": entry.flight,
+        }
+        try:
+            import json
+
+            json.dumps(d.get("payload"))
+        except (TypeError, ValueError):
+            d["payload"] = repr(d.get("payload"))
+        return d
+
+    @staticmethod
+    def _from_json(d: dict) -> ErroneousEvent:
+        for key in ("events", "flight"):
+            if d.get(key) is not None:
+                d[key] = [(int(ts), tuple(row)) for ts, row in d[key]]
+        return ErroneousEvent(cause=None, **d)
+
+    def _iter_entries(self):
+        import json
+
+        for path in self._files():
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            yield self._from_json(json.loads(line))
+                        except Exception:
+                            continue  # a torn tail line must not kill load()
+            except OSError:
+                continue
+
+    def store(self, entry: ErroneousEvent) -> None:
+        import json
+
+        with self._lock:
+            if entry.id == 0:
+                entry.id = next(self._ids)
+            if entry.stored_at_ms == 0:
+                entry.stored_at_ms = int(time.time() * 1000)
+            with open(self._app_file(entry.app_name), "a", encoding="utf-8") as f:
+                f.write(json.dumps(self._to_json(entry), default=str) + "\n")
+            self._count += 1
+            if self._count > self.capacity:
+                self._enforce_capacity()
+
+    def _enforce_capacity(self) -> None:
+        """FIFO eviction across the whole directory (oldest ids first),
+        same policy as the in-memory store. Caller holds the lock; only
+        invoked when the running count exceeds capacity. Evicts down to 90%
+        of capacity, not to capacity exactly — the eviction pass re-parses
+        the directory (O(capacity)), and dropping with slack amortizes that
+        over the next capacity/10 stores instead of paying it on every
+        store() once the directory is full."""
+        entries = sorted(self._iter_entries(), key=lambda e: e.id)
+        self._count = len(entries)  # re-sync (torn lines are not counted)
+        if len(entries) <= self.capacity:
+            return
+        target = max(1, (self.capacity * 9) // 10)
+        evict = {e.id for e in entries[: len(entries) - target]}
+        # count only what was ACTUALLY removed (a momentarily unreadable
+        # app file skips its rewrite): dropped must reconcile with disk
+        removed = self._rewrite_without(evict)
+        self.dropped += removed
+        self._count -= removed
+
+    def _rewrite_without(self, ids: set) -> int:
+        """Rewrite every app file dropping `ids`; returns how many entries
+        were removed. Caller holds the lock."""
+        import json
+        import os
+
+        removed = 0
+        for path in self._files():
+            keep: list[str] = []
+            changed = False
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            eid = json.loads(line).get("id")
+                        except Exception:
+                            changed = True  # drop torn lines on rewrite
+                            continue
+                        if eid in ids:
+                            removed += 1
+                            changed = True
+                        else:
+                            keep.append(line)
+            except OSError:
+                continue
+            if not changed:
+                continue
+            if keep:
+                tmp = path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write("\n".join(keep) + "\n")
+                os.replace(tmp, path)
+            else:
+                os.unlink(path)
+        return removed
+
+    def load(
+        self,
+        app_name: Optional[str] = None,
+        stream_id: Optional[str] = None,
+        origin: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> list[ErroneousEvent]:
+        with self._lock:
+            out = [
+                e
+                for e in self._iter_entries()
+                if (app_name is None or e.app_name == app_name)
+                and (stream_id is None or e.stream_id == stream_id)
+                and (origin is None or e.origin == origin)
+            ]
+        out.sort(key=lambda e: e.id)
+        return out[:limit] if limit is not None else out
+
+    def purge(self, ids: Optional[list[int]] = None) -> int:
+        import os
+
+        with self._lock:
+            if ids is None:
+                n = sum(1 for _ in self._iter_entries())
+                for path in self._files():
+                    os.unlink(path)
+                self._count = 0
+                return n
+            removed = self._rewrite_without(set(ids))
+            self._count = max(0, self._count - removed)
+            return removed
+
+    def size(self) -> int:
+        """O(1): the running count (seeded by the init scan, adjusted by
+        store/purge/eviction) — selfmon polls this every tick, and a
+        directory re-parse per poll would stall the scheduler thread."""
+        with self._lock:
+            return self._count
+
+    def describe_state(self) -> dict:
+        """The per-app breakdown does read the directory — describe_state
+        is an on-demand introspection pull, not a periodic poll."""
+        with self._lock:
+            by_app: dict[str, int] = {}
+            for e in self._iter_entries():
+                by_app[e.app_name] = by_app.get(e.app_name, 0) + 1
+            return {
+                "depth": self._count,
+                "capacity": self.capacity,
+                "dropped": self.dropped,
+                "by_app": by_app,
+                "path": self.base_path,
+            }
 
 
 def make_entry(

@@ -120,6 +120,23 @@ class FusedJunctionIngest:
         if ps is not None:
             ps.depth = self.pipeline_depth if self.pipeline_enabled else 0
 
+    def describe_state(self) -> dict:
+        """Introspection: chunking, pipeline depth/occupancy, slots in
+        flight (see observability/introspect.py)."""
+        d: dict = {
+            "chunk_batches": self.K,
+            "enabled": not self._disabled,
+            "pipeline_enabled": self.pipeline_enabled,
+            "depth": self.pipeline_depth if self.pipeline_enabled else 0,
+        }
+        ps = getattr(self.junction, "pipeline_stats", None)
+        if ps is not None:
+            d["occupancy"] = round(ps.occupancy(), 3)
+        pl = self.pipeline
+        if pl is not None:
+            d.update(pl.describe_state())
+        return d
+
     def wire_params(self):
         """(capacity, keep, narrow) — the exact wire codec the built fused
         program decodes; tools/bench must encode with the same triple."""
@@ -405,6 +422,17 @@ class FusedJunctionIngest:
         if not self._prewarmed:
             self._prewarm_tail(prog, now)
 
+        # flight recorder: the fused path never materializes an EventBatch
+        # host-side, so record straight from the (host, physical) columns —
+        # but only once a send path COMMITS (returns True): a False return
+        # re-sends the same events through the per-batch path, whose
+        # publish_batch would record them a second time
+        def record_flight(ok: bool) -> bool:
+            fl = self.junction.flight
+            if ok and fl is not None:
+                fl.record_columns(ts_arr, cols, n)
+            return ok
+
         # observability hooks: device-budget trackers on the junction plus
         # per-endpoint latency trackers (recording CHUNK dispatch wall time —
         # in fused mode the chunk is the unit of processing). All None/empty
@@ -430,16 +458,16 @@ class FusedJunctionIngest:
                 with self._send_lock:
                     self._sender = threading.current_thread()
                     try:
-                        return self._send_pipelined(
+                        return record_flight(self._send_pipelined(
                             prog, encode, deliver, dset, ts_arr, cols, n, B,
                             now, ds, tracked, tr, stream_span, pl,
-                        )
+                        ))
                     finally:
                         self._sender = None
-        return self._send_serial(
+        return record_flight(self._send_serial(
             prog, encode, deliver, dset, ts_arr, cols, n, B, now,
             ds, tracked, tr, stream_span,
-        )
+        ))
 
     def _pipeline(self):
         pl = self.pipeline

@@ -473,6 +473,26 @@ class JoinQueryRuntime(BaseQueryRuntime):
     def init_state(self):
         return {"join": self.join.init_state(), "sel": self.selector.init_state()}
 
+    def describe_state(self) -> dict:
+        """Introspection: per-side window buffers (table/named-window sides
+        are shared findables reported under their own component)."""
+        d = super().describe_state()
+        for key, side in (("left", self.join.left), ("right", self.join.right)):
+            w = getattr(side, "window", None)
+            if w is None:
+                d[key] = {"type": "findable", "ref": side.stream_id}
+                continue
+            sk = "l" if key == "left" else "r"
+            # under the receive lock: the step donates old state buffers, so
+            # an unlocked read could touch already-deleted device arrays
+            with self._receive_lock:
+                d[key] = (
+                    w.describe_state(self.state["join"][sk])
+                    if self.state is not None
+                    else {"type": type(w).__name__, "fill": 0}
+                )
+        return d
+
     def _step_impl(self, state, tstates, batch: EventBatch, now, side: str):
         jstate, flow, aux = self.join.step(state["join"], batch, now, side, tstates)
         sel_state, out = self.selector.apply(state["sel"], flow)

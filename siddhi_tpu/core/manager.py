@@ -126,8 +126,9 @@ class SiddhiManager:
 
     def serve_metrics(self, port: int = 9464, host: str = "127.0.0.1") -> int:
         """Serve Prometheus text (`/metrics`), raw reports (`/metrics.json`),
-        and sampled traces (`/traces`) for EVERY app runtime registered on
-        this manager that has statistics enabled. Idempotent: a second call
+        sampled traces (`/traces`), live engine state (`/status`,
+        `/status.json`), and flight-recorder rings (`/flight`) for EVERY app
+        runtime registered on this manager. Idempotent: a second call
         returns the already-bound port. Pass port=0 for an ephemeral port;
         the bound port is returned either way."""
         if self._metrics_server is not None:
@@ -172,6 +173,38 @@ class SiddhiManager:
         from siddhi_tpu.observability.reporters import render_prometheus
 
         return render_prometheus(self.observability_reports())
+
+    # ---- state introspection (observability/introspect.py) ----------------
+
+    def snapshot_status(self) -> dict:
+        """Live engine state across every app on this manager plus the
+        shared error store — served as `/status` (human text) and
+        `/status.json` by `serve_metrics()`. Pull-only; see
+        `SiddhiAppRuntime.snapshot_status()` for the per-app schema."""
+        status: dict = {
+            "apps": {
+                name: rt.snapshot_status()
+                for name, rt in list(self._runtimes.items())
+            }
+        }
+        store = self._error_store
+        if store is not None and hasattr(store, "describe_state"):
+            status["error_store"] = store.describe_state()
+        return status
+
+    def status_text(self) -> str:
+        from siddhi_tpu.observability.introspect import render_status
+
+        return render_status(self.snapshot_status())
+
+    def flight_records(self) -> dict:
+        """Every app's recorded flight rings: app -> stream -> [(ts, row)]."""
+        out = {}
+        for name, rt in list(self._runtimes.items()):
+            recs = rt.flight_records()
+            if recs:
+                out[name] = recs
+        return out
 
     def persist(self) -> None:
         for rt in self._runtimes.values():

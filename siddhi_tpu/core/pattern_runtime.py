@@ -320,6 +320,52 @@ class PatternQueryRuntime(BaseQueryRuntime):
         self._warn_aux(aux)
         return out, aux
 
+    def describe_state(self) -> dict:
+        """NFA introspection: active state-machine instances per linearized
+        slot (the device token table's `active`/`slot` lanes pulled to host)
+        plus the earliest pending within/absent deadline."""
+        d = super().describe_state()
+        prog = self.prog
+        d["token_capacity"] = prog.T
+        slots = []
+        for s in prog.slots:
+            slots.append({
+                "refs": [a.ref for a in s.atoms],
+                "absent": s.is_absent,
+                "count": [s.min_count, s.max_count] if s.is_count else None,
+            })
+        from siddhi_tpu.observability.introspect import device_reads_ok
+
+        if self.state is None:
+            d["states"] = [dict(s, active=0) for s in slots]
+            return d
+        if not device_reads_ok():
+            # degraded relay: one d2h would poison dispatch
+            d["states"] = [dict(s, active=None) for s in slots]
+            return d
+        try:
+            with self._receive_lock:
+                tok = self.state["tok"]
+                active = np.asarray(tok["active"])
+                slot = np.asarray(tok["slot"])
+                deadline = int(
+                    np.asarray(
+                        prog.next_timer(tok, after=self.state["timer_ts"])
+                    )
+                )
+        except Exception:
+            # a concurrent donated-state dispatch (fused ingest) can delete
+            # the buffers under us; introspection degrades, never raises
+            d["states"] = [dict(s, active=None) for s in slots]
+            return d
+        per_state = np.bincount(slot[active], minlength=len(slots))
+        d["states"] = [
+            dict(s, active=int(per_state[i])) for i, s in enumerate(slots)
+        ]
+        d["active_instances"] = int(active.sum())
+        d["next_deadline_ms"] = deadline if deadline < int(NO_TIMER) else None
+        return d
+
     def prime(self, now: int) -> dict:
         """Arm the initial token's clock (absent-at-start patterns need a timer
         before any event arrives — reference:

@@ -231,6 +231,27 @@ class IngestPipeline:
             slot.buf = np.zeros_like(slot.buf)
             slot.ref = None
 
+    def in_flight(self) -> int:
+        """Chunks submitted but not yet drained (inline mode: the one
+        pending chunk)."""
+        if self._thread is None:
+            return 1 if self._pending_inline is not None else 0
+        with self._cv:
+            return self._inflight
+
+    def describe_state(self) -> dict:
+        """Introspection: depth, slots in flight, pooled wire slots, drain
+        mode (see observability/introspect.py)."""
+        return {
+            "depth": self.depth,
+            "in_flight": self.in_flight(),
+            "wire_slots": sum(
+                len(ent["slots"]) for ent in self._pool.values()
+            ),
+            "drain_thread": self._thread is not None,
+            "closed": self._closed,
+        }
+
     # ---- drain -----------------------------------------------------------
 
     def is_drain_thread(self) -> bool:

@@ -355,6 +355,17 @@ class BaseQueryRuntime:
     def init_state(self):
         raise NotImplementedError
 
+    def describe_state(self) -> dict:
+        """Introspection snapshot (pull-only; see observability/introspect).
+        Subclasses add their stateful internals (window fill, NFA instance
+        counts, join-side buffers)."""
+        return {
+            "kind": type(self).__name__,
+            "callbacks": len(self.query_callbacks),
+            "rate_limited": self.rate_limiter is not None,
+            "tables": sorted(self.tables),
+        }
+
     @staticmethod
     def _fresh(state):
         """Deep-copy an initial state pytree: jnp constant caching can alias
@@ -672,6 +683,20 @@ class QueryRuntime(BaseQueryRuntime):
 
     def init_state(self):
         return {"chain": self.chain.init_state(), "sel": self.selector.init_state()}
+
+    def describe_state(self) -> dict:
+        d = super().describe_state()
+        win = self.chain.window
+        if win is not None:
+            # under the receive lock: the step donates the old state buffers,
+            # so an unlocked read could touch already-deleted device arrays
+            with self._receive_lock:
+                d["window"] = (
+                    win.describe_state(self.state["chain"])
+                    if self.state is not None
+                    else {"type": type(win).__name__, "fill": 0}
+                )
+        return d
 
     def _step_impl(self, state, tstates, batch: EventBatch, now):
         flow = Flow(batch=batch, ref=self.ref, now=now, tables=tstates)

@@ -59,6 +59,11 @@ class StreamJunction:
         # pipelined-ingest stage budget (PipelineStats): encode/h2d/dispatch/
         # drain histograms + the pipeline.occupancy overlap gauge
         self.pipeline_stats = None
+        # flight recorder (observability.flight.FlightRecorder): bounded
+        # ring of the last N events through this junction, opt-in via
+        # @flightRecorder(size='N') / SIDDHI_TPU_FLIGHT=N; None = one
+        # attribute check on the hot path
+        self.flight = None
         # user hook for subscriber failures (reference: the pluggable
         # Disruptor ExceptionHandler, SiddhiAppRuntime.java:664)
         self.exception_handler: Callable[[Exception], None] | None = None
@@ -70,6 +75,42 @@ class StreamJunction:
         self.fault_junction: "StreamJunction | None" = None
         self.error_store_fn: Callable[[], object] | None = None
         self.app_name: str = ""
+
+    def enable_flight(self, size: int) -> None:
+        """Attach a flight recorder of the last `size` events. Idempotent
+        for an unchanged size: re-arming (e.g. the annotation resolving to
+        the same ring the SIDDHI_TPU_FLIGHT env already applied) must not
+        allocate a second arena and discard the recorded history."""
+        if self.flight is not None and self.flight.size == int(size):
+            return
+        from siddhi_tpu.observability.flight import FlightRecorder
+
+        self.flight = FlightRecorder(self.schema, self.interner, size)
+
+    def describe_state(self) -> dict:
+        """Cheap live-state snapshot (no device reads): queue depth, wiring,
+        async worker health, fused/pipeline engagement, flight ring."""
+        d: dict = {
+            "queue_depth": self.queued(),
+            "subscribers": list(self.subscriber_names),
+            "callbacks": len(self.stream_callbacks),
+            "batch_size": self.batch_size,
+        }
+        if self.is_async:
+            workers = getattr(self, "_workers", [])
+            d["async"] = {
+                "workers": len(workers),
+                "workers_alive": sum(1 for t in workers if t.is_alive()),
+                "native_ring": getattr(self, "_ring", None) is not None,
+            }
+        if self.fault_policy is not None:
+            d["on_error"] = self.fault_policy
+        fi = self.fused_ingest
+        if fi is not None:
+            d["pipeline"] = fi.describe_state()
+        if self.flight is not None:
+            d["flight"] = self.flight.describe_state()
+        return d
 
     def subscribe(self, fn: Subscriber, name: str | None = None) -> None:
         """`name` labels this subscriber in error attribution and trace spans
@@ -268,6 +309,9 @@ class StreamJunction:
     def publish_batch(self, batch: EventBatch, now: int) -> None:
         """Fan a device batch out to all subscribers (already this stream's schema)."""
         with self.lock:
+            fl = self.flight
+            if fl is not None:
+                fl.record_batch(batch)
             n_valid = -1
             if self.on_publish_stats is not None:
                 n_valid = int(np.asarray(batch.valid).sum())
@@ -436,10 +480,23 @@ class StreamJunction:
                 return False
             # replay re-injects through the input handler, i.e. as CURRENT
             # events; EXPIRED rows are recorded for inspection all the same
-            store.store(make_entry(
+            entry = make_entry(
                 self.app_name, ORIGIN_STREAM, self.schema.stream_id, exc,
                 events=[(ts, tuple(d)) for ts, _k, d in events],
-            ))
+            )
+            if self.flight is not None:
+                # black-box dump: the last-N events through this junction
+                # BEFORE the failure, decoded host-side (the failing batch's
+                # own rows are already in the ring — it was recorded at
+                # publish time)
+                try:
+                    entry.flight = self.flight.events()
+                except Exception:
+                    log.exception(
+                        "stream '%s': flight-recorder dump failed",
+                        self.schema.stream_id,
+                    )
+            store.store(entry)
             return True
         return False
 

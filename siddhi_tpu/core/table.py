@@ -233,6 +233,29 @@ class InMemoryTable:
     # currently-unique keys; duplicates fall back to the dense compare).
     _indexed_cols: tuple = ()
 
+    def describe_state(self) -> dict:
+        """Introspection: live row count, capacity, index wiring (see
+        observability/introspect.py). One host read per call."""
+        import numpy as np
+
+        d: dict = {
+            "capacity": self.capacity,
+            "primary_keys": list(self.primary_keys),
+            "indexes": list(self._indexed_cols),
+            "record_store": self.record_store is not None,
+        }
+        from siddhi_tpu.observability.introspect import device_reads_ok
+
+        if not device_reads_ok():
+            d["rows"] = None  # degraded relay: one d2h would poison dispatch
+            return d
+        try:
+            with self.lock:
+                d["rows"] = int(np.asarray(self.state["valid"]).sum())
+        except Exception:
+            d["rows"] = None  # mid-dispatch buffer churn: degrade
+        return d
+
     @property
     def _pk_indexed(self) -> bool:
         return (

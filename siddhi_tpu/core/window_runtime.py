@@ -57,6 +57,13 @@ class NamedWindow:
         self.timer_target = None
         self._step = jax.jit(self._step_impl)
 
+    def describe_state(self) -> dict:
+        """Introspection: the shared buffer's type/fill/capacity plus this
+        runtime's wiring (see observability/introspect.py)."""
+        d = self.stage.describe_state(self.state)
+        d["output_events"] = self.out_events
+        return d
+
     # findable protocol (shared with InMemoryTable)
     @property
     def table_id(self) -> str:

@@ -82,6 +82,42 @@ class WindowStage:
         buffer, query/processor/stream/window/LengthWindowProcessor.java:144)."""
         raise NotImplementedError(f"{type(self).__name__} is not findable")
 
+    def describe_state(self, state) -> dict:
+        """Introspection snapshot of the live buffer: type, fill, capacity,
+        oldest/newest stored timestamps. Pull-only (one host read per call);
+        rides `view()` so every findable window gets it for free."""
+        import numpy as np
+
+        d: dict = {"type": type(self).__name__}
+        cap = getattr(self, "w", None)
+        if cap is not None:
+            d["capacity"] = int(cap)
+        dur = getattr(self, "t", None)
+        if dur is not None:
+            d["duration_ms"] = int(dur)
+        from siddhi_tpu.observability.introspect import device_reads_ok
+
+        if not device_reads_ok():
+            d["fill"] = None  # degraded relay: one d2h would poison dispatch
+            return d
+        try:
+            _cols, ts, mask = self.view(state)
+            m = np.asarray(mask)
+        except NotImplementedError:
+            return d
+        except Exception:
+            # a concurrent donated-state dispatch (fused ingest) can delete
+            # the buffers under us; introspection degrades, never raises
+            d["fill"] = None
+            return d
+        fill = int(m.sum())
+        d["fill"] = fill
+        if fill:
+            lived = np.asarray(ts)[m]
+            d["oldest_ts"] = int(lived.min())
+            d["newest_ts"] = int(lived.max())
+        return d
+
 
 # ---------------------------------------------------------------------------
 # sliding family: length / time / timeLength / externalTime / delay

@@ -1,9 +1,13 @@
-"""siddhi_tpu.observability — engine-wide metrics, exposition, and tracing.
+"""siddhi_tpu.observability — metrics, exposition, tracing, introspection.
 
 Histogram metrics (log-bucketed p50/p95/p99/p999 + EWMA rates), a pluggable
 reporter SPI with console/log/JSON-lines/Prometheus exposition, sampled
-event tracing across junction -> query -> sink, and device-budget profiling
-hooks (dispatch step time, h2d wire traffic, truth-sync stalls).
+event tracing across junction -> query -> sink, device-budget profiling
+hooks (dispatch step time, h2d wire traffic, truth-sync stalls), and the
+self-observation layer: per-component state introspection
+(`snapshot_status()` / `/status.json`, introspect.py), the CEP-native
+`@app:selfmon` SelfMonitorStream feed (selfmon.py), and per-junction
+flight recorders (`@flightRecorder` / `/flight`, flight.py).
 
 `siddhi_tpu.core.statistics` is a back-compat shim over this package.
 """
@@ -29,6 +33,12 @@ from siddhi_tpu.observability.reporters import (  # noqa: F401
     render_prometheus,
 )
 from siddhi_tpu.observability.tracing import Tracer  # noqa: F401
+from siddhi_tpu.observability.flight import FlightRecorder  # noqa: F401
+from siddhi_tpu.observability.introspect import render_status  # noqa: F401
+from siddhi_tpu.observability.selfmon import (  # noqa: F401
+    SELFMON_STREAM_ID,
+    SelfMonitor,
+)
 
 __all__ = [
     "LogHistogram",
@@ -46,4 +56,8 @@ __all__ = [
     "render_prometheus",
     "timed",
     "Tracer",
+    "FlightRecorder",
+    "render_status",
+    "SELFMON_STREAM_ID",
+    "SelfMonitor",
 ]

@@ -1,10 +1,14 @@
-"""Metrics exposition endpoint: a tiny stdlib `http.server` serving every
-app registered on a SiddhiManager.
+"""Metrics + introspection endpoint: a tiny stdlib `http.server` serving
+every app registered on a SiddhiManager.
 
 Routes:
   /metrics        Prometheus text format (version 0.0.4) — scrape this
   /metrics.json   the raw report() dicts, one per app
   /traces         sampled trace spans per app (JSON)
+  /status         live engine state, human-readable text
+  /status.json    live engine state (junction queue depths, window fills,
+                  NFA instance counts, pipeline occupancy, error store)
+  /flight         flight-recorder rings per app/stream (JSON)
 
 Started by `manager.serve_metrics(port)` (idempotent; port 0 picks an
 ephemeral port and returns it). No dependency beyond the stdlib — the
@@ -43,13 +47,27 @@ class MetricsServer:
                             outer._traces(), default=str
                         ).encode()
                         ctype = "application/json"
+                    elif path == "/status":
+                        body = outer.manager.status_text().encode()
+                        ctype = "text/plain; charset=utf-8"
+                    elif path == "/status.json":
+                        body = json.dumps(
+                            outer.manager.snapshot_status(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/flight":
+                        body = json.dumps(
+                            outer.manager.flight_records(), default=str
+                        ).encode()
+                        ctype = "application/json"
                     else:
                         self.send_error(404)
                         return
                 except Exception as e:  # a bad metric must not 500 forever
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(str(e).encode())
+                    # send_error writes a complete, Content-Length-framed
+                    # response; the previous raw write after end_headers()
+                    # left keep-alive scrapers waiting on an unframed body
+                    self.send_error(500, explain=str(e))
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)

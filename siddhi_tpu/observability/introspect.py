@@ -1,0 +1,86 @@
+"""State introspection: aggregate `describe_state()` hooks into one status.
+
+Every stateful runtime component grows a cheap, pull-only `describe_state()
+-> dict` (junction queue depth and subscriber health, window type/fill/
+capacity and oldest/newest timestamps, NFA active-instance counts per state
+and within-clause deadlines, aggregation bucket counts and watermarks,
+table row counts and index info, ingest-pipeline depth/occupancy/slots in
+flight, error-store depth). `SiddhiAppRuntime.snapshot_status()` walks
+them; `SiddhiManager.snapshot_status()` adds the shared error store; the
+`MetricsServer` serves both as `/status` (human text) and `/status.json`.
+
+The hooks are PULL-only: nothing is collected, sampled, or scheduled until
+a caller asks, so the hot dispatch path cost of the whole subsystem is
+zero. Reads that touch device state (window fills, table occupancy, NFA
+token pulls) do one host transfer per component — an on-demand operator
+action, not a steady cost. EXCEPT on transfer-degraded relay backends
+(utils/backend.transfer_degrades_dispatch), where the FIRST device->host
+read from any thread permanently degrades every later dispatch: there the
+device-touching fields degrade to None (`device_reads_ok()`), and an
+operator who accepts the cost opts back in with
+SIDDHI_TPU_STATUS_DEVICE=1.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def device_reads_ok() -> bool:
+    """May an introspection pull read device state back to the host?
+
+    False only on transfer-degraded relay backends (where one d2h read
+    permanently poisons dispatch latency) without the explicit
+    SIDDHI_TPU_STATUS_DEVICE=1 opt-in. The component describe_state()
+    implementations consult this and report None for device-derived fields
+    (window fill, table rows, NFA instance counts, aggregation buckets)
+    instead of paying the read.
+    """
+    if os.environ.get("SIDDHI_TPU_STATUS_DEVICE", "").strip() == "1":
+        return True
+    from siddhi_tpu.utils.backend import transfer_degrades_dispatch
+
+    return not transfer_degrades_dispatch()
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    return str(v)
+
+
+def _render_component(lines: list, name: str, d: dict, indent: str) -> None:
+    flat = {k: v for k, v in d.items() if not isinstance(v, dict)}
+    nested = {k: v for k, v in d.items() if isinstance(v, dict)}
+    body = ", ".join(f"{k}={_fmt(v)}" for k, v in flat.items())
+    lines.append(f"{indent}{name}: {body}" if body else f"{indent}{name}:")
+    for k, sub in nested.items():
+        _render_component(lines, k, sub, indent + "  ")
+
+
+def render_status(status: dict) -> str:
+    """Human-readable rendering of a manager/runtime status snapshot (the
+    `/status` endpoint body)."""
+    lines: list[str] = []
+    apps = status.get("apps")
+    if apps is None:  # a single runtime's snapshot
+        apps = {status.get("app", "app"): status}
+    for name, app in apps.items():
+        running = "running" if app.get("running") else "stopped"
+        lines.append(f"app {name} [{running}]")
+        for section in (
+            "streams", "queries", "windows", "tables", "aggregations",
+        ):
+            comps = app.get(section) or {}
+            if not comps:
+                continue
+            lines.append(f"  {section}:")
+            for cid, d in comps.items():
+                _render_component(lines, cid, d, "    ")
+        sm = app.get("selfmon")
+        if sm:
+            _render_component(lines, "selfmon", sm, "  ")
+    es = status.get("error_store")
+    if es:
+        _render_component(lines, "error_store", es, "")
+    return "\n".join(lines) + "\n"

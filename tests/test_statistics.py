@@ -7,6 +7,7 @@ plus the engine-specific additions (siddhi_tpu/observability/)."""
 import json
 import re
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -503,6 +504,95 @@ class TestMetricsEndpoint:
         assert tr["SiddhiApp"], "sampled traces must be served"
         mgr.shutdown()  # also stops the endpoint
         assert mgr.metrics_port is None
+
+    def test_unknown_path_is_404(self):
+        mgr = SiddhiManager()
+        _mk_app(mgr).start()
+        port = mgr.serve_metrics(0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=5
+            )
+        assert ei.value.code == 404
+        ei.value.read()  # framed body: the connection is not left hanging
+        mgr.shutdown()
+
+    def test_500_response_is_framed(self):
+        # satellite: the old handler wrote a raw body after end_headers()
+        # with no Content-Length, hanging keep-alive scrapers; send_error
+        # frames it. Induce a handler fault by breaking report collection.
+        mgr = SiddhiManager()
+        _mk_app(mgr).start()
+        port = mgr.serve_metrics(0)
+        broken = mgr._metrics_server
+        orig = broken._reports
+        broken._reports = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics.json", timeout=5
+                )
+            assert ei.value.code == 500
+            assert ei.value.headers.get("Content-Length") is not None
+            body = ei.value.read()
+            assert b"boom" in body
+        finally:
+            broken._reports = orig
+        # the server survives and keeps serving after the 500
+        rep = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json", timeout=5
+            ).read()
+        )
+        assert rep[0]["app"] == "SiddhiApp"
+        mgr.shutdown()
+
+    def test_concurrent_scrape_while_app_shutdown(self):
+        # scrapes racing an app shutdown must always get well-formed 200s
+        # (collection snapshots + manager-level iteration are copy-safe)
+        import threading
+
+        mgr = SiddhiManager()
+        rt = _mk_app(mgr)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(10):
+            h.send(("A", float(i * 3)))
+        port = mgr.serve_metrics(0)
+        base = f"http://127.0.0.1:{port}"
+        errors: list = []
+        stop = threading.Event()
+
+        def scrape_loop():
+            paths = ("/metrics", "/metrics.json", "/traces", "/status.json")
+            i = 0
+            while not stop.is_set():
+                try:
+                    resp = urllib.request.urlopen(
+                        base + paths[i % len(paths)], timeout=5
+                    )
+                    assert resp.status == 200
+                    resp.read()
+                except Exception as e:  # pragma: no cover - failure detail
+                    errors.append(e)
+                    return
+                i += 1
+
+        threads = [threading.Thread(target=scrape_loop) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        assert mgr.shutdown_siddhi_app_runtime("SiddhiApp")
+        time.sleep(0.1)  # keep scraping against the app-less manager
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, errors
+        # app deregistered: endpoints still serve (empty) well-formed bodies
+        assert json.loads(
+            urllib.request.urlopen(f"{base}/metrics.json", timeout=5).read()
+        ) == []
+        mgr.shutdown()
 
 
 # ---------------------------------------------------------------------------

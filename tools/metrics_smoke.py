@@ -1,11 +1,13 @@
-"""CI smoke test for the Prometheus exposition path (tier1.yml).
+"""CI smoke test for the metrics + introspection endpoint (tier1.yml).
 
 Boots a small app with `@app:statistics(reporter='prometheus')` (which makes
 the manager serve `/metrics`), drives a little traffic, scrapes the endpoint
 with curl (urllib fallback), and asserts the exposition is non-empty and
 well-formed: every sample line parses, every family is typed, and the
 acceptance families (throughput, latency quantiles, buffered depth, device
-budget) are present. Exit 0 = pass.
+budget) are present. Also scrapes `/status.json` (junction queue depth,
+window fill, pipeline occupancy must be live) and `/flight` (the flight ring
+must hold the tail of the driven traffic). Exit 0 = pass.
 """
 
 from __future__ import annotations
@@ -54,6 +56,7 @@ def main() -> int:
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime("""
     @app:statistics(reporter='prometheus', port='0', trace.sample='1.0')
+    @flightRecorder(size='16')
     define stream S (symbol string, price float);
     @info(name='q')
     from S[price > 10]#window.length(8)
@@ -99,8 +102,36 @@ def main() -> int:
     for op in ("pipeline.encode", "pipeline.h2d", "pipeline.dispatch"):
         assert f'op="{op}"' in text, f"missing pipeline stage metric {op}"
     assert rt.traces(), "trace.sample='1.0' must produce sampled traces"
+
+    # introspection endpoints: /status.json must carry live per-component
+    # state, /flight the recorded ring tail (see observability/introspect.py)
+    import json
+
+    status = json.loads(scrape(f"http://127.0.0.1:{port}/status.json"))
+    app = status["apps"]["SiddhiApp"]
+    s_state = app["streams"]["S"]
+    assert "queue_depth" in s_state, f"no junction queue depth: {s_state}"
+    assert "occupancy" in s_state.get("pipeline", {}), (
+        f"no pipeline occupancy: {s_state}"
+    )
+    q_state = app["queries"]["q"]
+    assert q_state.get("window", {}).get("fill") == 8, (
+        f"window fill must be live (expected full length(8)): {q_state}"
+    )
+    assert s_state.get("flight", {}).get("recorded") == 16, (
+        f"flight ring must be full: {s_state}"
+    )
+    flight = json.loads(scrape(f"http://127.0.0.1:{port}/flight"))
+    ring = flight["SiddhiApp"]["S"]
+    assert len(ring) == 16, f"/flight must serve the 16-event ring: {ring}"
+    status_text = scrape(f"http://127.0.0.1:{port}/status")
+    assert "app SiddhiApp" in status_text and "queue_depth" in status_text
+
     mgr.shutdown()
-    print(f"metrics smoke OK: {samples} samples, {len(typed)} families")
+    print(
+        f"metrics smoke OK: {samples} samples, {len(typed)} families, "
+        f"status + flight live"
+    )
     return 0
 
 
