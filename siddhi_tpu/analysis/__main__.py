@@ -1,11 +1,16 @@
 """SiddhiQL linter CLI.
 
     python -m siddhi_tpu.analysis app.siddhi [more.siddhi ...]
-        [--format=text|json] [--werror] [--codes]
+        [--format=text|json] [--werror] [--codes] [--explain]
 
 Exit codes: 0 clean, 1 semantic errors (or warnings under --werror),
 2 unreadable/unparsable input. Parse errors are reported as SA001 with the
 parser's line/column rather than a traceback.
+
+`--explain` renders the app's dataflow plan (the EXPLAIN half of the
+runtime's EXPLAIN ANALYZE — same graph, no live counters; see
+observability/explain.py) instead of diagnostics. Combine with
+`--format=json` for the raw node/edge plan.
 """
 
 from __future__ import annotations
@@ -36,6 +41,26 @@ def _lint_source(source: str) -> AnalysisResult:
     return analyze_app(app)
 
 
+def _explain_source(source: str, name: str, fmt: str) -> int:
+    """`--explain`: render the static dataflow plan; rc 2 on parse errors."""
+    import json
+
+    from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+    try:
+        app = SiddhiCompiler.parse(source)
+    except SiddhiParserError as exc:
+        print(f"{name}: SA001: {exc}", file=sys.stderr)
+        return 2
+    from siddhi_tpu.observability.explain import explain_static
+
+    if fmt == "json":
+        print(json.dumps(explain_static(app, fmt="dict"), default=str))
+    else:
+        print(explain_static(app))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m siddhi_tpu.analysis",
@@ -53,6 +78,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--codes", action="store_true",
         help="print the SA### diagnostic catalog and exit",
+    )
+    ap.add_argument(
+        "--explain", action="store_true",
+        help="render the app's dataflow plan (static EXPLAIN) instead of "
+        "diagnostics",
     )
     args = ap.parse_args(argv)
 
@@ -73,8 +103,11 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{path}: cannot read: {exc}", file=sys.stderr)
             worst = max(worst, 2)
             continue
-        result = _lint_source(source)
         name = "<stdin>" if path == "-" else path
+        if args.explain:
+            worst = max(worst, _explain_source(source, name, args.format))
+            continue
+        result = _lint_source(source)
         if args.format == "json":
             print(result.to_json(name))
         else:

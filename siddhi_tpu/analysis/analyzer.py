@@ -76,7 +76,20 @@ def analyze(app: SiddhiApp) -> AnalysisResult:
     return AnalysisResult(diags, app_name=app.name)
 
 
-def _analyze(app: SiddhiApp, diags: list[Diagnostic]) -> None:
+def collect_flows(app: SiddhiApp) -> list[QueryFlow]:
+    """The app's query-level dataflow edges (consumed stream ids ->
+    produced stream id per query/aggregation), computed by the same pass
+    `analyze()` runs. Never raises — the EXPLAIN plan builder
+    (observability/explain.py) must render best-effort even for apps the
+    analyzer would reject (e.g. invalid partition keys, SA115)."""
+    diags: list[Diagnostic] = []
+    try:
+        return _analyze(app, diags)
+    except Exception:  # pragma: no cover - analyzer defect guard
+        return []
+
+
+def _analyze(app: SiddhiApp, diags: list[Diagnostic]) -> list[QueryFlow]:
     sym = build_symbols(app, diags)
     flows: list[QueryFlow] = []
 
@@ -104,27 +117,24 @@ def _analyze(app: SiddhiApp, diags: list[Diagnostic]) -> None:
                         "SA105", f"duplicate query name '{name}'", line, col
                     ))
 
-    unnamed = 0
+    # query/partition ids come from the ONE shared assignment the runtime
+    # uses (query_api/execution.py assign_execution_ids) so diagnostics and
+    # explain plans name exactly the queries the runtime would build
+    from siddhi_tpu.query_api.execution import assign_execution_ids
+
     inferred_targets: dict[str, list] = {}
-    n_partitions = 0
-    for elem in app.execution_elements:
-        if isinstance(elem, Query):
-            info = find_annotation(elem.annotations, "info")
-            qid = info.element("name") if info else None
-            if not qid:
-                while f"query{unnamed}" in taken:
-                    unnamed += 1
-                qid = f"query{unnamed}"
-                unnamed += 1
-            _analyze_query(elem, qid, sym, diags, inferred_targets, flows)
-        elif isinstance(elem, Partition):
+    for ent in assign_execution_ids(app):
+        if ent[0] == "query":
+            _kind, qid, q = ent
+            _analyze_query(q, qid, sym, diags, inferred_targets, flows)
+        else:
+            _kind, pid, elem, inner_ids = ent
             _analyze_partition(
-                elem, f"partition{n_partitions}", sym, diags,
-                inferred_targets, flows,
+                elem, pid, sym, diags, inferred_targets, flows, inner_ids
             )
-            n_partitions += 1
 
     check_dataflow(app, sym, flows, diags)
+    return flows
 
 
 # ---------------------------------------------------------------------------
@@ -791,6 +801,7 @@ def _analyze_partition(
     diags: list[Diagnostic],
     inferred_targets: dict,
     flows: list[QueryFlow],
+    query_ids: list,
 ) -> None:
     from siddhi_tpu.query_api.execution import (
         RangePartitionType,
@@ -798,6 +809,7 @@ def _analyze_partition(
     )
 
     checker = ExprChecker(sym, diags, query=pid)
+    keyed: set = set()  # streams this partition declares a key for
     for pt in part.partition_types:
         line, col = _loc(pt)
         schema = sym.streams.get(pt.stream_id)
@@ -808,11 +820,22 @@ def _analyze_partition(
                 line, col, query=pid,
             ))
             continue
+        keyed.add(pt.stream_id)
         pscope = AnalysisScope().add(
             pt.stream_id, dict(schema) if schema is not None else None
         )
         if isinstance(pt, ValuePartitionType):
-            checker.infer_no_agg(pt.expression, pscope)
+            t = checker.infer_no_agg(pt.expression, pscope)
+            if t is AttrType.OBJECT:
+                # runtime analog: PartitionRuntime raises 'cannot partition
+                # by OBJECT' (partition.py) — OBJECT values have no stable
+                # device key encoding
+                diags.append(Diagnostic(
+                    "SA115",
+                    f"partition key over stream '{pt.stream_id}' is "
+                    "OBJECT-typed: OBJECT values cannot be partition keys",
+                    line, col, query=pid,
+                ))
         elif isinstance(pt, RangePartitionType):
             for rng in pt.ranges:
                 t = checker.infer_no_agg(rng.condition, pscope)
@@ -826,11 +849,8 @@ def _analyze_partition(
                     ))
 
     inner_schemas: dict[str, Optional[dict]] = {}
-    unnamed = 0
-    for q in part.queries:
-        info = find_annotation(q.annotations, "info")
-        qid = (info.element("name") if info else None) or f"{pid}_query{unnamed}"
-        unnamed += 1
+    for qid, q in query_ids:
+        _check_partition_keys(q, qid, keyed, sym, diags)
         out_attrs = _analyze_query(
             q, qid, sym, diags, inferred_targets, flows,
             inner_schemas=inner_schemas, inner_ns=pid,
@@ -842,3 +862,36 @@ def _analyze_partition(
                 if out_attrs is not None
                 else None
             )
+
+
+def _check_partition_keys(
+    query: Query,
+    qid: str,
+    keyed: set,
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+) -> None:
+    """SA115: every OUTER stream a partitioned query consumes must have a
+    partition key declared (`partition with (expr of Stream, ...)`) — the
+    runtime has no way to route its events to a partition slot and raises
+    'partition has no key for stream' at creation (partition.py). Inner
+    `#streams` arrive already partition-shaped and need no key."""
+    stream = query.input_stream
+    atoms: list[SingleInputStream] = []
+    if isinstance(stream, SingleInputStream):
+        atoms = [stream]
+    elif isinstance(stream, JoinInputStream):
+        atoms = [stream.left, stream.right]
+    elif isinstance(stream, StateInputStream):
+        atoms = list(iter_state_streams(stream.state))
+    for s in atoms:
+        sid = s.stream_id
+        if s.is_inner or sid in keyed or sid not in sym.streams:
+            continue  # inner/keyed are fine; undefined is SA101's job
+        line, col = _loc(s)
+        diags.append(Diagnostic(
+            "SA115",
+            f"partition has no key for stream '{sid}': declare one with "
+            f"`partition with (<expr> of {sid}, ...)`",
+            line, col, query=qid,
+        ))

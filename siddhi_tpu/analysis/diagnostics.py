@@ -36,6 +36,9 @@ CODES: dict[str, str] = {
     "SA112": "invalid @pipeline annotation (unknown key / bad depth / bad disable)",
     "SA113": "invalid @app:selfmon annotation (bad interval / unknown key / reserved stream name)",
     "SA114": "invalid @flightRecorder annotation (bad size / unknown key)",
+    "SA115": "invalid partition key (OBJECT-typed key expression, or a "
+             "partitioned query consumes a stream the partition declares "
+             "no key for)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

@@ -34,7 +34,6 @@ from siddhi_tpu.query_api.execution import (
     InsertIntoStream,
     JoinInputStream,
     OutputEventsFor,
-    Partition,
     Query,
     SingleInputStream,
     StateInputStream,
@@ -226,6 +225,10 @@ class SiddhiAppRuntime:
                 j.device_stats = sm.junction_device_stats(f"stream.{sid}")
                 # pipelined-ingest stage budget + occupancy overlap gauge
                 j.pipeline_stats = sm.pipeline_stats(f"stream.{sid}")
+                # continuous profiler: chunk waterfalls + compile telemetry
+                # for the fused chunk program (observability/profiler.py)
+                j.profiler = sm.profiler
+                j.compile_telemetry = sm.compile_telemetry
 
         # @app:selfmon(interval='5 sec'): CEP-native self-monitoring — inject
         # the SelfMonitorStream system schema (runtime-side only: the user's
@@ -439,37 +442,20 @@ class SiddhiAppRuntime:
                 )
 
         from siddhi_tpu.core.partition import PartitionRuntime
+        from siddhi_tpu.query_api.execution import assign_execution_ids
 
+        # query/partition ids come from the ONE shared assignment (auto-ids
+        # must not collide with explicit @info names anywhere in the app;
+        # the analyzer and the EXPLAIN plan builder use the same helper)
         self.partitions: list[PartitionRuntime] = []
-        # auto-ids must not collide with explicit @info names elsewhere in
-        # the app (e.g. two unnamed queries before one named 'query1'),
-        # including names on queries INSIDE partitions
-        taken = set()
-        for elem in app.execution_elements:
-            inner = (
-                [elem]
-                if isinstance(elem, Query)
-                else list(getattr(elem, "queries", []) or [])
-            )
-            for q in inner:
-                info = find_annotation(q.annotations, "info")
-                name = info.element("name") if info else None
-                if name:
-                    taken.add(name)
-        unnamed = 0
-        for elem in app.execution_elements:
-            if isinstance(elem, Query):
-                info = find_annotation(elem.annotations, "info")
-                qid = info.element("name") if info else None
-                if not qid:
-                    while f"query{unnamed}" in taken:
-                        unnamed += 1
-                    qid = f"query{unnamed}"
-                    unnamed += 1
-                self._add_query(qid, elem)
-            elif isinstance(elem, Partition):
+        for ent in assign_execution_ids(app):
+            if ent[0] == "query":
+                _kind, qid, q = ent
+                self._add_query(qid, q)
+            else:
+                _kind, pid, elem, inner_ids = ent
                 self.partitions.append(
-                    PartitionRuntime(elem, self, f"partition{len(self.partitions)}")
+                    PartitionRuntime(elem, self, pid, query_ids=inner_ids)
                 )
 
     # ---- assembly --------------------------------------------------------
@@ -561,6 +547,10 @@ class SiddhiAppRuntime:
         qr.sync_stall_tracker = sm.device_time_tracker(
             f"query.{qid}", "sync_stall"
         )
+        # compile telemetry + waterfall sub-stage attribution for the
+        # per-batch jitted step (observability/profiler.py)
+        qr.compile_telemetry = sm.compile_telemetry
+        qr.profiler = sm.profiler
         return sm.latency_tracker(f"query.{qid}")
 
     def _timer_batch(self, schema: StreamSchema, t_ms: int) -> EventBatch:
@@ -1015,6 +1005,29 @@ class SiddhiAppRuntime:
         dict of spans crossing ingress junction -> query -> sink. Empty when
         `@app:statistics(trace.sample=...)` is not configured."""
         return self.tracer.traces() if self.tracer is not None else []
+
+    # ---- EXPLAIN ANALYZE + profiling (observability/explain.py,
+    # observability/profiler.py) --------------------------------------------
+
+    def explain(self, fmt: str = "text"):
+        """The app's dataflow plan annotated with live counters (events
+        in/out, selectivity, latency, device-time share, compile ledger) —
+        EXPLAIN ANALYZE for the running app. fmt='text' renders; 'dict'/
+        'json' returns the raw plan. Works without `@app:statistics` too
+        (topology only, no counters)."""
+        from siddhi_tpu.observability.explain import explain
+
+        return explain(self, fmt=fmt)
+
+    def explain_plan(self) -> dict:
+        """`explain(fmt='dict')` — the raw node/edge plan."""
+        return self.explain(fmt="dict")
+
+    def profile_report(self) -> dict:
+        """Compile telemetry + slowest-chunk waterfalls + high latency
+        quantiles (`/profile` payload); None without `@app:statistics`."""
+        sm = self.statistics_manager
+        return sm.profile_report() if sm is not None else None
 
     # ---- state introspection (observability/introspect.py) ----------------
 

@@ -116,6 +116,11 @@ class FusedJunctionIngest:
         self._send_lock = threading.Lock()
         self._sender = None  # thread holding _send_lock (re-entrancy guard)
         self._prewarmed = False
+        # compile-telemetry cause hints for the NEXT compiling dispatch,
+        # keyed per program mode (deliver bool): a full-width rebuild
+        # invalidates BOTH programs, and each must attribute its own
+        # rebuild compile (tail-variant hints are computed per call)
+        self._cause_hints: dict = {}
         ps = getattr(junction, "pipeline_stats", None)
         if ps is not None:
             ps.depth = self.pipeline_depth if self.pipeline_enabled else 0
@@ -388,6 +393,12 @@ class FusedJunctionIngest:
             return False  # int32 ts-delta wire can't span >24 days per call
         with self._lock:
             if deliver and getattr(self, "_deliver_set", None) != dset:
+                if self._fused_deliver is not None:
+                    from siddhi_tpu.observability.profiler import (
+                        CAUSE_DELIVER_SET,
+                    )
+
+                    self._cause_hints[True] = CAUSE_DELIVER_SET
                 self._fused_deliver = None  # callback set changed: rebuild
             if (self._fused_deliver if deliver else self._fused) is None:
                 try:
@@ -505,11 +516,17 @@ class FusedJunctionIngest:
             encode, _decode, _nb = self.junction.schema.wire_codec(
                 self.junction.batch_size, self._keep, {}
             )
+            from siddhi_tpu.observability.profiler import CAUSE_FULL_WIDTH
+
+            # both programs were discarded: each mode's next compile is
+            # rebuild-caused
+            self._cause_hints[False] = CAUSE_FULL_WIDTH
+            self._cause_hints[True] = CAUSE_FULL_WIDTH
         return prog, encode
 
     def _dispatch_chunk(
         self, prog, wire, counts, bases, now, ds, tracked, tr, stream_span,
-        ps=None,
+        ps=None, wf=None, deliver=False,
     ):
         """One donated-state dispatch under the app lock: collect states,
         run the program, write back, publish stats, surface aux flags.
@@ -536,9 +553,13 @@ class FusedJunctionIngest:
                 if tr is not None
                 else None
             )
+            ct = self.junction.compile_telemetry
             t0 = (
                 time.perf_counter_ns()
-                if (ds is not None or tracked or ps is not None)
+                if (
+                    ds is not None or tracked or ps is not None
+                    or ct is not None or wf is not None
+                )
                 else 0
             )
             try:
@@ -556,6 +577,28 @@ class FusedJunctionIngest:
                         ds.h2d_chunks.add(1)
                     if ps is not None:
                         ps.dispatch.record_ns(dt)
+                    if wf is not None:
+                        wf.stage("dispatch", dt)
+                    if ct is not None:
+                        # fused compile telemetry: the chunk program retraces
+                        # per (K, wire width); rebuild paths leave a cause
+                        # hint, short tails are tail-variant compiles
+                        K = int(counts.shape[0])
+                        hint = self._cause_hints.pop(deliver, None)
+                        if hint is None and K < self.K:
+                            from siddhi_tpu.observability.profiler import (
+                                CAUSE_TAIL_K,
+                            )
+
+                            hint = CAUSE_TAIL_K
+                        ct.observe(
+                            "stream.{}.fused{}".format(
+                                self.junction.schema.stream_id,
+                                "_deliver" if deliver else "",
+                            ),
+                            prog, (K, int(wire.shape[1])), dt,
+                            cause_hint=hint,
+                        )
             except Exception as e:
                 # the call donated the state buffers: they are gone either
                 # way, so reset to fresh state (lazily re-initialized on
@@ -602,11 +645,18 @@ class FusedJunctionIngest:
         """The fully serial chunk loop (@pipeline(disable='true') or a
         drain-worker re-entrant send): encode, dispatch, and drain the
         previous chunk's outputs on the calling thread, in order."""
+        prof = self.junction.profiler
         pending_drain = None  # previous chunk's packs, drained one chunk late
         c_off = 0
         while c_off < n:
             K = self._chunk_K(-(-(n - c_off) // B))
             c_end = min(c_off + K * B, n)
+            wf = (
+                prof.begin(self.junction.schema.stream_id, c_end - c_off)
+                if prof is not None
+                else None
+            )
+            t_enc = time.perf_counter_ns() if wf is not None else 0
             try:
                 wire, counts, bases = self._encode_chunk(
                     encode, ts_arr, cols, c_off, c_end, B, K
@@ -638,9 +688,12 @@ class FusedJunctionIngest:
                 wire, counts, bases = self._encode_chunk(
                     encode, ts_arr, cols, c_off, c_end, B, K
                 )
+            if wf is not None:
+                wf.stage("encode", time.perf_counter_ns() - t_enc)
 
             packs, _completion = self._dispatch_chunk(
-                prog, wire, counts, bases, now, ds, tracked, tr, stream_span
+                prog, wire, counts, bases, now, ds, tracked, tr, stream_span,
+                wf=wf, deliver=deliver,
             )
             if packs is not None and deliver:
                 # drain the PREVIOUS chunk now that this chunk's device work
@@ -648,19 +701,24 @@ class FusedJunctionIngest:
                 # callbacks still fire in order before send_columns returns
                 if pending_drain is not None:
                     self._drain_guarded(*pending_drain)
-                pending_drain = (packs, K)
+                if wf is not None:
+                    wf.t_mark = time.perf_counter_ns()
+                pending_drain = (packs, K, wf)
+            else:
+                if prof is not None:
+                    prof.end(wf)
             c_off = c_end
         if pending_drain is not None:
             self._drain_guarded(*pending_drain)
         return True
 
-    def _drain_guarded(self, packs, K: int) -> None:
+    def _drain_guarded(self, packs, K: int, wf=None) -> None:
         """Drain with the junction's failure machinery owning callback
         errors (same contract on every ingest path — per-batch dispatch,
         @async workers, pipelined drain): guarded junctions route the
         failure, unguarded ones re-raise to the sender."""
         try:
-            self._drain(packs, K)
+            self._drain(packs, K, wf)
         except Exception as e:
             j = self.junction
             if j.exception_handler is None and j.fault_policy is None:
@@ -687,11 +745,11 @@ class FusedJunctionIngest:
                 c_off, n, B, ps,
             )
             while staged is not None:
-                dev_wire, counts, bases, K, slot = staged
+                dev_wire, counts, bases, K, slot, wf = staged
                 staged = None
                 packs, completion = self._dispatch_chunk(
                     prog, dev_wire, counts, bases, now, ds, tracked, tr,
-                    stream_span, ps,
+                    stream_span, ps, wf=wf, deliver=deliver,
                 )
                 pl.retire(slot, completion)
                 dispatched = True
@@ -699,7 +757,13 @@ class FusedJunctionIngest:
                     # hand the packs to the drain worker BEFORE staging the
                     # next chunk: nothing downstream can lose them, and the
                     # worker's readback+decode overlaps the encode below
-                    pl.submit(packs, K)
+                    if wf is not None:
+                        wf.t_mark = time.perf_counter_ns()
+                    pl.submit(packs, K, wf)
+                elif wf is not None:
+                    prof = self.junction.profiler
+                    if prof is not None:
+                        prof.end(wf)
                 if deliver and pl.pending_error():
                     # an unguarded delivery failure is waiting at the
                     # barrier: stop ingesting further chunks, like the
@@ -741,13 +805,19 @@ class FusedJunctionIngest:
         self, pl, prog, encode, deliver, dset, ts_arr, cols, c_off, n, B, ps
     ):
         """Encode the next chunk into a pooled wire buffer and start its
-        async h2d transfer. Returns ((dev_wire, counts, bases, K, slot),
+        async h2d transfer. Returns ((dev_wire, counts, bases, K, slot, wf),
         next_off, prog, encode) — prog/encode may have been swapped by a
         full-width rebuild on a narrow-wire misfit; the caller must
         pl.retire(slot, ...) once the chunk's dispatch is submitted."""
         K = self._chunk_K(-(-(n - c_off) // B))
         c_end = min(c_off + K * B, n)
-        t0 = time.perf_counter_ns() if ps is not None else 0
+        prof = self.junction.profiler
+        wf = (
+            prof.begin(self.junction.schema.stream_id, c_end - c_off)
+            if prof is not None
+            else None
+        )
+        t0 = time.perf_counter_ns() if (ps is not None or wf is not None) else 0
         try:
             slot = pl.acquire(K, self._wire_bytes)
             wire, counts, bases = self._encode_chunk(
@@ -774,12 +844,20 @@ class FusedJunctionIngest:
                 encode, ts_arr, cols, c_off, c_end, B, K, out=slot.buf
             )
         if t0:
-            ps.encode.record_ns(time.perf_counter_ns() - t0)
+            dt = time.perf_counter_ns() - t0
+            if ps is not None:
+                ps.encode.record_ns(dt)
+            if wf is not None:
+                wf.stage("encode", dt)
             t0 = time.perf_counter_ns()
         dev_wire = pl.ship(slot)
         if t0:
-            ps.h2d.record_ns(time.perf_counter_ns() - t0)
-        return (dev_wire, counts, bases, K, slot), c_end, prog, encode
+            dt = time.perf_counter_ns() - t0
+            if ps is not None:
+                ps.h2d.record_ns(dt)
+            if wf is not None:
+                wf.stage("h2d", dt)
+        return (dev_wire, counts, bases, K, slot, wf), c_end, prog, encode
 
     def _prewarm_tail(self, prog, now: int) -> None:
         """Opt-in (SIDDHI_TPU_PREWARM_TAIL=1): compile the smallest tail
@@ -845,13 +923,19 @@ class FusedJunctionIngest:
             return out, counts, bases  # [K, bytes]
         return np.stack(bufs), counts, bases  # [K, bytes]
 
-    def _drain(self, packs, K: int) -> None:
+    def _drain(self, packs, K: int, wf=None) -> None:
         """Deliver one chunk's packed outputs to query callbacks: one counts
         readback + one sliced transfer per endpoint-with-callbacks, then a
         vectorized host decode, preserving per-micro-batch callback grouping
         (reference: QueryCallback.receive per chunk,
         query/output/callback/QueryCallback.java:52-105). `K` is the chunk's
-        batch count (variable: short tails ride smaller-K programs)."""
+        batch count (variable: short tails ride smaller-K programs).
+
+        With a waterfall `wf` (observability/profiler.py), the drain
+        attributes its spans: `queue` (dispatch-submit to drain-start),
+        `device` (the FIRST blocking readback, dominated by waiting for the
+        program), `readback` (top-up transfers), `deliver` (decode +
+        callback wall), then closes the chunk's record."""
         import jax
 
         from siddhi_tpu.core.event import (
@@ -864,6 +948,14 @@ class FusedJunctionIngest:
         if not hasattr(self, "_drain_guess"):
             self._drain_guess = {}
         ds = self.junction.device_stats
+        wf_get_ns = 0  # device+readback spans, excluded from 'deliver'
+        first_get = True
+        t_drain0 = 0
+        if wf is not None:
+            t_drain0 = time.perf_counter_ns()
+            if wf.t_mark:
+                wf.stage("queue", t_drain0 - wf.t_mark)
+                wf.t_mark = 0
         # packs align with the endpoints the program was built to deliver
         for i, pack in zip(self._deliver_idx, packs):
             qr = self.endpoints[i].qr
@@ -884,12 +976,25 @@ class FusedJunctionIngest:
             # ascontiguousarray: this backend's device_get can hand back a
             # strided view of the device-layout buffer for some slice sizes,
             # and the .view(dtype) reinterprets below require dense bytes
-            t0 = time.perf_counter_ns() if ds is not None else 0
+            t0 = (
+                time.perf_counter_ns()
+                if (ds is not None or wf is not None)
+                else 0
+            )
             head = np.ascontiguousarray(
                 jax.device_get(pack["buf"][: hdr_rows + guess])
             )
             if t0:
-                ds.sync_stall.record_ns(time.perf_counter_ns() - t0)
+                dt = time.perf_counter_ns() - t0
+                if ds is not None:
+                    ds.sync_stall.record_ns(dt)
+                if wf is not None:
+                    # the first blocking readback waits for the program:
+                    # that's the chunk's device span; later ones are pure
+                    # readback
+                    wf.stage("device" if first_get else "readback", dt)
+                    first_get = False
+                    wf_get_ns += dt
             cnts = head[:hdr_rows].reshape(-1)[: 4 * K].view(np.int32)
             total = int(cnts.sum())
             self._drain_guess[i] = max(total, 1)
@@ -899,14 +1004,24 @@ class FusedJunctionIngest:
             if L <= guess:
                 host = head[hdr_rows:]
             else:
-                t0 = time.perf_counter_ns() if ds is not None else 0
+                t0 = (
+                    time.perf_counter_ns()
+                    if (ds is not None or wf is not None)
+                    else 0
+                )
                 tail = np.ascontiguousarray(
                     jax.device_get(
                         pack["buf"][hdr_rows + guess : hdr_rows + L]
                     )
                 )
                 if t0:
-                    ds.sync_stall.record_ns(time.perf_counter_ns() - t0)
+                    dt = time.perf_counter_ns() - t0
+                    if ds is not None:
+                        ds.sync_stall.record_ns(dt)
+                    if wf is not None:
+                        wf.stage("readback", dt)
+                        first_get = False
+                        wf_get_ns += dt
                 host = np.concatenate([head[hdr_rows:], tail])
             lanes = {}
             for name, dt, off in layout:
@@ -972,6 +1087,15 @@ class FusedJunctionIngest:
                     ts = seg[-1][0]
                     for cb in qr.query_callbacks:
                         cb(ts, ins or None, removed or None)
+        if wf is not None:
+            # deliver = the drain wall minus the blocking readbacks
+            wf.stage(
+                "deliver",
+                time.perf_counter_ns() - t_drain0 - wf_get_ns,
+            )
+            prof = self.junction.profiler
+            if prof is not None:
+                prof.end(wf)
 
     def _probe_aux_keys(self, i: int) -> list:
         """Sorted non-timer aux keys for endpoint i, discovered by tracing

@@ -506,9 +506,21 @@ class JoinQueryRuntime(BaseQueryRuntime):
             if self.state is None:
                 self.state = self._fresh(self.init_state())
             tstates = self._collect_table_states()
+            timed = self._need_step_clock()
+            if timed:
+                import time as _time
+
+                t0 = _time.perf_counter_ns()
             self.state, tstates, out, aux = self._steps[side](
                 self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)
             )
+            if timed:
+                # one jitted program per join side: the telemetry component
+                # embeds the side (see BaseQueryRuntime._observe_step)
+                self._observe_step(
+                    self._steps[side], (side, int(batch.ts.shape[0])),
+                    _time.perf_counter_ns() - t0,
+                )
             self._writeback_table_states(tstates)
         self._warn_aux(aux)
         return out, aux

@@ -127,8 +127,9 @@ class SiddhiManager:
     def serve_metrics(self, port: int = 9464, host: str = "127.0.0.1") -> int:
         """Serve Prometheus text (`/metrics`), raw reports (`/metrics.json`),
         sampled traces (`/traces`), live engine state (`/status`,
-        `/status.json`), and flight-recorder rings (`/flight`) for EVERY app
-        runtime registered on this manager. Idempotent: a second call
+        `/status.json`), flight-recorder rings (`/flight`), the continuous
+        profiler (`/profile`), and EXPLAIN ANALYZE plans (`/explain`,
+        `/explain.json`) for EVERY app runtime registered on this manager. Idempotent: a second call
         returns the already-bound port. Pass port=0 for an ephemeral port;
         the bound port is returned either way."""
         if self._metrics_server is not None:
@@ -173,6 +174,34 @@ class SiddhiManager:
         from siddhi_tpu.observability.reporters import render_prometheus
 
         return render_prometheus(self.observability_reports())
+
+    def profile_reports(self) -> list:
+        """One `profile_report()` dict per stats-enabled app (`/profile`):
+        compile telemetry with cause taxonomy, top-K slowest chunk
+        waterfalls, p99/p999/p9999 of every latency histogram."""
+        return [
+            rt.statistics_manager.profile_report()
+            for rt in list(self._runtimes.values())
+            if getattr(rt, "statistics_manager", None) is not None
+        ]
+
+    def explain_reports(self) -> dict:
+        """app name -> live-annotated dataflow plan (`/explain.json`)."""
+        return {
+            name: rt.explain_plan()
+            for name, rt in list(self._runtimes.items())
+        }
+
+    def explain_text(self) -> str:
+        """Rendered EXPLAIN ANALYZE for every app (`/explain`)."""
+        from siddhi_tpu.observability.explain import render_text
+
+        return (
+            "\n\n".join(
+                render_text(plan) for plan in self.explain_reports().values()
+            )
+            or "no apps registered\n"
+        )
 
     # ---- state introspection (observability/introspect.py) ----------------
 

@@ -441,7 +441,9 @@ def _flatten(outs: EventBatch) -> EventBatch:
 class PartitionRuntime:
     """Host orchestration of one `partition with (...) begin ... end` block."""
 
-    def __init__(self, partition: Partition, app_runtime, pid: str):
+    def __init__(
+        self, partition: Partition, app_runtime, pid: str, query_ids=None
+    ):
         self.partition = partition
         self.app = app_runtime
         self.pid = pid
@@ -511,13 +513,21 @@ class PartitionRuntime:
         self.inner_subscribers: dict[str, list] = {}
 
         self.queries: list[PartitionedQueryRuntime] = []
-        unnamed = 0
-        for q in partition.queries:
+        if query_ids is None:
+            # direct construction (app_runtime passes the shared
+            # assignment): fall back to the same helper for this block
             from siddhi_tpu.query_api.annotation import find_annotation
 
-            info = find_annotation(q.annotations, "info")
-            qid = (info.element("name") if info else None) or f"{pid}_query{unnamed}"
-            unnamed += 1
+            query_ids = []
+            unnamed = 0
+            for q in partition.queries:
+                info = find_annotation(q.annotations, "info")
+                qid = (
+                    info.element("name") if info else None
+                ) or f"{pid}_query{unnamed}"
+                unnamed += 1
+                query_ids.append((qid, q))
+        for qid, q in query_ids:
             self._add_query(qid, q)
 
     def _add_query(self, qid: str, query: Query) -> None:

@@ -294,16 +294,21 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 self.state = self._fresh(self.init_state(now))
             step = self._steps[stream_id]
             tstates = self._collect_table_states()
-            dt = self.device_step_tracker
-            if dt is not None:
+            timed = self._need_step_clock()
+            if timed:
                 import time as _time
 
                 t0 = _time.perf_counter_ns()
             self.state, tstates, out, aux = step(
                 self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)
             )
-            if dt is not None:
-                dt.record_ns(_time.perf_counter_ns() - t0)
+            if timed:
+                # one jitted program per pattern stream: the telemetry
+                # component embeds the stream id (see _observe_step)
+                self._observe_step(
+                    step, (stream_id, int(batch.ts.shape[0])),
+                    _time.perf_counter_ns() - t0,
+                )
             self._writeback_table_states(tstates)
         self._warn_aux(aux)
         return out, aux

@@ -269,9 +269,10 @@ class IngestPipeline:
             self._use_thread = not transfer_degrades_dispatch()
         return self._use_thread
 
-    def submit(self, packs, K: int) -> None:
-        """Queue one chunk's packed outputs for ordered delivery. Blocks
-        while `depth` chunks are already in flight (backpressure)."""
+    def submit(self, packs, K: int, wf=None) -> None:
+        """Queue one chunk's packed outputs for ordered delivery (`wf`:
+        the chunk's stage waterfall, closed by the drain). Blocks while
+        `depth` chunks are already in flight (backpressure)."""
         if self._thread_ok():
             if self._thread is None:
                 self._start_thread()
@@ -279,10 +280,10 @@ class IngestPipeline:
                 while self._inflight >= self.depth and not self._closed:
                     self._cv.wait()
                 self._inflight += 1
-            self._q.put((packs, K))
+            self._q.put((packs, K, wf))
         else:
             prev = self._pending_inline
-            self._pending_inline = (packs, K)
+            self._pending_inline = (packs, K, wf)
             if prev is not None:
                 self._drain_inline(*prev)
 
@@ -323,9 +324,9 @@ class IngestPipeline:
             item = self._q.get()
             if item is None:
                 return
-            packs, K = item
+            packs, K, wf = item
             try:
-                self._drain_one(packs, K)
+                self._drain_one(packs, K, wf)
             except Exception as exc:  # must not kill the worker
                 self._on_drain_error(exc)
             finally:
@@ -333,13 +334,13 @@ class IngestPipeline:
                     self._inflight -= 1
                     self._cv.notify_all()
 
-    def _drain_one(self, packs, K: int) -> None:
+    def _drain_one(self, packs, K: int, wf=None) -> None:
         import time
 
         ps = self.stats
         t0 = time.perf_counter_ns() if ps is not None else 0
         try:
-            self.drain_fn(packs, K)
+            self.drain_fn(packs, K, wf)
         finally:
             if t0:
                 ps.drain.record_ns(time.perf_counter_ns() - t0)
@@ -355,12 +356,12 @@ class IngestPipeline:
             return True
         return False
 
-    def _drain_inline(self, packs, K: int) -> None:
+    def _drain_inline(self, packs, K: int, wf=None) -> None:
         """Caller-thread drain (degraded-transfer backends) with the same
         error contract as the worker: guarded junctions route, unguarded
         ones re-raise to the sender."""
         try:
-            self._drain_one(packs, K)
+            self._drain_one(packs, K, wf)
         except Exception as exc:
             if not self._route_drain_error(exc):
                 raise

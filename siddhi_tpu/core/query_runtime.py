@@ -293,6 +293,11 @@ class BaseQueryRuntime:
         # are on): jitted-step dispatch time and host-blocking decode stalls
         self.device_step_tracker = None
         self.sync_stall_tracker = None
+        # continuous profiler (observability/profiler.py): compile ledger
+        # for the jitted step + waterfall sub-stage attribution; both None
+        # (one check) when statistics are off
+        self.compile_telemetry = None
+        self.profiler = None
         self.state = None
         self.tables = {}
         self.table_op = None
@@ -502,6 +507,38 @@ class BaseQueryRuntime:
                 self.query_id,
             )
 
+    def _need_step_clock(self) -> bool:
+        """One check deciding whether a receive path should time its jitted
+        step (device-budget tracker or compile telemetry wired)."""
+        return (
+            self.device_step_tracker is not None
+            or self.compile_telemetry is not None
+        )
+
+    def _observe_step(self, prog, signature, wall_ns: int) -> None:
+        """Shared step-call accounting for every receive path (single/
+        pattern/join): device-time histogram, waterfall 'device' sub-stage
+        (thread-local, set by send_columns' per-batch chunk), and compile
+        telemetry for `prog` under `query.<id>[signature]`-scoped ledgers.
+
+        `signature` must identify the PROGRAM as well as the call shape
+        when the runtime jits several (pattern per-stream steps, join
+        sides): telemetry tracks one jit cache per component, so the
+        component key embeds everything up to the batch capacity."""
+        dt = self.device_step_tracker
+        if dt is not None:
+            dt.record_ns(wall_ns)
+            prof = self.profiler
+            if prof is not None:
+                prof.tls_stage("device", wall_ns)
+        ct = self.compile_telemetry
+        if ct is not None:
+            prog_key, shape = signature
+            comp = f"query.{self.query_id}"
+            if prog_key:
+                comp += f"[{prog_key}]"
+            ct.observe(comp, prog, shape, wall_ns)
+
     def _timed_decode(self, decode, schema, out):
         """Host decode with the d2h truth-sync stall recorded: decoding a
         device batch is the blocking read that forces real completion of the
@@ -515,7 +552,13 @@ class BaseQueryRuntime:
         try:
             return decode(schema, out)
         finally:
-            st.record_ns(_time.perf_counter_ns() - t0)
+            dns = _time.perf_counter_ns() - t0
+            st.record_ns(dns)
+            prof = self.profiler
+            if prof is not None:
+                # waterfall: the blocking decode is the 'readback' sub-stage
+                # of send_columns' active per-batch chunk (if any)
+                prof.tls_stage("readback", dns)
 
     def route_output(self, out: EventBatch, now: int, decode) -> None:
         """Dispatch a step's output to query callbacks / downstream junction.
@@ -713,16 +756,23 @@ class QueryRuntime(BaseQueryRuntime):
             if self.state is None:
                 self.state = self._fresh(self.init_state())
             tstates = self._collect_table_states()
-            dt = self.device_step_tracker
-            if dt is not None:
+            timed = self._need_step_clock()
+            if timed:
                 import time as _time
 
                 t0 = _time.perf_counter_ns()
             self.state, tstates, out, aux = self._step(
                 self.state, tstates, batch, jnp.asarray(now, dtype=jnp.int64)
             )
-            if dt is not None:
-                dt.record_ns(_time.perf_counter_ns() - t0)
+            if timed:
+                # compile telemetry: the jit retraces per batch capacity
+                # (timer batches, downstream cap-64 re-publishes); a
+                # recompile at a seen capacity means the carried state
+                # pytree drifted (donation_mismatch)
+                self._observe_step(
+                    self._step, ("", int(batch.ts.shape[0])),
+                    _time.perf_counter_ns() - t0,
+                )
             self._writeback_table_states(tstates)
         self._warn_aux(aux)
         return out, aux

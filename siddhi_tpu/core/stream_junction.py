@@ -59,6 +59,11 @@ class StreamJunction:
         # pipelined-ingest stage budget (PipelineStats): encode/h2d/dispatch/
         # drain histograms + the pipeline.occupancy overlap gauge
         self.pipeline_stats = None
+        # continuous profiler (observability/profiler.py): per-chunk stage
+        # waterfalls + compile telemetry for the fused chunk program; both
+        # None (one attribute check) when statistics are off
+        self.profiler = None
+        self.compile_telemetry = None
         # flight recorder (observability.flight.FlightRecorder): bounded
         # ring of the last N events through this junction, opt-in via
         # @flightRecorder(size='N') / SIDDHI_TPU_FLIGHT=N; None = one
@@ -583,16 +588,34 @@ class InputHandler:
             return
         if numeric:
             encode, decode = j.schema.packed_codec(j.batch_size)
+            prof = j.profiler
             for ofs in range(0, n, j.batch_size):
                 end = min(ofs + j.batch_size, n)
                 m = end - ofs
+                # per-batch waterfall (observability/profiler.py): encode +
+                # dispatch walls here; the query step adds device/readback
+                # sub-stages through the profiler's thread-local context.
+                # wf is None when statistics are off/disabled (one check).
+                wf = prof.begin(j.schema.stream_id, m) if prof is not None else None
+                t0 = time.perf_counter_ns() if wf is not None else 0
                 buf = encode(
                     timestamps[ofs:end],
                     {k: v[ofs:end] for k, v in cols.items()},
                     m,
                 )
                 batch = decode(buf, np.int32(m))
-                j.publish_batch(batch, now)
+                if wf is None:
+                    j.publish_batch(batch, now)
+                    continue
+                wf.stage("encode", time.perf_counter_ns() - t0)
+                prof.tls_begin(wf)
+                t0 = time.perf_counter_ns()
+                try:
+                    j.publish_batch(batch, now)
+                finally:
+                    wf.stage("dispatch", time.perf_counter_ns() - t0)
+                    prof.tls_end()
+                    prof.end(wf)
             return
         for ofs in range(0, n, j.batch_size):
             ts_chunk = timestamps[ofs : ofs + j.batch_size]

@@ -6,8 +6,11 @@ event tracing across junction -> query -> sink, device-budget profiling
 hooks (dispatch step time, h2d wire traffic, truth-sync stalls), and the
 self-observation layer: per-component state introspection
 (`snapshot_status()` / `/status.json`, introspect.py), the CEP-native
-`@app:selfmon` SelfMonitorStream feed (selfmon.py), and per-junction
-flight recorders (`@flightRecorder` / `/flight`, flight.py).
+`@app:selfmon` SelfMonitorStream feed (selfmon.py), per-junction
+flight recorders (`@flightRecorder` / `/flight`, flight.py), the
+continuous profiler (compile telemetry + chunk waterfalls, profiler.py,
+`/profile`), and EXPLAIN ANALYZE plan rendering (explain.py,
+`runtime.explain()` / `/explain`).
 
 `siddhi_tpu.core.statistics` is a back-compat shim over this package.
 """
@@ -33,6 +36,16 @@ from siddhi_tpu.observability.reporters import (  # noqa: F401
     render_prometheus,
 )
 from siddhi_tpu.observability.tracing import Tracer  # noqa: F401
+from siddhi_tpu.observability.profiler import (  # noqa: F401
+    CompileTelemetry,
+    Profiler,
+)
+from siddhi_tpu.observability.explain import (  # noqa: F401
+    build_plan,
+    explain,
+    explain_static,
+    render_text,
+)
 from siddhi_tpu.observability.flight import FlightRecorder  # noqa: F401
 from siddhi_tpu.observability.introspect import render_status  # noqa: F401
 from siddhi_tpu.observability.selfmon import (  # noqa: F401
@@ -56,6 +69,12 @@ __all__ = [
     "render_prometheus",
     "timed",
     "Tracer",
+    "CompileTelemetry",
+    "Profiler",
+    "build_plan",
+    "explain",
+    "explain_static",
+    "render_text",
     "FlightRecorder",
     "render_status",
     "SELFMON_STREAM_ID",

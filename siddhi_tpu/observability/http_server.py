@@ -9,6 +9,11 @@ Routes:
   /status.json    live engine state (junction queue depths, window fills,
                   NFA instance counts, pipeline occupancy, error store)
   /flight         flight-recorder rings per app/stream (JSON)
+  /profile        continuous profiler: compile telemetry (count/cause/wall
+                  per program), slowest-chunk waterfalls, p99.99s (JSON)
+  /explain        EXPLAIN ANALYZE: the dataflow plan annotated with live
+                  counters, human-readable text
+  /explain.json   the raw plan dicts (nodes + edges) per app
 
 Started by `manager.serve_metrics(port)` (idempotent; port 0 picks an
 ephemeral port and returns it). No dependency beyond the stdlib — the
@@ -58,6 +63,19 @@ class MetricsServer:
                     elif path == "/flight":
                         body = json.dumps(
                             outer.manager.flight_records(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/profile":
+                        body = json.dumps(
+                            outer.manager.profile_reports(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/explain":
+                        body = outer.manager.explain_text().encode()
+                        ctype = "text/plain; charset=utf-8"
+                    elif path == "/explain.json":
+                        body = json.dumps(
+                            outer.manager.explain_reports(), default=str
                         ).encode()
                         ctype = "application/json"
                     else:

@@ -118,6 +118,15 @@ class StatisticsManager:
         # pipelined fused ingest: component -> PipelineStats (stage
         # histograms ride device_time; occupancy/depth are gauges here)
         self.pipeline: dict[str, PipelineStats] = {}
+        # continuous profiler: compile telemetry + per-chunk stage
+        # waterfalls (observability/profiler.py), gated by this registry
+        from siddhi_tpu.observability.profiler import (
+            CompileTelemetry,
+            Profiler,
+        )
+
+        self.compile_telemetry = CompileTelemetry(gate=self)
+        self.profiler = Profiler(gate=self)
         self.enabled = True
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -256,6 +265,34 @@ class StatisticsManager:
         from siddhi_tpu.observability.reporters import render_prometheus
 
         return render_prometheus([self.report()])
+
+    def profile_report(self) -> dict:
+        """The app's `/profile` payload: compile ledger per program, the
+        top-K slowest chunk waterfalls, and the high quantiles (p99/p999/
+        p9999) of every latency + device-time histogram."""
+
+        def highs(trackers) -> dict:
+            out = {}
+            for n, t in trackers:
+                h = t.hist
+                if h.count == 0:
+                    continue
+                p99, p999, p9999 = h.quantiles([0.99, 0.999, 0.9999])
+                out[n] = {
+                    "count": h.count,
+                    "p99": round(p99 / 1e6, 4),
+                    "p999": round(p999 / 1e6, 4),
+                    "p9999": round(p9999 / 1e6, 4),
+                }
+            return out
+
+        return {
+            "app": self.app_name,
+            "compile": self.compile_telemetry.report(),
+            "waterfalls": self.profiler.report(),
+            "latency_high_ms": highs(list(self.latency.items())),
+            "device_time_high_ms": highs(list(self.device_time.items())),
+        }
 
     def start_reporting(self) -> None:
         if self._thread is not None:

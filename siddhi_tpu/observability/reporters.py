@@ -141,7 +141,7 @@ _FAMILIES = {
 
 def _summary_lines(out, family, app, component, summ, **extra) -> None:
     for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"),
-                   ("0.999", "p999")):
+                   ("0.999", "p999"), ("0.9999", "p9999")):
         out.append(
             f"{family}{_labels(app=app, component=component, quantile=q, **extra)}"
             f" {summ[key]}"

@@ -404,3 +404,58 @@ class StoreQuery(SourceLocated):
     # for store insert/update/delete forms
     output_stream: Optional[OutputStream] = None
     select_expression_rows: Optional[list] = None
+
+
+def assign_execution_ids(app) -> list:
+    """THE query/partition id assignment for an app, shared by the runtime
+    (app_runtime.py + partition.py), the semantic analyzer (analysis/
+    analyzer.py), and the EXPLAIN plan builder (observability/explain.py)
+    so the three can never drift: explicit @info names are reserved
+    app-wide (including names on queries inside partitions), unnamed
+    top-level queries take the next free `queryN`, partitions number
+    `partitionM` in source order, and their unnamed inner queries take
+    `{pid}_queryK` where K counts ALL inner queries (named ones included).
+
+    Returns source-ordered entries:
+      ("query", qid, query)
+      ("partition", pid, partition, [(qid, query), ...])
+    """
+    from siddhi_tpu.query_api.annotation import find_annotation
+
+    def info_name(q):
+        info = find_annotation(q.annotations, "info")
+        return info.element("name") if info else None
+
+    taken = set()
+    for elem in app.execution_elements:
+        inner = (
+            [elem] if isinstance(elem, Query)
+            else list(getattr(elem, "queries", []) or [])
+        )
+        for q in inner:
+            name = info_name(q)
+            if name:
+                taken.add(name)
+    out: list = []
+    unnamed = 0
+    n_partitions = 0
+    for elem in app.execution_elements:
+        if isinstance(elem, Query):
+            qid = info_name(elem)
+            if not qid:
+                while f"query{unnamed}" in taken:
+                    unnamed += 1
+                qid = f"query{unnamed}"
+                unnamed += 1
+            out.append(("query", qid, elem))
+        elif isinstance(elem, Partition):
+            pid = f"partition{n_partitions}"
+            n_partitions += 1
+            inner_ids = []
+            p_unnamed = 0
+            for q in elem.queries:
+                qid = info_name(q) or f"{pid}_query{p_unnamed}"
+                p_unnamed += 1
+                inner_ids.append((qid, q))
+            out.append(("partition", pid, elem, inner_ids))
+    return out

@@ -238,3 +238,67 @@ def test_cli_codes_catalog(capsys):
     out = capsys.readouterr().out
     for code in ("SA101", "SA206", "SA301", "SA403"):
         assert code in out
+
+
+def test_partition_key_validation_sa115():
+    """OBJECT-typed keys and un-keyed consumed streams are SA115 errors —
+    the analyzer-side analog of PartitionRuntime's 'cannot partition by
+    OBJECT' / 'partition has no key for stream' creation errors."""
+    result = analyze("""
+    define stream S (symbol string, payload object);
+    define stream R (k string);
+    partition with (payload of S) begin
+    from S select symbol insert into Out;
+    from R select k insert into Out2;
+    end;
+    """)
+    codes = [d.code for d in result.errors]
+    assert codes.count("SA115") == 2, result.format()
+    msgs = " ".join(d.message for d in result.errors)
+    assert "OBJECT" in msgs and "no key for stream 'R'" in msgs
+
+
+def test_partition_inner_and_keyed_streams_are_clean():
+    result = analyze("""
+    define stream S (symbol string, price float);
+    partition with (symbol of S) begin
+    from S select symbol, price insert into #tmp;
+    from #tmp select symbol insert into Out;
+    end;
+    """)
+    assert not any(d.code == "SA115" for d in result.diagnostics), (
+        result.format()
+    )
+
+
+def test_cli_explain_renders_static_plan(tmp_path, capsys):
+    p = tmp_path / "app.siddhi"
+    p.write_text(
+        "define stream S (a int);\n"
+        "@info(name='q') from S select a insert into Out;\n"
+    )
+    assert lint_main(["--explain", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "EXPLAIN" in out and "query q" in out and "Out" in out
+    assert lint_main(["--explain", "--format=json", str(p)]) == 0
+    plan = json.loads(capsys.readouterr().out)
+    assert plan["analyzed"] and not plan["live"]
+    assert any(n["id"] == "query:q" for n in plan["nodes"])
+    assert any(e["from"] == "stream:S" for e in plan["edges"])
+
+
+def test_explain_survives_invalid_partition_keys():
+    """/explain renders partitioned plans best-effort: an app the analyzer
+    rejects (SA115) must still produce a plan, not a crash."""
+    from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+    from siddhi_tpu.observability.explain import explain_static
+
+    app = SiddhiCompiler.parse("""
+    define stream S (symbol string, payload object);
+    define stream R (k string);
+    partition with (payload of S) begin
+    from R select k insert into Out2;
+    end;
+    """)
+    text = explain_static(app)
+    assert "partition0_query0" in text and "R" in text

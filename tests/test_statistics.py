@@ -619,6 +619,12 @@ class TestNoOverheadWhenDisabled:
         qr = rt.queries["q"]
         assert qr.device_step_tracker is None
         assert qr.sync_stall_tracker is None
+        # profiler + compile telemetry ride the same wiring: without
+        # @app:statistics the hot paths pay one `is None` check
+        assert qr.compile_telemetry is None
+        assert qr.profiler is None
+        assert j.profiler is None
+        assert j.compile_telemetry is None
         assert rt.traces() == []
         mgr.shutdown()
 
@@ -653,3 +659,43 @@ class TestNoOverheadWhenDisabled:
             f"disabled path ({disabled:.4f}s) must be cheaper than enabled "
             f"({enabled:.4f}s)"
         )
+
+    def test_profiler_hooks_are_single_gate_check_when_disabled(self):
+        # the profiler/compile-telemetry contract matches the trackers':
+        # `enable_stats(False)` stops collection at one gate check —
+        # begin() returns None and observe() returns before touching the
+        # jit cache or taking a lock's slow path
+        from siddhi_tpu.observability.profiler import (
+            CompileTelemetry,
+            Profiler,
+        )
+
+        class Gate:
+            enabled = True
+
+        gate = Gate()
+        prof = Profiler(gate=gate)
+        ct = CompileTelemetry(gate=gate)
+
+        class FakeProg:
+            calls = 0
+
+            def _cache_size(self):
+                FakeProg.calls += 1
+                return 1
+
+        prog = FakeProg()
+        gate.enabled = False
+        assert prof.begin("S", 8) is None
+        ct.observe("c", prog, (8,), 1000)
+        assert FakeProg.calls == 0  # never reached the cache probe
+        assert prof.report()["chunks"] == 0
+        assert ct.report() == {}
+        gate.enabled = True
+        wf = prof.begin("S", 8)
+        assert wf is not None
+        prof.end(wf)
+        ct.observe("c", prog, (8,), 1000)
+        assert FakeProg.calls == 1
+        assert prof.report()["chunks"] == 1
+        assert ct.report()["c"]["compiles"] == 1
