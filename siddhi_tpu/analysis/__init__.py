@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Union
 
 from siddhi_tpu.analysis.analyzer import analyze as _analyze_app
+from siddhi_tpu.analysis.analyzer import analyze_store_query
 from siddhi_tpu.analysis.diagnostics import (
     CODES,
     ERROR,
@@ -33,6 +34,9 @@ from siddhi_tpu.query_api.siddhi_app import SiddhiApp
 
 __all__ = [
     "analyze",
+    "analyze_store_query",
+    "build_fusion_plan",
+    "compute_costs",
     "AnalysisResult",
     "Diagnostic",
     "SiddhiAnalysisError",
@@ -42,10 +46,28 @@ __all__ = [
 ]
 
 
-def analyze(app: Union[str, SiddhiApp]) -> AnalysisResult:
-    """Semantic analysis of a SiddhiApp (AST or SiddhiQL source text)."""
+def _to_app(app: "Union[str, SiddhiApp]") -> SiddhiApp:
     if isinstance(app, str):
         from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
 
         app = SiddhiCompiler.parse(app)
-    return _analyze_app(app)
+    return app
+
+
+def build_fusion_plan(app: "Union[str, SiddhiApp]"):
+    """Static FusionPlan (analysis/fusion.py) for an app (AST or source)."""
+    from siddhi_tpu.analysis.fusion import build_fusion_plan as _plan
+
+    return _plan(_to_app(app))
+
+
+def compute_costs(app: "Union[str, SiddhiApp]"):
+    """Static AppCostModel (analysis/cost.py) for an app (AST or source)."""
+    from siddhi_tpu.analysis.cost import compute_costs as _costs
+
+    return _costs(_to_app(app))
+
+
+def analyze(app: Union[str, SiddhiApp]) -> AnalysisResult:
+    """Semantic analysis of a SiddhiApp (AST or SiddhiQL source text)."""
+    return _analyze_app(_to_app(app))

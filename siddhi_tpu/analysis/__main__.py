@@ -1,7 +1,7 @@
 """SiddhiQL linter CLI.
 
     python -m siddhi_tpu.analysis app.siddhi [more.siddhi ...]
-        [--format=text|json] [--werror] [--codes] [--explain]
+        [--format=text|json] [--werror] [--codes] [--explain] [--plan]
 
 Exit codes: 0 clean, 1 semantic errors (or warnings under --werror),
 2 unreadable/unparsable input. Parse errors are reported as SA001 with the
@@ -11,6 +11,12 @@ parser's line/column rather than a traceback.
 runtime's EXPLAIN ANALYZE — same graph, no live counters; see
 observability/explain.py) instead of diagnostics. Combine with
 `--format=json` for the raw node/edge plan.
+
+`--plan` emits the static FusionPlan (analysis/fusion.py): per-stream
+fusable query groups, shared-state candidates, fusion blockers, and the
+per-query cost model (state bytes, predicted compile counts, selectivity
+estimates). Never fails on semantically-bad apps (rc 0; rc 2 only for
+unparsable input) — the plan is best-effort by contract, like --explain.
 """
 
 from __future__ import annotations
@@ -61,6 +67,25 @@ def _explain_source(source: str, name: str, fmt: str) -> int:
     return 0
 
 
+def _plan_source(source: str, name: str, fmt: str) -> int:
+    """`--plan`: emit the static FusionPlan; rc 2 on parse errors."""
+    from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+    try:
+        app = SiddhiCompiler.parse(source)
+    except SiddhiParserError as exc:
+        print(f"{name}: SA001: {exc}", file=sys.stderr)
+        return 2
+    from siddhi_tpu.analysis.fusion import build_fusion_plan, render_plan_text
+
+    plan = build_fusion_plan(app)
+    if fmt == "json":
+        print(plan.to_json())
+    else:
+        print(render_plan_text(plan))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m siddhi_tpu.analysis",
@@ -84,6 +109,11 @@ def main(argv: list[str] | None = None) -> int:
         help="render the app's dataflow plan (static EXPLAIN) instead of "
         "diagnostics",
     )
+    ap.add_argument(
+        "--plan", action="store_true",
+        help="emit the static FusionPlan (fusable groups, shared-state "
+        "candidates, per-query cost model) instead of diagnostics",
+    )
     args = ap.parse_args(argv)
 
     if args.codes:
@@ -106,6 +136,9 @@ def main(argv: list[str] | None = None) -> int:
         name = "<stdin>" if path == "-" else path
         if args.explain:
             worst = max(worst, _explain_source(source, name, args.format))
+            continue
+        if args.plan:
+            worst = max(worst, _plan_source(source, name, args.format))
             continue
         result = _lint_source(source)
         if args.format == "json":

@@ -45,12 +45,13 @@ from siddhi_tpu.query_api.execution import (
     WindowHandler,
     iter_state_streams,
 )
-from siddhi_tpu.query_api.expression import Variable
+from siddhi_tpu.query_api.expression import Constant, Variable
 from siddhi_tpu.query_api.annotation import find_annotation
 from siddhi_tpu.query_api.siddhi_app import SiddhiApp
 
 from siddhi_tpu.analysis.dataflow import QueryFlow, check_dataflow
 from siddhi_tpu.analysis.diagnostics import (
+    ERROR,
     WARNING,
     AnalysisResult,
     Diagnostic,
@@ -63,17 +64,22 @@ from siddhi_tpu.analysis.typecheck import AnalysisScope, ExprChecker, _loc
 def analyze(app: SiddhiApp) -> AnalysisResult:
     """Run the full semantic pass. Never raises on bad apps — every finding
     becomes a Diagnostic; an unexpected analyzer fault degrades to an SA000
-    warning rather than masking runtime behavior."""
+    warning rather than masking runtime behavior. The returned result also
+    carries the static `FusionPlan` (`result.fusion_plan`) built by the
+    cost/fusion passes."""
     diags: list[Diagnostic] = []
+    out: dict = {}
     try:
-        _analyze(app, diags)
+        _analyze(app, diags, out=out)
     except Exception as exc:  # pragma: no cover - analyzer defect guard
         diags.append(Diagnostic(
             "SA000",
             f"internal analyzer error, analysis incomplete: {exc!r}",
             severity=WARNING,
         ))
-    return AnalysisResult(diags, app_name=app.name)
+    result = AnalysisResult(diags, app_name=app.name)
+    result.fusion_plan = out.get("fusion_plan")
+    return result
 
 
 def collect_flows(app: SiddhiApp) -> list[QueryFlow]:
@@ -84,12 +90,17 @@ def collect_flows(app: SiddhiApp) -> list[QueryFlow]:
     analyzer would reject (e.g. invalid partition keys, SA115)."""
     diags: list[Diagnostic] = []
     try:
-        return _analyze(app, diags)
+        return _analyze(app, diags, lints=False)
     except Exception:  # pragma: no cover - analyzer defect guard
         return []
 
 
-def _analyze(app: SiddhiApp, diags: list[Diagnostic]) -> list[QueryFlow]:
+def _analyze(
+    app: SiddhiApp,
+    diags: list[Diagnostic],
+    out: Optional[dict] = None,
+    lints: bool = True,
+) -> list[QueryFlow]:
     sym = build_symbols(app, diags)
     flows: list[QueryFlow] = []
 
@@ -134,6 +145,16 @@ def _analyze(app: SiddhiApp, diags: list[Diagnostic]) -> list[QueryFlow]:
             )
 
     check_dataflow(app, sym, flows, diags)
+
+    if lints:
+        # cost model + fusion-feasibility lints (SA120-SA124, warnings)
+        from siddhi_tpu.analysis.cost import check_costs
+        from siddhi_tpu.analysis.fusion import check_fusion
+
+        model = check_costs(app, sym, diags)
+        plan = check_fusion(app, sym, diags, model)
+        if out is not None:
+            out["fusion_plan"] = plan
     return flows
 
 
@@ -178,6 +199,20 @@ def _check_aggregation_definition(
         scope.add(stream.stream_id, dict(schema) if schema is not None else None)
     schema2 = _apply_handlers(stream, schema, ref, checker, scope, diags, qid)
     scope.refs[ref] = schema2
+    # `aggregate by <attr>`: the bucket timestamp source must be INT/LONG
+    # (runtime analog: AggregationRuntime raises 'aggregate by attribute
+    # must be long' at creation, core/aggregation.py)
+    if ad.aggregate_attribute is not None:
+        t = checker.resolve_variable(ad.aggregate_attribute, scope)
+        if t is not None and t not in (AttrType.INT, AttrType.LONG):
+            line, col = _loc(ad.aggregate_attribute)
+            diags.append(Diagnostic(
+                "SA116",
+                f"aggregation '{ad.id}': 'aggregate by "
+                f"{ad.aggregate_attribute.attribute}' must be INT/LONG "
+                f"(epoch millis), got {t!r}",
+                line, col, query=qid,
+            ))
     if ad.selector is not None:
         _analyze_selector(
             ad.selector, checker, scope,
@@ -449,9 +484,97 @@ def _analyze_join_input(
                 line, col, query=qid,
             ))
 
+    _check_join_agg_clauses(join, sym, diags, qid)
+
     if side_base[0] is None or side_base[1] is None:
         return None
     return side_base[0] + side_base[1]
+
+
+def _check_join_agg_clauses(
+    join: JoinInputStream,
+    sym: SymbolTable,
+    diags: list[Diagnostic],
+    qid: str,
+) -> None:
+    """`within`/`per` on aggregation joins (runtime analog:
+    app_runtime._add_join_query AggFindable construction — every error
+    here raises at creation time there). On a join with NO aggregation
+    side the clauses are silently ignored by the runtime: warning."""
+    from siddhi_tpu.query_api.expression import AttributeFunction
+
+    agg_sides = [
+        s for s in (join.left, join.right)
+        if s.stream_id in sym.aggregations
+    ]
+    line, col = _loc(join)
+    if line is None:  # the parser stamps the sides, not the join node
+        line, col = _loc(join.left)
+
+    def err(msg, node=None, severity=ERROR):
+        l2, c2 = _loc(node) if node is not None else (line, col)
+        diags.append(Diagnostic(
+            "SA117", msg, l2 if l2 is not None else line,
+            c2 if c2 is not None else col, severity=severity, query=qid,
+        ))
+
+    if not agg_sides:
+        if join.within is not None or join.per is not None:
+            err(
+                "'within'/'per' apply to aggregation joins only — no join "
+                "side is an aggregation, the clause is ignored",
+                join.within or join.per, severity=WARNING,
+            )
+        return
+
+    from siddhi_tpu.core.aggregation import parse_per, parse_within_value
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    if join.per is None or not isinstance(join.per, Constant):
+        err(
+            "joining an aggregation needs per '<duration>' "
+            "(a constant like per 'hours')",
+            join.per,
+        )
+        per_dur = None
+    else:
+        try:
+            per_dur = parse_per(join.per.value)
+        except SiddhiAppCreationError as exc:
+            err(str(exc), join.per)
+            per_dur = None
+
+    if per_dur is not None:
+        for s in agg_sides:
+            ad = sym.aggregation_defs.get(s.stream_id)
+            if ad is None or ad.time_period is None:
+                continue
+            if per_dur not in ad.time_period.durations:
+                have = ", ".join(
+                    d.name.lower() for d in ad.time_period.durations
+                )
+                err(
+                    f"aggregation '{s.stream_id}' has no "
+                    f"'{per_dur.name.lower()}' duration (declares: {have})",
+                    join.per,
+                )
+
+    w = join.within
+    if w is None:
+        return
+    if isinstance(w, AttributeFunction) and w.name == "__within_range__":
+        operands = list(w.parameters)
+    else:
+        operands = [w]
+    for op in operands:
+        if not isinstance(op, Constant):
+            err("'within' operands must be constants", op)
+            return
+    try:
+        for op in operands:
+            parse_within_value(op.value)
+    except SiddhiAppCreationError as exc:
+        err(str(exc), w)
 
 
 def _analyze_state_input(
@@ -861,6 +984,211 @@ def _analyze_partition(
                 {n: t for n, t in out_attrs if n}
                 if out_attrs is not None
                 else None
+            )
+
+
+# ---------------------------------------------------------------------------
+# store queries
+# ---------------------------------------------------------------------------
+
+
+def analyze_store_query(store_query, app) -> AnalysisResult:
+    """Semantic analysis of a one-shot store query (`runtime.query(...)`)
+    against an app's definitions — the static analog of
+    core/store_query.py StoreQueryRuntime creation checks. Accepts the
+    StoreQuery AST or SiddhiQL text for either argument; never raises."""
+    from siddhi_tpu.query_api.execution import StoreQuery
+
+    diags: list[Diagnostic] = []
+    if isinstance(app, str):
+        from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+        from siddhi_tpu.core.errors import SiddhiParserError
+
+        try:
+            app = SiddhiCompiler.parse(app)
+        except SiddhiParserError as exc:
+            return AnalysisResult([Diagnostic(
+                "SA001", f"app source: {exc}",
+                getattr(exc, "line", None), getattr(exc, "col", None),
+            )])
+    if isinstance(store_query, str):
+        from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+        from siddhi_tpu.core.errors import SiddhiParserError
+
+        try:
+            store_query = SiddhiCompiler.parse_store_query(store_query)
+        except SiddhiParserError as exc:
+            return AnalysisResult([Diagnostic(
+                "SA001", str(exc),
+                getattr(exc, "line", None), getattr(exc, "col", None),
+            )], app_name=app.name)
+    assert isinstance(store_query, StoreQuery)
+    try:
+        _analyze_store_query(store_query, app, diags)
+    except Exception as exc:  # pragma: no cover - analyzer defect guard
+        diags.append(Diagnostic(
+            "SA000",
+            f"internal analyzer error, analysis incomplete: {exc!r}",
+            severity=WARNING,
+        ))
+    return AnalysisResult(diags, app_name=app.name)
+
+
+def _analyze_store_query(sq, app: SiddhiApp, diags: list[Diagnostic]) -> None:
+    from siddhi_tpu.core.aggregation import parse_per, parse_within_value
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    sym = build_symbols(app, [])  # definition defects are the app's report
+    qid = "store query"
+    checker = ExprChecker(sym, diags, query=qid)
+    store = sq.input_store
+    line, col = _loc(sq)
+
+    if store is None and sq.output_stream is None:
+        diags.append(Diagnostic(
+            "SA118",
+            "a store query needs a 'from <store>' clause or an "
+            "insert/update/delete output",
+            line, col, query=qid,
+        ))
+        return
+
+    schema: Optional[dict] = None
+    is_agg = False
+    if store is not None:
+        sid = store.store_id
+        sline, scol = _loc(store)
+        if sid in sym.tables:
+            schema = sym.tables[sid]
+        elif sid in sym.windows:
+            schema = sym.windows[sid]
+        elif sid in sym.aggregations:
+            is_agg = True  # bucket view: schema stays open
+        else:
+            diags.append(Diagnostic(
+                "SA108",
+                f"'{sid}' is not a defined table, window, or aggregation "
+                f"(tables: {', '.join(sorted(sym.tables)) or 'none'})",
+                sline, scol, query=qid,
+            ))
+
+        def clause_err(msg, node=None):
+            l2, c2 = _loc(node) if node is not None else (sline, scol)
+            diags.append(Diagnostic(
+                "SA117", msg, l2 if l2 is not None else sline,
+                c2 if c2 is not None else scol, query=qid,
+            ))
+
+        if is_agg:
+            # per '<duration>' is mandatory and must be a declared duration
+            if store.per is None or not isinstance(store.per, Constant):
+                clause_err(
+                    "aggregation store queries need a per '<duration>' "
+                    "clause", store.per,
+                )
+                per_dur = None
+            else:
+                try:
+                    per_dur = parse_per(store.per.value)
+                except SiddhiAppCreationError as exc:
+                    clause_err(str(exc), store.per)
+                    per_dur = None
+            ad = sym.aggregation_defs.get(sid)
+            if (
+                per_dur is not None and ad is not None
+                and ad.time_period is not None
+                and per_dur not in ad.time_period.durations
+            ):
+                have = ", ".join(
+                    d.name.lower() for d in ad.time_period.durations
+                )
+                clause_err(
+                    f"aggregation '{sid}' has no '{per_dur.name.lower()}' "
+                    f"duration (declares: {have})", store.per,
+                )
+            if store.within is not None:
+                w1, w2 = store.within
+                operands = [w1] if w2 is None else [w1, w2]
+                if not all(isinstance(w, Constant) for w in operands):
+                    clause_err("'within' operands must be constants", w1)
+                else:
+                    try:
+                        if w2 is None:
+                            lo, hi = parse_within_value(w1.value)
+                        else:
+                            lo = parse_within_value(w1.value)[0]
+                            hi = parse_within_value(w2.value)[0]
+                        if lo >= hi:
+                            clause_err(
+                                "'within' start time must be before the "
+                                "end time", w1,
+                            )
+                    except SiddhiAppCreationError as exc:
+                        clause_err(str(exc), w1)
+        elif store.within is not None or store.per is not None:
+            clause_err(
+                "'within'/'per' apply to aggregation store queries",
+                store.within[0] if store.within is not None else store.per,
+            )
+
+    ref = (store.alias or store.store_id) if store is not None else "__const__"
+    # unresolved stores and aggregation bucket views stay OPEN (None): an
+    # SA108 is already reported; cascading SA103s would be noise. The
+    # no-from insert form exposes a closed empty row (constants only).
+    open_schema = store is not None and (is_agg or schema is None)
+    scope_schema = (
+        dict(schema) if schema is not None
+        else (None if open_schema else {})
+    )
+    scope = AnalysisScope().add(ref, scope_schema)
+    if store is not None and ref != store.store_id:
+        scope.add(
+            store.store_id,
+            dict(scope_schema) if scope_schema is not None else None,
+        )
+    scope.default_ref = ref
+
+    if store is not None and store.on is not None:
+        t = checker.infer_no_agg(store.on, scope)
+        if t is not None and t is not AttrType.BOOL:
+            oline, ocol = _loc(store.on)
+            diags.append(Diagnostic(
+                "SA203",
+                f"'on' must be a boolean expression, got {t!r}",
+                oline, ocol, query=qid,
+            ))
+
+    star = list(schema.items()) if schema is not None else None
+    out_attrs = _analyze_selector(sq.selector, checker, scope, star)
+
+    out = sq.output_stream
+    if out is not None:
+        target = getattr(out, "target", None)
+        oline, ocol = _loc(out)
+        if target is None:
+            # a ReturnStream output parses but the runtime rejects it: a
+            # store-query write must name a table (store_query.py target
+            # resolution)
+            diags.append(Diagnostic(
+                "SA118",
+                "a store query write output must target a defined table "
+                "(insert into / update / delete <table>)",
+                oline if oline is not None else line,
+                ocol if ocol is not None else col, query=qid,
+            ))
+        elif target not in sym.tables:
+            diags.append(Diagnostic(
+                "SA108",
+                f"store query target '{target}' is not a defined table "
+                f"(tables: {', '.join(sorted(sym.tables)) or 'none'})",
+                oline if oline is not None else line,
+                ocol if ocol is not None else col, query=qid,
+            ))
+        elif isinstance(out, InsertIntoStream) and out_attrs is not None:
+            _check_insert_schema(
+                target, "table", out_attrs,
+                list(sym.tables[target].items()),
+                diags, qid, oline, ocol, widening=True,
             )
 
 

@@ -39,6 +39,21 @@ CODES: dict[str, str] = {
     "SA115": "invalid partition key (OBJECT-typed key expression, or a "
              "partitioned query consumes a stream the partition declares "
              "no key for)",
+    "SA116": "aggregation 'aggregate by' attribute must be INT/LONG",
+    "SA117": "invalid 'within'/'per' clause (aggregation joins and store "
+             "queries; warning when the clause is silently ignored)",
+    "SA118": "malformed store query (no from-store and no write output)",
+    # cost model / fusion planner (warnings)
+    "SA120": "unbounded pattern state: 'every' with no 'within' bound "
+             "(token-table growth; warning)",
+    "SA121": "unbounded or oversized window/aggregation state (no expiry, "
+             "or state beyond the device budget; warning)",
+    "SA122": "statically-predicted recompile churn (tail-variant ladder, "
+             "re-published batch shapes; warning)",
+    "SA123": "identical window state duplicated across queries of one "
+             "stream (shareable; warning)",
+    "SA124": "fusion blocker: the named hazard excludes this query from "
+             "its stream's fusable group (warning)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",
@@ -95,6 +110,9 @@ class Diagnostic:
 class AnalysisResult:
     diagnostics: list[Diagnostic] = dataclasses.field(default_factory=list)
     app_name: str = "SiddhiApp"
+    # static FusionPlan (analysis/fusion.py) built by the same pass; None
+    # when the pass was skipped or the analyzer degraded (SA000)
+    fusion_plan: object = None
 
     @property
     def errors(self) -> list[Diagnostic]:

@@ -32,6 +32,9 @@ class SymbolTable:
     tables: dict[str, Optional[Schema]] = dataclasses.field(default_factory=dict)
     windows: dict[str, Optional[Schema]] = dataclasses.field(default_factory=dict)
     aggregations: dict[str, Optional[Schema]] = dataclasses.field(default_factory=dict)
+    # aggregation definitions by id (within/per clause checks need the
+    # declared time_period durations)
+    aggregation_defs: dict = dataclasses.field(default_factory=dict)
     # script-defined functions: name -> return AttrType
     functions: dict[str, AttrType] = dataclasses.field(default_factory=dict)
     # streams declaring @OnError(action='STREAM') (fault stream '!S' exists)
@@ -186,8 +189,9 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
     for fid, fdef in app.function_definitions.items():
         sym.functions[fid] = fdef.return_type
 
-    for aid in app.aggregation_definitions:
+    for aid, adef in app.aggregation_definitions.items():
         sym.aggregations[aid] = None  # bucket-view schema: leave open
+        sym.aggregation_defs[aid] = adef
 
     _apply_selfmon_annotation(app, sym, diags)
 

@@ -874,9 +874,11 @@ class Parser:
 
     def parse_store_query(self) -> StoreQuery:
         sq = StoreQuery()
+        self.stamp(sq, self.peek())
         if self.accept_kw("from"):
+            store_tok = self.peek()
             store_id = self.name()
-            store = InputStore(store_id)
+            store = self.stamp(InputStore(store_id), store_tok)
             if self.accept_kw("as"):
                 store.alias = self.name()
             if self.accept_kw("on"):

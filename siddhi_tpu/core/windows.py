@@ -571,9 +571,15 @@ class BatchWindow(WindowStage):
                 # bucket WITHOUT advancing the bucket grid: later events whose
                 # external time falls in the same grid bucket open a fresh
                 # bucket there (reference: ExternalTimeBatchWindowProcessor
-                # clears currentEventChunk but keeps endTime)
+                # clears currentEventChunk but keeps endTime). Positional: a
+                # CURRENT row earlier in this batch re-arms the deadline to
+                # now + timeout (which cannot have elapsed at this same now),
+                # so only a TIMER with no prior CURRENT row (`rank == 0`) can
+                # see a genuinely stale deadline — a stale timer after a
+                # same-batch refill must not force-close the bucket
                 timeout_flush = (
                     is_timer
+                    & (rank == 0)
                     & (cur_n0 > 0)
                     & (jnp.asarray(flow.now, jnp.int64)
                        >= state["timeout_deadline"])

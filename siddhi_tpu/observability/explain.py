@@ -150,6 +150,25 @@ def build_plan(app, runtime=None) -> dict:
     flows = collect_flows(app)
     qindex = _query_index(app)
 
+    # static cost model + fusion plan (analysis/cost.py, analysis/fusion.py):
+    # predicted state bytes / compile counts / selectivity per query, and the
+    # per-stream fusable groups — rendered NEXT TO the live counters so the
+    # predicted and measured numbers sit on the same line of the same plan.
+    # Independently guarded: a cost-model defect must not take down EXPLAIN.
+    static_costs: dict = {}
+    fusion_summary = None
+    try:
+        from siddhi_tpu.analysis.cost import compute_costs
+        from siddhi_tpu.analysis.fusion import build_fusion_plan
+        from siddhi_tpu.analysis.symbols import build_symbols
+
+        _sym = build_symbols(app, [])
+        _model = compute_costs(app, _sym)
+        static_costs = _model.queries
+        fusion_summary = build_fusion_plan(app, _sym, model=_model).summary()
+    except Exception:
+        pass
+
     sm = getattr(runtime, "statistics_manager", None) if runtime else None
     ct = sm.compile_telemetry if sm is not None else None
 
@@ -246,6 +265,14 @@ def build_plan(app, runtime=None) -> dict:
             )
             if counters:
                 node["counters"] = counters
+            qc = static_costs.get(f.qid)
+            if qc is not None:
+                node["static"] = {
+                    "state_bytes": qc.state_bytes,
+                    "est_selectivity": qc.est_selectivity,
+                    "predicted_compiles": qc.predicted_compiles,
+                    "programs": [p.to_dict() for p in qc.programs],
+                }
             nodes.append(node)
         for sid in sorted(f.consumes):
             edges.append({"from": add_stream(sid), "to": nid})
@@ -283,6 +310,7 @@ def build_plan(app, runtime=None) -> dict:
         "live": sm is not None,
         "nodes": nodes,
         "edges": edges,
+        "fusion": fusion_summary,
     }
 
 
@@ -390,6 +418,18 @@ def render_text(plan: dict) -> str:
         lines.append(head + _fmt_counters(n.get("counters")))
         if n.get("selector"):
             lines.append(f"    {n['selector']}  |  {n['sink']}")
+        st = n.get("static")
+        if st is not None:
+            progs = ",".join(
+                f"{p['component']}~{p['predicted_compiles']}"
+                for p in st.get("programs", [])
+            )
+            lines.append(
+                f"    static: state={st['state_bytes']}B "
+                f"sel~{st['est_selectivity']} "
+                f"compiles~{st['predicted_compiles']}"
+                + (f" [{progs}]" if progs else "")
+            )
         for src in sorted(in_edges.get(n["id"], [])):
             sn = nodes.get(src)
             if sn is None:
@@ -416,6 +456,29 @@ def render_text(plan: dict) -> str:
             lines.append(
                 f"  {n['kind']} {n['label']}" + _fmt_counters(n.get("counters"))
             )
+    fusion = plan.get("fusion")
+    if fusion:
+        if fusion.get("groups"):
+            lines.append("fusion plan:")
+            for g in fusion["groups"]:
+                lines.append(
+                    f"  stream {g['stream']}: fuse "
+                    f"{', '.join(g['queries'])}  "
+                    f"(-{g['est_dispatch_reduction'] * 100:.1f}% dispatch)"
+                )
+        if fusion.get("shared_state"):
+            for s in fusion["shared_state"]:
+                lines.append(
+                    f"  shared state on {s['stream']}: "
+                    f"{', '.join(s['queries'])} "
+                    f"(~{s['est_bytes_saved']}B saved)"
+                )
+        if fusion.get("blockers"):
+            for b in fusion["blockers"]:
+                lines.append(
+                    f"  blocked: {b['query']} on {b['stream']} "
+                    f"({b['hazard']})"
+                )
     return "\n".join(lines)
 
 

@@ -70,11 +70,68 @@ class WindowDefinition(AbstractDefinition):
 
 @dataclasses.dataclass
 class WindowSpec(SourceLocated):
-    """A window invocation `ns:name(params)` attached to a stream or window def."""
+    """A window invocation `ns:name(params)` attached to a stream or window
+    def, plus static state-bound metadata: which builtin windows tumble
+    (two device buckets instead of one ring), which arm host timers, and
+    the constant row bound when one is declared — consumed by the static
+    cost model (analysis/cost.py) and anyone else reasoning about device
+    state without building a runtime stage. The sets mirror
+    `core/windows.py make_window` dispatch."""
 
     namespace: Optional[str]
     name: str
     parameters: list[Expression] = dataclasses.field(default_factory=list)
+
+    # tumbling family: state is cur + prev buckets (core/windows.py
+    # BatchWindow / windows_special.py CronWindow)
+    BATCH_WINDOWS = frozenset(
+        {"lengthbatch", "timebatch", "externaltimebatch", "cron"}
+    )
+    # these arm the host scheduler unconditionally; externalTimeBatch joins
+    # them only with its 4th (idle timeout) parameter — see arms_scheduler
+    SCHEDULER_WINDOWS = frozenset({"time", "timelength", "timebatch", "cron"})
+    # parameter position of the constant row bound, where one is declared
+    _LENGTH_PARAM = {
+        "length": 0, "lengthbatch": 0, "timelength": 1, "sort": 0,
+        "frequent": 0,
+    }
+
+    @property
+    def key(self) -> str:
+        """Lowercased dispatch key (`ns:name` for extensions)."""
+        return (
+            self.name.lower()
+            if self.namespace is None
+            else f"{self.namespace}:{self.name}".lower()
+        )
+
+    @property
+    def is_batch(self) -> bool:
+        return self.key in self.BATCH_WINDOWS
+
+    @property
+    def arms_scheduler(self) -> bool:
+        """True when this window needs host timer wake-ups between batches
+        (mirrors the runtime stages' `needs_scheduler`)."""
+        k = self.key
+        if k in self.SCHEDULER_WINDOWS:
+            return True
+        return k == "externaltimebatch" and len(self.parameters) > 3
+
+    def length_bound(self) -> Optional[int]:
+        """The window's constant row bound, or None when its capacity is a
+        runtime default (time-capacity family) / unknowable (extension,
+        non-constant parameter)."""
+        from siddhi_tpu.query_api.expression import Constant
+
+        i = self._LENGTH_PARAM.get(self.key)
+        if i is None or i >= len(self.parameters):
+            return None
+        p = self.parameters[i]
+        if isinstance(p, Constant) and isinstance(p.value, (int, float)) \
+                and not isinstance(p.value, bool):
+            return int(p.value)
+        return None
 
 
 @dataclasses.dataclass
@@ -153,3 +210,11 @@ class AggregationDefinition(SourceLocated):
     aggregate_attribute: Optional[Variable] = None
     time_period: Optional[TimePeriod] = None
     annotations: list[Annotation] = dataclasses.field(default_factory=list)
+
+    def bucket_durations(self) -> list[Duration]:
+        """The declared per-duration bucket tables (state-bound metadata:
+        one closed-bucket device table per entry — analysis/cost.py sizes
+        them; []) when the definition is incomplete."""
+        if self.time_period is None:
+            return []
+        return list(self.time_period.durations)

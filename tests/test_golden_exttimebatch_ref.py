@@ -161,19 +161,12 @@ class TestIdleTimeoutMixedBatch:
     The reference processes a batch event-by-event: a CURRENT event re-arms
     the idle deadline BEFORE a later TIMER row in the same batch is
     examined, so a stale-elapsed timer must not force-close the bucket the
-    event just (re)filled. The engine's batch-level check
-    (`timeout_flush` in core/windows.py BatchWindow.apply) compares the
-    TIMER against the batch-START deadline and carried count, ignoring
-    re-arms earlier in the same batch — the positional fix is deferred
-    (see ISSUE 4 satellite), hence the xfail."""
+    event just (re)filled. The engine's batch-level check (`timeout_flush`
+    in core/windows.py BatchWindow.apply) guards on `rank == 0`: any
+    CURRENT row earlier in the batch re-arms the deadline to now + timeout
+    (which cannot have elapsed at the same now), so a TIMER preceded by a
+    CURRENT row never force-closes."""
 
-    @pytest.mark.xfail(
-        strict=True,
-        reason="timeout_flush uses batch-start (cur_n0, timeout_deadline); "
-        "an event earlier in the same batch re-arming the deadline is not "
-        "seen by a later TIMER row — positional fix deferred "
-        "(core/windows.py BatchWindow.apply, timeout_flush)",
-    )
     def test_stale_timer_after_refill_in_same_batch(self):
         from siddhi_tpu.core.event import KIND_CURRENT, KIND_TIMER
 
