@@ -54,6 +54,8 @@ CODES: dict[str, str] = {
              "stream (shareable; warning)",
     "SA124": "fusion blocker: the named hazard excludes this query from "
              "its stream's fusable group (warning)",
+    "SA125": "invalid @app:fuse annotation (unknown option or bad "
+             "disable value)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

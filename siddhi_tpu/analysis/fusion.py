@@ -258,6 +258,11 @@ def build_fusion_plan(
             )
             plan.groups.append({
                 "stream": sid,
+                # telemetry component of the group's chunk program — the
+                # fusion executor (core/fusion_exec.py) adopts this name, so
+                # the static plan, runtime.explain(), and /profile all key
+                # the same ledger
+                "component": f"stream.{sid}.fusedgroup.{len(plan.groups)}",
                 "queries": sorted(c.qid for c in fusable),
                 "chunk": {
                     "batch_size": model.batch_size,

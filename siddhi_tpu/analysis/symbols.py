@@ -108,6 +108,20 @@ def _check_flight_annotation(
         ))
 
 
+def _check_fuse_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
+    """Validate `@app:fuse(disable='true|false')` — the whole-graph fusion
+    escape hatch. One SA125 per malformed element, using the SAME rule set
+    the runtime resolver raises on (core/fusion_exec.py
+    iter_fuse_annotation_problems), so the two can never drift."""
+    ann = find_annotation(app.annotations, "app:fuse")
+    if ann is None:
+        return
+    from siddhi_tpu.core.fusion_exec import iter_fuse_annotation_problems
+
+    for problem in iter_fuse_annotation_problems(ann):
+        diags.append(Diagnostic("SA125", problem))
+
+
 def _apply_selfmon_annotation(
     app: SiddhiApp, sym: SymbolTable, diags: list[Diagnostic]
 ) -> None:
@@ -194,5 +208,6 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
         sym.aggregation_defs[aid] = adef
 
     _apply_selfmon_annotation(app, sym, diags)
+    _check_fuse_annotation(app, diags)
 
     return sym

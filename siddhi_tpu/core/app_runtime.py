@@ -133,6 +133,14 @@ class SiddhiAppRuntime:
         batch_ann = find_annotation(app.annotations, "app:batch")
         self.batch_size = int(batch_ann.element("size", str(DEFAULT_BATCH))) if batch_ann else DEFAULT_BATCH
         self.group_capacity = self._capacity_annotation("app:groupCapacity", None)
+        # whole-graph fusion escape hatch: @app:fuse(disable='true') /
+        # SIDDHI_TPU_FUSE=1|0 (core/fusion_exec.py; malformed options raise
+        # here — the runtime analog of the analyzer's SA125)
+        from siddhi_tpu.core.fusion_exec import resolve_fuse_annotation
+
+        self._fuse_enabled = resolve_fuse_annotation(
+            find_annotation(app.annotations, "app:fuse")
+        )
         # one app-level processing lock: receive+route for every query runs
         # under it, so cyclic stream topologies cannot lock-order deadlock and
         # timer/input threads deliver outputs in state-step order (analog of
@@ -1025,9 +1033,24 @@ class SiddhiAppRuntime:
 
     def profile_report(self) -> dict:
         """Compile telemetry + slowest-chunk waterfalls + high latency
-        quantiles (`/profile` payload); None without `@app:statistics`."""
+        quantiles (`/profile` payload); None without `@app:statistics`.
+        Plan-driven fused groups (core/fusion_exec.py) append their
+        achieved-vs-predicted dispatch-reduction ledger under
+        `fused_groups`, keyed by the cost model's component taxonomy
+        (`stream.<S>.fusedgroup.<g>`)."""
         sm = self.statistics_manager
-        return sm.profile_report() if sm is not None else None
+        if sm is None:
+            return None
+        rep = sm.profile_report()
+        groups = []
+        for j in list(self.junctions.values()):
+            fi = j.fused_ingest
+            gr = fi.group_report() if fi is not None else None
+            if gr is not None:
+                groups.append({"stream": j.schema.stream_id, **gr})
+        if groups:
+            rep["fused_groups"] = groups
+        return rep
 
     # ---- state introspection (observability/introspect.py) ----------------
 
@@ -1185,17 +1208,47 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self._running = True
-        # build per-junction fused ingest engines (core/ingest.py) for
-        # junctions where every subscriber registered a FuseEndpoint
+        # build per-junction fused ingest engines (core/ingest.py):
+        # plan-driven GROUP engines first (core/fusion_exec.py — the
+        # FusionPlan's fusable subset runs as one chunk program, blocked
+        # queries ride the residual per-batch path, shared-window candidates
+        # reference one ring), then the legacy all-or-nothing engine for
+        # junctions where every subscriber registered a FuseEndpoint.
+        # @app:fuse(disable='true') / SIDDHI_TPU_FUSE=0 skips all of it.
         from siddhi_tpu.core.ingest import FusedJunctionIngest
         from siddhi_tpu.core.pipeline import resolve_pipeline_annotation
 
         chunk = self._capacity_annotation("app:ingestChunk", 32)
-        for j in self.junctions.values():
-            if j.fuse_candidates and len(j.fuse_candidates) == len(j.subscribers):
-                pipe_on, pipe_depth = self._pipeline_conf.get(
-                    j.schema.stream_id, resolve_pipeline_annotation(None)
+        fusion_configs: dict = {}
+        if self._fuse_enabled:
+            try:
+                from siddhi_tpu.core.fusion_exec import (
+                    junction_fusion_configs,
                 )
+
+                fusion_configs = junction_fusion_configs(self)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "fusion planning failed for app '%s'; falling back to "
+                    "per-junction fusion only", self.name, exc_info=True,
+                )
+        for j in self.junctions.values() if self._fuse_enabled else ():
+            sid = j.schema.stream_id
+            pipe_on, pipe_depth = self._pipeline_conf.get(
+                sid, resolve_pipeline_annotation(None)
+            )
+            cfg = fusion_configs.get(sid)
+            if cfg is not None:
+                j.fused_ingest = FusedJunctionIngest(
+                    self, j, cfg["endpoints"], chunk_batches=chunk,
+                    pipeline_enabled=pipe_on, pipeline_depth=pipe_depth,
+                    component=cfg["component"], residual=cfg["residual"],
+                    share_sets=cfg["share_sets"],
+                    plan_group=cfg["plan_group"],
+                )
+            elif j.fuse_candidates and len(j.fuse_candidates) == len(j.subscribers):
                 j.fused_ingest = FusedJunctionIngest(
                     self, j, j.fuse_candidates, chunk_batches=chunk,
                     pipeline_enabled=pipe_on, pipeline_depth=pipe_depth,

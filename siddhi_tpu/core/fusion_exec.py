@@ -1,0 +1,285 @@
+"""Fusion executor planning: turn the static FusionPlan into per-junction
+fused-group configurations at runtime creation.
+
+PR 7 shipped the static decision layer (`analysis/fusion.py` — a versioned
+FusionPlan with per-stream fusable groups, SA124 hazards, and shared-state
+candidates). This module is the runtime half of the contract: at `start()`
+the app runtime calls `junction_fusion_configs(runtime)` and, for every
+junction the plan formed a group on, builds ONE `FusedJunctionIngest` over
+exactly the group's endpoints:
+
+* **group members** run inside one XLA chunk program (one donated-state
+  dispatch per K-batch chunk instead of `n * K` per-batch dispatches);
+* **blocked queries** (the plan's SA124 hazards: rate limiters, schedulers,
+  partitions, observed insert targets, ...) stay on the unfused per-batch
+  path — the group engine re-dispatches every micro-batch to them after the
+  fused chunk commits (`FusedJunctionIngest._residual_dispatch`), so their
+  outputs are byte-identical to a fully per-batch run;
+* **shared-state candidates** whose queries all landed in the same group
+  and whose runtime chains are provably compatible (`_chain_share_key`)
+  reference ONE window ring: the chunk program carries the canonical chain
+  state once and every member reads it (core/ingest.py share sets).
+
+Safety guards applied here, beyond the plan's own hazards:
+
+* `_insert_reach`: a residual (blocked) query whose output can reach the
+  fused stream — directly or through a chain of insert-into queries — would
+  feed events back into the group AFTER the whole chunk instead of
+  interleaved per batch, changing the group's window contents. Such
+  junctions fall back to the legacy all-or-nothing fused path.
+* subscriber-name accounting: the group engages only when every junction
+  subscriber is either a group endpoint or a mapped residual consumer
+  (query / aggregation); anything unrecognized vetoes the partial config.
+
+Escape hatch: `@app:fuse(disable='true')` on the app, overridden
+process-wide by SIDDHI_TPU_FUSE=1 (force on) / SIDDHI_TPU_FUSE=0 (force
+off — no fused ingest engines are built at all, every junction runs the
+per-batch path). The annotation is validated here (the runtime analog of
+the analyzer's SA125, same rule set).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+FUSE_ENV = "SIDDHI_TPU_FUSE"
+
+_TRUE = ("1", "on", "true", "force")
+_FALSE = ("0", "off", "false")
+
+
+def fuse_env_override() -> Optional[bool]:
+    """Process-wide fusion toggle: True (forced on), False (forced off), or
+    None (defer to the app's @app:fuse annotation)."""
+    v = os.environ.get(FUSE_ENV, "").strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    return None
+
+
+def iter_fuse_annotation_problems(ann):
+    """Yield one message per malformed `@app:fuse` element — THE validation
+    rules, shared by the runtime resolver (raises on the first) and the
+    analyzer's SA125 diagnostics (reports them all), so the two can never
+    drift."""
+    for k, v in ann.elements:
+        if k == "disable":
+            if str(v).strip().lower() not in ("true", "false"):
+                yield f"@app:fuse disable '{v}' must be true or false"
+        else:
+            yield (
+                f"unknown @app:fuse option '{k if k is not None else v}' "
+                "(expected disable)"
+            )
+
+
+def resolve_fuse_annotation(ann) -> bool:
+    """Whether whole-graph fusion is enabled for one app, from its
+    `@app:fuse` annotation (or None) plus the SIDDHI_TPU_FUSE env override.
+    Raises SiddhiAppCreationError on malformed options — the runtime analog
+    of the analyzer's SA125 diagnostic."""
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    enabled = True
+    if ann is not None:
+        for problem in iter_fuse_annotation_problems(ann):
+            raise SiddhiAppCreationError(problem)
+        enabled = (
+            str(ann.element("disable", "false")).strip().lower() != "true"
+        )
+    env = fuse_env_override()
+    if env is not None:
+        enabled = env
+    return enabled
+
+
+# ---------------------------------------------------------------------------
+# plan -> junction configuration
+# ---------------------------------------------------------------------------
+
+
+def _insert_reach(app) -> dict:
+    """stream id -> set of stream ids its events can reach through chains of
+    insert-into queries (the stream itself excluded unless a cycle feeds it
+    back). Used to veto partial fusion when a BLOCKED query's output can
+    re-enter the fused stream: per-batch it interleaves, post-chunk it
+    would not."""
+    from siddhi_tpu.analysis.cost import iter_query_entries
+
+    edges: dict[str, set] = {}
+    for _qid, q, _in_part in iter_query_entries(app):
+        target = getattr(q.output_stream, "target", None)
+        if target is None:
+            continue
+        for sid in _consumed_stream_ids(q):
+            edges.setdefault(sid, set()).add(target)
+
+    reach: dict[str, set] = {}
+
+    def closure(sid: str) -> set:
+        got = reach.get(sid)
+        if got is not None:
+            return got
+        reach[sid] = seen = set()
+        frontier = [sid]
+        while frontier:
+            nxt = frontier.pop()
+            for t in edges.get(nxt, ()):
+                if t not in seen:
+                    seen.add(t)
+                    frontier.append(t)
+        return seen
+
+    for sid in list(edges):
+        closure(sid)
+    return reach
+
+
+def _consumed_stream_ids(q) -> list:
+    from siddhi_tpu.query_api.execution import (
+        JoinInputStream,
+        SingleInputStream,
+        StateInputStream,
+        iter_state_streams,
+    )
+
+    stream = q.input_stream
+    if isinstance(stream, SingleInputStream):
+        return [stream.stream_id]
+    if isinstance(stream, JoinInputStream):
+        return [stream.left.stream_id, stream.right.stream_id]
+    if isinstance(stream, StateInputStream):
+        return [s.stream_id for s in iter_state_streams(stream.state)]
+    return []
+
+
+def _chain_share_key(qr):
+    """Runtime-level compatibility key for cross-query window-state sharing,
+    or None when this runtime cannot share. Defense in depth over the plan's
+    AST signature (which already proved the filter+window chains textually
+    identical): only plain single-stream QueryRuntimes with a pure
+    filter+window chain (no stream functions — their appended columns feed
+    the ring) whose live window stage opted into sharing
+    (`WindowStage.share_signature`, core/windows.py — plain ring/bucket
+    shapes only, never timer-armed) hold provably byte-identical chain
+    state."""
+    from siddhi_tpu.core.query_runtime import QueryRuntime
+
+    if type(qr) is not QueryRuntime:
+        return None
+    chain = getattr(qr, "chain", None)
+    win = getattr(chain, "window", None)
+    if win is None:
+        return None
+    if any(kind == "fn" for kind, _stage in chain.stages):
+        return None
+    return win.share_signature()
+
+
+def junction_fusion_configs(runtime) -> dict:
+    """stream id -> config dict for junctions where the FusionPlan formed a
+    fusable group that can engage against the live wiring. Config keys:
+
+    * ``endpoints`` — the group's FuseEndpoints (subscription order);
+    * ``residual`` — [(subscriber_fn, name)] left on the per-batch path;
+    * ``share_sets`` — lists of endpoint indices referencing one window ring;
+    * ``component`` — telemetry component (``stream.<S>.fusedgroup.<g>``);
+    * ``plan_group`` — the plan's group entry (predicted dispatch reduction).
+
+    Junctions with no entry fall back to the legacy all-or-nothing fused
+    path. Never raises: any mismatch between the static plan and the live
+    wiring simply drops that junction's config."""
+    from siddhi_tpu.analysis.cost import iter_query_entries
+    from siddhi_tpu.analysis.fusion import build_fusion_plan
+
+    plan = build_fusion_plan(runtime.app)
+    if not plan.groups:
+        return {}
+    shared_by_stream: dict[str, list] = {}
+    for s in plan.shared_state:
+        shared_by_stream.setdefault(s["stream"], []).append(s)
+    targets = {
+        qid: getattr(q.output_stream, "target", None)
+        for qid, q, _in_part in iter_query_entries(runtime.app)
+    }
+    reach = _insert_reach(runtime.app)
+
+    configs: dict = {}
+    for gi, g in enumerate(plan.groups):
+        sid = g["stream"]
+        j = runtime.junctions.get(sid)
+        if j is None:
+            continue
+        cand_by_qid = {}
+        for ep in j.fuse_candidates:
+            qid = getattr(ep.qr, "query_id", None)
+            if qid is not None and qid not in cand_by_qid:
+                cand_by_qid[qid] = ep
+        group_qids = [q for q in g["queries"] if q in cand_by_qid]
+        if len(group_qids) < 2:
+            continue
+        covered_names = {f"query.{q}" for q in group_qids}
+        # endpoints in subscription order (fuse_candidates are appended as
+        # queries subscribe), residual = every other live subscriber
+        endpoints = [
+            ep for ep in j.fuse_candidates
+            if getattr(ep.qr, "query_id", None) in set(group_qids)
+        ]
+        residual = []
+        unsafe = False
+        covered_subs = 0
+        for fn, name in zip(j.subscribers, j.subscriber_names):
+            if name in covered_names:
+                covered_subs += 1
+                continue
+            if name.startswith("query."):
+                qid = name[len("query."):]
+                if qid not in targets:
+                    unsafe = True  # unmapped query subscriber: veto
+                    break
+                t = targets[qid]
+                if t is not None and (
+                    t == sid or sid in reach.get(t, ())
+                ):
+                    # the blocked query's output can re-enter this stream:
+                    # post-chunk residual dispatch would reorder the group's
+                    # input relative to the per-batch interleave
+                    unsafe = True
+                    break
+            elif not name.startswith("aggregation."):
+                unsafe = True  # unknown consumer kind: veto, stay legacy
+                break
+            residual.append((fn, name))
+        if unsafe or covered_subs != len(endpoints):
+            continue
+
+        qid_to_idx = {
+            getattr(ep.qr, "query_id", None): i
+            for i, ep in enumerate(endpoints)
+        }
+        share_sets = []
+        for entry in shared_by_stream.get(sid, ()):  # plan candidates
+            members = [q for q in entry["queries"] if q in qid_to_idx]
+            if len(members) < 2:
+                continue
+            keys = {
+                _chain_share_key(endpoints[qid_to_idx[q]].qr)
+                for q in members
+            }
+            if len(keys) != 1 or None in keys:
+                continue  # runtime chains not provably identical
+            share_sets.append(sorted(qid_to_idx[q] for q in members))
+
+        configs[sid] = {
+            "endpoints": endpoints,
+            "residual": residual,
+            "share_sets": share_sets,
+            "component": g.get(
+                "component", f"stream.{sid}.fusedgroup.{gi}"
+            ),
+            "plan_group": g,
+        }
+    return configs

@@ -95,11 +95,61 @@ class FusedJunctionIngest:
         chunk_batches: int = 32,
         pipeline_enabled: bool = True,
         pipeline_depth: int = 2,
+        component: str = None,
+        residual=None,
+        share_sets=None,
+        plan_group=None,
     ):
         self.app = app
         self.junction = junction
         self.endpoints = list(endpoints)
         self.K = max(2, int(chunk_batches))
+        # plan-driven group mode (core/fusion_exec.py): `residual` holds the
+        # junction subscribers NOT in the fused group — after a fused send
+        # commits, every micro-batch is re-dispatched to them per batch, so
+        # blocked (SA124) queries keep byte-identical per-batch semantics
+        self.component = component or (
+            f"stream.{junction.schema.stream_id}.fused"
+        )
+        self.residual = list(residual or [])
+        self.plan_group = plan_group
+        # cross-query state sharing: each share set is a list of endpoint
+        # indices whose filter+window chain states are provably identical —
+        # the chunk program carries ONE canonical chain per set (the first
+        # member's) and every member reads it (see _build / _pack_arg0)
+        self.share_sets = [list(s) for s in (share_sets or []) if len(s) >= 2]
+        self._share_of = {
+            i: g for g, idxs in enumerate(self.share_sets) for i in idxs
+        }
+        self._share_leader = {
+            g: idxs[0] for g, idxs in enumerate(self.share_sets)
+        }
+        # surface the sharing in each member's describe_state(): one ring,
+        # refcounted across the set (observability/introspect.py), and arm
+        # the unshare guard: EVERY per-batch entry point that can donate a
+        # member's state funnels through QueryRuntime.receive (row sends,
+        # non-numeric send_columns, insert-into publishes, timer fires), so
+        # the guard there — under the same app process lock the fused
+        # writeback aliases chains under — is the one sound split point
+        for idxs in self.share_sets:
+            qids = [
+                getattr(self.endpoints[i].qr, "query_id", i) for i in idxs
+            ]
+            for i in idxs:
+                self.endpoints[i].qr.shared_ring = {
+                    "queries": qids,
+                    "leader": qids[0],
+                    "refcount": len(idxs),
+                }
+                self.endpoints[i].qr._unshare_guard = self._maybe_unshare
+        # True once a fused dispatch wrote back aliased chain states: the
+        # per-batch path donates per-query states independently, so any
+        # fall-back first un-aliases follower chains (_maybe_unshare)
+        self._aliased = False
+        # achieved-dispatch accounting (vs the plan's n*K -> 1 prediction)
+        self.chunks_dispatched = 0
+        self.batches_fused = 0
+        self.events_fused = 0
         self._fused = None
         self._fused_deliver = None
         self._disabled = False
@@ -133,7 +183,11 @@ class FusedJunctionIngest:
             "enabled": not self._disabled,
             "pipeline_enabled": self.pipeline_enabled,
             "depth": self.pipeline_depth if self.pipeline_enabled else 0,
+            "component": self.component,
         }
+        gr = self.group_report()
+        if gr is not None:
+            d["fusedgroup"] = gr
         ps = getattr(self.junction, "pipeline_stats", None)
         if ps is not None:
             d["occupancy"] = round(ps.occupancy(), 3)
@@ -141,6 +195,49 @@ class FusedJunctionIngest:
         if pl is not None:
             d.update(pl.describe_state())
         return d
+
+    def group_report(self) -> Optional[dict]:
+        """Achieved-vs-predicted dispatch reduction for a plan-driven fused
+        group (None for the legacy whole-junction engine): chunk/batch/event
+        counters, dispatches-per-chunk before/after, shared-ring refcounts.
+        Surfaced through describe_state(), runtime.explain(), and /profile."""
+        if self.plan_group is None:
+            return None
+        n = len(self.endpoints)
+        rep: dict = {
+            "component": self.component,
+            "queries": list(self.plan_group.get("queries", ())),
+            "chunks": self.chunks_dispatched,
+            "batches": self.batches_fused,
+            "events": self.events_fused,
+            "dispatches_per_chunk_before": self.plan_group.get(
+                "dispatches_per_chunk_before", n * self.K
+            ),
+            "dispatches_per_chunk_after": 1,
+            "predicted_dispatch_reduction": self.plan_group.get(
+                "est_dispatch_reduction"
+            ),
+        }
+        if self.batches_fused:
+            # per-batch equivalence: every fused micro-batch would have cost
+            # one dispatch per group member on the unfused path
+            rep["achieved_dispatch_reduction"] = round(
+                1.0 - self.chunks_dispatched / (self.batches_fused * n), 4
+            )
+        if self.residual:
+            rep["residual"] = [name for _fn, name in self.residual]
+        if self.share_sets:
+            rep["shared_state"] = [
+                {
+                    "queries": [
+                        getattr(self.endpoints[i].qr, "query_id", i)
+                        for i in idxs
+                    ],
+                    "refcount": len(idxs),
+                }
+                for idxs in self.share_sets
+            ]
+        return rep
 
     def wire_params(self):
         """(capacity, keep, narrow) — the exact wire codec the built fused
@@ -171,8 +268,8 @@ class FusedJunctionIngest:
             return False
         if getattr(self.app, "_debugger", None) is not None:
             return False
-        if len(j.subscribers) != len(self.endpoints):
-            return False  # an unfused subscriber is attached
+        if len(j.subscribers) != len(self.endpoints) + len(self.residual):
+            return False  # an uncovered subscriber is attached
         for ep in self.endpoints:
             qr = ep.qr
             if getattr(qr, "rate_limiter", None) is not None:
@@ -225,16 +322,42 @@ class FusedJunctionIngest:
         )
         impls = [ep.impl_factory() for ep in self.endpoints]
         impls_want = [ep.qr.output_events for ep in self.endpoints]
+        share_of = dict(self._share_of)
+        share_leader = dict(self._share_leader)
+        has_share = bool(self.share_sets)
 
-        def fused(states, tstates, wire, counts, bases, now):
+        def fused(states_all, tstates, wire, counts, bases, now):
+            # with share sets, arg0 = (per-endpoint states with shared-member
+            # chains STRIPPED, one canonical chain per set): the duplicate
+            # ring is carried (and donated) exactly once, and every member's
+            # window update reads the same buffers — XLA CSE collapses the
+            # identical update computations into one
+            if has_share:
+                states, shared0 = states_all
+            else:
+                states, shared0 = states_all, ()
+
             def body(carry, xs):
-                sts, tst = carry
+                (sts, shr), tst = carry
                 batch = decode(xs[0], xs[1], xs[2])
                 new_states = []
+                new_shr = list(shr)
                 auxes = []
                 outs = []
                 for ei, (impl, st) in enumerate(zip(impls, sts)):
+                    g = share_of.get(ei)
+                    if g is not None:
+                        # every member consumes the PREVIOUS iteration's
+                        # canonical chain — exactly what its own chain would
+                        # hold, by the share-set identity invariant
+                        st = dict(st)
+                        st["chain"] = shr[g]
                     st2, tst, out, aux = impl(st, tst, batch, now)
+                    if g is not None:
+                        st2 = dict(st2)
+                        ch = st2.pop("chain")
+                        if ei == share_leader[g]:
+                            new_shr[g] = ch
                     new_states.append(st2)
                     auxes.append(
                         tuple(
@@ -273,16 +396,20 @@ class FusedJunctionIngest:
                             {f"c.{n}": c for n, c in out.cols.items()}
                         )
                         outs.append((lanes, dv))
-                return (tuple(new_states), tst), (tuple(auxes), tuple(outs))
+                return (
+                    ((tuple(new_states), tuple(new_shr)), tst),
+                    (tuple(auxes), tuple(outs)),
+                )
 
-            (states, tstates), (aux_stack, out_stack) = lax.scan(
-                body, (states, tstates), (wire, counts, bases)
+            ((states, shared), tstates), (aux_stack, out_stack) = lax.scan(
+                body, ((states, shared0), tstates), (wire, counts, bases)
             )
+            states_out = (states, shared) if has_share else states
             aux_red = tuple(
                 tuple(v.any() for v in a) for a in aux_stack
             )
             if not deliver:
-                return states, tstates, aux_red, ()
+                return states_out, tstates, aux_red, ()
             # pack each endpoint's K compacted segments into ONE contiguous
             # ROW-MAJOR byte buffer [R, row_bytes]: the host drains exactly
             # the filled row prefix with a single contiguous slice transfer
@@ -323,7 +450,7 @@ class FusedJunctionIngest:
                 packs.append(
                     {"buf": jnp.concatenate([hdr, data_buf], axis=0)}
                 )
-            return states, tstates, aux_red, tuple(packs)
+            return states_out, tstates, aux_red, tuple(packs)
 
         # donate the per-endpoint states (exclusively owned); tstates may
         # alias read-only findables shared with other runtimes — not donated
@@ -442,7 +569,14 @@ class FusedJunctionIngest:
             fl = self.junction.flight
             if ok and fl is not None:
                 fl.record_columns(ts_arr, cols, n)
-            return ok
+            if not ok:
+                return False
+            if self.residual:
+                # fused chunks committed (group callbacks delivered at the
+                # barrier above); now the blocked consumers get the same
+                # events per batch, preserving their unfused semantics
+                self._residual_dispatch(ts_arr, cols, n, now)
+            return True
 
         # observability hooks: device-budget trackers on the junction plus
         # per-endpoint latency trackers (recording CHUNK dispatch wall time —
@@ -542,6 +676,7 @@ class FusedJunctionIngest:
                 if ep.qr.state is None:
                     ep.qr.state = ep.qr._fresh(ep.init_state(now))
                 states.append(ep.qr.state)
+            arg0 = self._pack_arg0(states)
             tstates = {}
             ep_tids = []
             for ep in self.endpoints:
@@ -563,8 +698,8 @@ class FusedJunctionIngest:
                 else 0
             )
             try:
-                new_states, tstates, aux_red, packs = prog(
-                    tuple(states), tstates, wire,
+                new_all, tstates, aux_red, packs = prog(
+                    arg0, tstates, wire,
                     counts, bases, np.int64(now),
                 )
                 if t0:
@@ -592,10 +727,7 @@ class FusedJunctionIngest:
 
                             hint = CAUSE_TAIL_K
                         ct.observe(
-                            "stream.{}.fused{}".format(
-                                self.junction.schema.stream_id,
-                                "_deliver" if deliver else "",
-                            ),
+                            self.component + ("_deliver" if deliver else ""),
                             prog, (K, int(wire.shape[1])), dt,
                             cause_hint=hint,
                         )
@@ -608,6 +740,7 @@ class FusedJunctionIngest:
                 # drops at most the failing batch and keeps going)
                 for ep in self.endpoints:
                     ep.qr.state = None
+                self._aliased = False
                 handler = self.junction.exception_handler
                 if handler is None:
                     raise
@@ -616,12 +749,14 @@ class FusedJunctionIngest:
             finally:
                 if span is not None:
                     tr.end_span(span)
-            for ep, st in zip(self.endpoints, new_states):
-                ep.qr.state = st
+            self._writeback_states(new_all)
             for ep, tids in zip(self.endpoints, ep_tids):
                 ep.qr._writeback_table_states(
                     {tid: tstates[tid] for tid in tids}
                 )
+        self.chunks_dispatched += 1
+        self.batches_fused += int(counts.shape[0])
+        self.events_fused += int(counts.sum())
         if self.junction.on_publish_stats is not None:
             self.junction.on_publish_stats(int(counts.sum()))
         for i, ep in enumerate(self.endpoints):
@@ -637,6 +772,96 @@ class FusedJunctionIngest:
         # buffer instead of reusing it.
         leaves = jax.tree_util.tree_leaves((aux_red, packs, tstates))
         return packs, (leaves[0] if leaves else None)
+
+    # ---- cross-query state sharing (plan share sets) ---------------------
+
+    def _pack_arg0(self, full_states):
+        """Program arg0 from the per-endpoint full states: with share sets,
+        shared members' chains are stripped and each set's canonical chain
+        (the leader's) rides once — so the shared ring's buffers are donated
+        exactly once per dispatch."""
+        if not self.share_sets:
+            return tuple(full_states)
+        stripped = tuple(
+            {k: v for k, v in st.items() if k != "chain"}
+            if i in self._share_of else st
+            for i, st in enumerate(full_states)
+        )
+        shared = tuple(
+            full_states[idxs[0]]["chain"] for idxs in self.share_sets
+        )
+        return (stripped, shared)
+
+    def _writeback_states(self, new_all) -> None:
+        """Write the program's output states back onto the runtimes; shared
+        members get the canonical chain re-attached (ALIASED across the set
+        — one ring serves every member until _maybe_unshare splits it)."""
+        if not self.share_sets:
+            for ep, st in zip(self.endpoints, new_all):
+                ep.qr.state = st
+            return
+        new_states, new_shared = new_all
+        for i, (ep, st) in enumerate(zip(self.endpoints, new_states)):
+            g = self._share_of.get(i)
+            if g is not None:
+                st = {**st, "chain": new_shared[g]}
+            ep.qr.state = st
+        self._aliased = True
+
+    def _maybe_unshare(self) -> None:
+        """Split aliased chain states before any per-batch dispatch can
+        donate them: each per-query jitted step donates its own state, and
+        two runtimes donating the SAME ring buffers would use-after-free.
+        Followers get a device copy; by the share-set identity invariant the
+        values stay equal, so a later fused send re-shares losslessly.
+
+        Called from each member's QueryRuntime.receive (the `_unshare_guard`
+        hook) INSIDE the app process lock — the lock the fused dispatch's
+        writeback aliases chains under — so the check cannot race an
+        in-flight fused send: either the writeback happened-before (the
+        guard splits here) or happens-after (our per-batch step ran on
+        unaliased state). Only share-set members pay the call; the lock is
+        an RLock the receive path already holds."""
+        with self.app._process_lock:
+            if not self._aliased:
+                return
+            self._aliased = False
+            for idxs in self.share_sets:
+                for i in idxs[1:]:
+                    qr = self.endpoints[i].qr
+                    st = qr.state
+                    if st is None or "chain" not in st:
+                        continue
+                    st = dict(st)
+                    st["chain"] = jax.tree_util.tree_map(
+                        lambda x: jnp.array(x, copy=True)
+                        if hasattr(x, "dtype") else x,
+                        st["chain"],
+                    )
+                    qr.state = st
+
+    # ---- residual per-batch dispatch (blocked queries) -------------------
+
+    def _residual_dispatch(self, ts_arr, cols, n: int, now: int) -> None:
+        """Re-dispatch the committed send per micro-batch to the junction
+        subscribers OUTSIDE the fused group (the plan's SA124-blocked
+        queries, aggregations): their per-batch semantics — rate limiters,
+        schedulers, observed insert targets — are preserved exactly, while
+        the group still collapsed its own n*K dispatches into one per chunk.
+        Events were already flight-recorded and throughput-counted by the
+        fused commit; dispatch_subset skips both."""
+        j = self.junction
+        B = j.batch_size
+        encode, decode = j.schema.packed_codec(B)
+        for ofs in range(0, n, B):
+            end = min(ofs + B, n)
+            m = end - ofs
+            buf = encode(
+                ts_arr[ofs:end],
+                {k: v[ofs:end] for k, v in cols.items()},
+                m,
+            )
+            j.dispatch_subset(decode(buf, np.int32(m)), now, self.residual)
 
     def _send_serial(
         self, prog, encode, deliver, dset, ts_arr, cols, n, B, now,
@@ -883,7 +1108,10 @@ class FusedJunctionIngest:
                     tstates.update(ep.qr._collect_table_states())
                 # zero counts: every lane is invalid, no state is observable;
                 # the throwaway states are donated, the table states are not
-                prog(states, tstates, wire, counts, bases, np.int64(now))
+                prog(
+                    self._pack_arg0(list(states)), tstates, wire, counts,
+                    bases, np.int64(now),
+                )
         except Exception:
             import logging
 

@@ -178,11 +178,14 @@ class SiddhiManager:
     def profile_reports(self) -> list:
         """One `profile_report()` dict per stats-enabled app (`/profile`):
         compile telemetry with cause taxonomy, top-K slowest chunk
-        waterfalls, p99/p999/p9999 of every latency histogram."""
+        waterfalls, p99/p999/p9999 of every latency histogram, and the
+        fused-group dispatch-reduction ledgers (core/fusion_exec.py)."""
         return [
-            rt.statistics_manager.profile_report()
-            for rt in list(self._runtimes.values())
-            if getattr(rt, "statistics_manager", None) is not None
+            rep
+            for rep in (
+                rt.profile_report() for rt in list(self._runtimes.values())
+            )
+            if rep is not None
         ]
 
     def explain_reports(self) -> dict:

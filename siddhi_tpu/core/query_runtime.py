@@ -289,6 +289,10 @@ class BaseQueryRuntime:
         self.query_callbacks: list[Callable] = []
         self.publish_fn: Optional[Callable] = None
         self._receive_lock = threading.RLock()
+        # armed by a fused group engine for cross-query shared-window members
+        # (core/ingest.py): called before every donated-state per-batch step
+        # to split chain buffers a fused dispatch aliased across queries
+        self._unshare_guard: Optional[Callable] = None
         # device-budget trackers (wired by the app runtime when statistics
         # are on): jitted-step dispatch time and host-blocking decode stalls
         self.device_step_tracker = None
@@ -364,12 +368,18 @@ class BaseQueryRuntime:
         """Introspection snapshot (pull-only; see observability/introspect).
         Subclasses add their stateful internals (window fill, NFA instance
         counts, join-side buffers)."""
-        return {
+        d = {
             "kind": type(self).__name__,
             "callbacks": len(self.query_callbacks),
             "rate_limited": self.rate_limiter is not None,
             "tables": sorted(self.tables),
         }
+        # cross-query state sharing (core/fusion_exec.py): this query's
+        # window ring is one refcounted buffer serving every query in the set
+        shared = getattr(self, "shared_ring", None)
+        if shared is not None:
+            d["shared_ring"] = dict(shared)
+        return d
 
     @staticmethod
     def _fresh(state):
@@ -752,6 +762,13 @@ class QueryRuntime(BaseQueryRuntime):
     # ---- host side -------------------------------------------------------
 
     def receive(self, batch: EventBatch, now: int) -> tuple[EventBatch, dict]:
+        # shared-window member (core/ingest.py share sets): split any chain
+        # buffers a fused dispatch aliased across queries BEFORE this step
+        # donates them. Callers hold the app process lock (the lock the
+        # fused writeback runs under), so the split cannot race an in-flight
+        # fused send. None — one attribute check — for every other query.
+        if self._unshare_guard is not None:
+            self._unshare_guard()
         with self._receive_lock:
             if self.state is None:
                 self.state = self._fresh(self.init_state())

@@ -331,29 +331,10 @@ class StreamJunction:
                 guarded = (
                     self.exception_handler is not None or self.fault_policy is not None
                 )
-                # one STREAM/STORE routing per batch even when several subscribers
-                # fail on it — fault consumers must not double-count a failure
-                routed = False
-                for i, fn in enumerate(self.subscribers):
-                    sp = (
-                        tr.start_span(self.subscriber_names[i], n_valid)
-                        if tr is not None
-                        else None
-                    )
-                    try:
-                        if not guarded:
-                            fn(batch, now)
-                        else:
-                            try:
-                                fn(batch, now)
-                            except Exception as e:  # user-owned failure policy
-                                routed |= self._on_dispatch_error(
-                                    batch, now, e, routed,
-                                    subscriber=self.subscriber_names[i],
-                                )
-                    finally:
-                        if sp is not None:
-                            tr.end_span(sp)
+                routed = self._fan_out(
+                    zip(self.subscribers, self.subscriber_names),
+                    batch, now, tr, n_valid, guarded,
+                )
                 if self.stream_callbacks:
                     try:
                         events = self.schema.from_batch(batch, self.interner)
@@ -389,6 +370,55 @@ class StreamJunction:
             finally:
                 if root is not None:
                     tr.end_span(root)
+
+    def _fan_out(
+        self, pairs, batch: EventBatch, now: int, tr, n_valid: int,
+        guarded: bool,
+    ) -> bool:
+        """Dispatch one batch to [(fn, name)] pairs — THE per-subscriber
+        loop, shared by publish_batch (all subscribers) and dispatch_subset
+        (the fused group engine's residual subset), so failure-policy and
+        tracing semantics cannot drift between the two paths. Returns the
+        routed flag: one STREAM/STORE routing per batch even when several
+        subscribers fail on it — fault consumers must not double-count a
+        failure."""
+        routed = False
+        for fn, name in pairs:
+            sp = tr.start_span(name, n_valid) if tr is not None else None
+            try:
+                if not guarded:
+                    fn(batch, now)
+                else:
+                    try:
+                        fn(batch, now)
+                    except Exception as e:  # user-owned failure policy
+                        routed |= self._on_dispatch_error(
+                            batch, now, e, routed, subscriber=name,
+                        )
+            finally:
+                if sp is not None:
+                    tr.end_span(sp)
+        return routed
+
+    def dispatch_subset(self, batch: EventBatch, now: int, subset) -> None:
+        """Fan one batch out to an explicit [(fn, name)] subscriber subset —
+        the fused group engine's residual path (core/ingest.py
+        `_residual_dispatch`): the plan's SA124-blocked consumers get every
+        micro-batch per batch, exactly as publish_batch would run them.
+        Throughput stats and the flight ring are NOT touched here — the
+        fused commit already counted and recorded these events; recording
+        again would double them. Per-subscriber failure policy and trace
+        spans ride the same _fan_out loop publish_batch uses."""
+        with self.lock:
+            tr = self.tracer
+            n_valid = (
+                int(np.asarray(batch.valid).sum()) if tr is not None else -1
+            )
+            guarded = (
+                self.exception_handler is not None
+                or self.fault_policy is not None
+            )
+            self._fan_out(subset, batch, now, tr, n_valid, guarded)
 
     def _on_dispatch_error(
         self,

@@ -82,6 +82,16 @@ class WindowStage:
         buffer, query/processor/stream/window/LengthWindowProcessor.java:144)."""
         raise NotImplementedError(f"{type(self).__name__} is not findable")
 
+    def share_signature(self):
+        """Canonical runtime identity for cross-query state sharing
+        (core/fusion_exec.py `_chain_share_key`): two window stages whose
+        signatures are equal and non-None hold byte-identical device state
+        under identical input, so one ring/bucket can serve both. The base
+        answer is None (never share) — only the plain ring (SlidingWindow)
+        and bucket (BatchWindow) shapes opt in; exotic windows (sort,
+        frequent, cron, ...) carry parameters this tuple cannot see."""
+        return None
+
     def describe_state(self, state) -> dict:
         """Introspection snapshot of the live buffer: type, fill, capacity,
         oldest/newest stored timestamps. Pull-only (one host read per call);
@@ -152,6 +162,13 @@ class SlidingWindow(WindowStage):
         self.t = duration_ms
         self.time_attr = time_attr
         self.needs_scheduler = use_scheduler
+
+    def share_signature(self):
+        if self.needs_scheduler:
+            return None  # timer-armed: host scheduling owns per-query state
+        return (
+            "SlidingWindow", self.w, self.t, self.time_attr,
+        )
 
     def init_state(self):
         w = self.w
@@ -477,6 +494,16 @@ class BatchWindow(WindowStage):
         self.timeout_ms = timeout_ms
         self.needs_scheduler = use_scheduler or timeout_ms is not None
         self.start_time = start_time
+
+    def share_signature(self):
+        if self.needs_scheduler:
+            return None  # timer-armed: host scheduling owns per-query state
+        # emit_expired is part of the identity: the query runtime clears it
+        # per query, and a no-expired bucket may skip prev-bucket writes
+        return (
+            "BatchWindow", self.w, self.n, self.t, self.time_attr,
+            self.start_time, self.emit_expired,
+        )
 
     def init_state(self):
         w = self.w

@@ -210,6 +210,7 @@ def build_plan(app, runtime=None) -> dict:
             counters["rate_1m"] = round(
                 sm.throughput[f"stream.{sid}"].rate_1m, 3
             )
+        fused_component = f"stream.{sid}.fused"
         if runtime is not None:
             j = runtime.junctions.get(sid)
             if j is not None:
@@ -221,10 +222,18 @@ def build_plan(app, runtime=None) -> dict:
                             "pipelined" if fi.pipeline_enabled else "serial"
                         )
                         counters["chunk_batches"] = fi.K
+                        # plan-driven group engine: the achieved-vs-predicted
+                        # dispatch-reduction ledger (core/fusion_exec.py),
+                        # under the cost model's component taxonomy
+                        # (stream.<S>.fusedgroup.<g>)
+                        fused_component = fi.component
+                        gr = fi.group_report()
+                        if gr is not None:
+                            counters["fusedgroup"] = gr
                 except Exception:
                     pass
         if ct is not None:
-            comp = ct.component(f"stream.{sid}.fused")
+            comp = ct.component(fused_component)
             if comp is not None:
                 counters["compile"] = comp
         if counters:
@@ -374,6 +383,26 @@ def _fmt_counters(c: Optional[dict]) -> str:
     if "latency_ms" in c:
         lm = c["latency_ms"]
         parts.append(f"p50={lm['p50']}ms p99={lm['p99']}ms")
+    if "fusedgroup" in c:
+        g = c["fusedgroup"]
+        pred = g.get("predicted_dispatch_reduction")
+        ach = g.get("achieved_dispatch_reduction")
+        parts.append(
+            f"fusedgroup[{','.join(g.get('queries', ()))}] "
+            f"chunks={g.get('chunks')} "
+            f"dispatch {g.get('dispatches_per_chunk_before')}->"
+            f"{g.get('dispatches_per_chunk_after')}/chunk"
+            + (f" pred=-{pred * 100:.1f}%" if pred is not None else "")
+            + (f" meas=-{ach * 100:.1f}%" if ach is not None else "")
+            + (
+                f" shared={len(g['shared_state'])}"
+                if g.get("shared_state") else ""
+            )
+            + (
+                f" residual={len(g['residual'])}"
+                if g.get("residual") else ""
+            )
+        )
     if "compile" in c:
         comp = c["compile"]
         causes = ",".join(

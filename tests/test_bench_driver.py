@@ -59,6 +59,46 @@ class TestBenchDriverExitPaths:
             f["error"] == "skipped(deadline)" for f in failed
         ), failed
 
+    def test_bench_budget_env_trims_and_emits_final_json(self):
+        """SIDDHI_TPU_BENCH_BUDGET=<seconds> (no --deadline flag at all —
+        the harness shape): a tiny budget caps the overall deadline AND the
+        per-leg subprocess timeouts; every leg is skip-recorded and the
+        final line is parseable JSON."""
+        env = _env()
+        env["SIDDHI_TPU_BENCH_BUDGET"] = "12"
+        proc = subprocess.run(
+            [sys.executable, BENCH],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        got = _last_json_line(proc.stdout)
+        assert got["metric"] == "engine_throughput_geomean"
+        failed = got["detail"].get("failed_legs", [])
+        assert failed and all(
+            f["error"] == "skipped(deadline)" for f in failed
+        ), failed
+
+    def test_per_leg_snapshot_lines_are_parseable(self):
+        """Every completed leg prints a snapshot JSON line (the SIGKILL
+        defense: a hard kill mid-suite still leaves a parseable tail).
+        With a sub-floor deadline no legs run, but each skip still updates
+        detail — assert every non-final line parses and carries the
+        partial marker."""
+        proc = subprocess.run(
+            [sys.executable, BENCH, "--deadline", "5"],
+            capture_output=True, text=True, timeout=120, env=_env(),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [
+            ln for ln in proc.stdout.strip().splitlines() if ln.strip()
+        ]
+        assert len(lines) >= 2  # snapshots + the final line
+        for ln in lines[:-1]:
+            snap = json.loads(ln)
+            assert snap["metric"] == "engine_throughput_geomean"
+            assert snap["detail"].get("partial_through_leg")
+        assert "partial_through_leg" not in json.loads(lines[-1])["detail"]
+
     def test_sigterm_mid_leg_emits_final_json(self):
         """SIGTERM while a leg subprocess is running (what `timeout -k`
         sends first): the handler must emit the final JSON line before the

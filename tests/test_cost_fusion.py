@@ -57,6 +57,7 @@ SNAPSHOT_PLAN = {
     "groups": [
         {
             "stream": "S",
+            "component": "stream.S.fusedgroup.0",
             "queries": ["avg50", "max50"],
             "chunk": {"batch_size": 64, "chunk_batches": 32},
             "state_bytes": 3200,

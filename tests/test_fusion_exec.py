@@ -1,0 +1,386 @@
+"""Plan-driven whole-graph fusion (core/fusion_exec.py + the group mode of
+core/ingest.py FusedJunctionIngest).
+
+The FusionPlan's fusable groups run as ONE donated-state chunk program per
+stream; SA124-blocked queries ride the residual per-batch path after each
+fused commit; shared-state candidates reference one refcounted window ring.
+Every case here holds the byte-parity contract: outputs under the group
+engine must equal the same app run with fusion disabled
+(@app:fuse(disable='true') / SIDDHI_TPU_FUSE=0)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+
+@pytest.fixture(autouse=True)
+def _isolate_fuse_env(monkeypatch):
+    """CI runs parts of the suite under SIDDHI_TPU_FUSE=1|0; these tests set
+    the toggle explicitly per case."""
+    monkeypatch.delenv("SIDDHI_TPU_FUSE", raising=False)
+
+
+HEAD = "@app:batch(size='32')\ndefine stream S (symbol string, price float, volume long);\n"
+
+# three fusable queries (two sharing an identical filter+window chain) plus
+# one rate-limited query — the plan forms a group of three, shares one ring,
+# and leaves q4 on the residual path (hazard: rate-limit)
+GROUP_QL = HEAD + """
+@info(name='q1') from S[price > 50]#window.length(16) select symbol, avg(price) as ap insert into Out1;
+@info(name='q2') from S[price > 50]#window.length(16) select symbol, max(price) as mx insert into Out2;
+@info(name='q3') from S#window.lengthBatch(8) select sum(volume) as tv insert into Out3;
+@info(name='q4') from S[volume > 300] select symbol, volume output every 5 events insert into Out4;
+"""
+
+
+def _feed(n, seed=11):
+    rng = np.random.default_rng(seed)
+    return (
+        np.arange(n, dtype=np.int64) + 1_700_000_000_000,
+        {
+            "symbol": rng.integers(1, 5, size=n).astype(np.int32),
+            "price": rng.uniform(0.0, 100.0, size=n).astype(np.float32),
+            "volume": rng.integers(1, 1000, size=n).astype(np.int64),
+        },
+    )
+
+
+def _run(ql, n=96, sends=1, keep_runtime=False, seed=11):
+    """Run `ql` on a columnar feed; returns ({qid: rows}, runtime-or-None).
+    With keep_runtime the caller must shut the runtime down."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    for s in ("A", "B", "C", "D"):
+        mgr.interner.intern(s)
+    rows = {qid: [] for qid in rt.queries}
+    for qid in rt.queries:
+        rt.add_callback(
+            qid,
+            lambda ts, ins, rem, _q=qid: rows[_q].append(
+                (
+                    tuple(tuple(e.data) for e in (ins or [])),
+                    tuple(tuple(e.data) for e in (rem or [])),
+                )
+            ),
+        )
+    rt.start()
+    ts, cols = _feed(n, seed)
+    for _ in range(sends):
+        rt.get_input_handler("S").send_columns(ts, cols, now=int(ts[-1]))
+    if keep_runtime:
+        return rows, (mgr, rt)
+    rt.shutdown()
+    mgr.shutdown()
+    return rows, None
+
+
+class TestGroupEngine:
+    def test_group_formed_with_residual_and_shared_ring(self):
+        rows, (mgr, rt) = _run(GROUP_QL, keep_runtime=True)
+        try:
+            fi = rt.junctions["S"].fused_ingest
+            assert fi is not None and fi.plan_group is not None
+            rep = fi.group_report()
+            assert rep["queries"] == ["q1", "q2", "q3"]
+            assert rep["residual"] == ["query.q4"]
+            assert rep["chunks"] >= 1  # the fused path actually engaged
+            assert rep["dispatches_per_chunk_after"] == 1
+            assert rep["shared_state"] == [
+                {"queries": ["q1", "q2"], "refcount": 2}
+            ]
+            # achieved reduction: n*K per-batch dispatches became `chunks`
+            assert 0 < rep["achieved_dispatch_reduction"] <= 1
+            # surfaced through junction introspection too
+            assert (
+                rt.junctions["S"].describe_state()["pipeline"]["fusedgroup"]
+                == rep
+            )
+            # ... and per query: one refcounted ring
+            q1 = rt.queries["q1"].describe_state()
+            assert q1["shared_ring"]["refcount"] == 2
+            assert q1["shared_ring"]["leader"] == "q1"
+            assert "shared_ring" not in rt.queries["q3"].describe_state()
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_group_outputs_match_unfused(self):
+        fused, _ = _run(GROUP_QL, n=96, sends=2)
+        unfused, _ = _run(
+            "@app:fuse(disable='true')\n" + GROUP_QL, n=96, sends=2
+        )
+        assert set(fused) == set(unfused)
+        for qid in fused:
+            assert fused[qid] == unfused[qid], qid
+
+    def test_env_force_off_beats_annotation(self, monkeypatch):
+        monkeypatch.setenv("SIDDHI_TPU_FUSE", "0")
+        rows, (mgr, rt) = _run(GROUP_QL, keep_runtime=True)
+        try:
+            assert all(
+                j.fused_ingest is None for j in rt.junctions.values()
+            )
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_annotation_disable(self):
+        _rows, (mgr, rt) = _run(
+            "@app:fuse(disable='true')\n" + GROUP_QL, keep_runtime=True
+        )
+        try:
+            assert all(
+                j.fused_ingest is None for j in rt.junctions.values()
+            )
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_env_force_on_beats_annotation(self, monkeypatch):
+        monkeypatch.setenv("SIDDHI_TPU_FUSE", "1")
+        _rows, (mgr, rt) = _run(
+            "@app:fuse(disable='true')\n" + GROUP_QL, keep_runtime=True
+        )
+        try:
+            assert rt.junctions["S"].fused_ingest is not None
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_single_query_junction_keeps_legacy_engine(self):
+        ql = HEAD + (
+            "@info(name='q') from S[price > 10] select symbol, price "
+            "insert into Out;\n"
+        )
+        _rows, (mgr, rt) = _run(ql, keep_runtime=True)
+        try:
+            fi = rt.junctions["S"].fused_ingest
+            assert fi is not None
+            assert fi.plan_group is None  # legacy all-or-nothing engine
+            assert fi.group_report() is None
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+
+class TestSharedState:
+    def test_shared_chains_alias_after_fused_send(self):
+        _rows, (mgr, rt) = _run(GROUP_QL, keep_runtime=True)
+        try:
+            import jax
+
+            q1 = rt.queries["q1"]
+            q2 = rt.queries["q2"]
+            l1 = jax.tree_util.tree_leaves(q1.state["chain"])
+            l2 = jax.tree_util.tree_leaves(q2.state["chain"])
+            assert all(a is b for a, b in zip(l1, l2))  # ONE ring
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_unshare_on_per_batch_fallback_keeps_parity(self):
+        """A short send (below the 2-batch fused threshold) after a fused
+        send rides the per-batch path: the aliased chains must split first
+        (independent donation) and the outputs must stay byte-identical to
+        a never-fused run of the same sequence."""
+
+        def run(ql):
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(ql)
+            for s in ("A", "B", "C", "D"):
+                mgr.interner.intern(s)
+            rows = {qid: [] for qid in rt.queries}
+            for qid in rt.queries:
+                rt.add_callback(
+                    qid,
+                    lambda ts, ins, rem, _q=qid: rows[_q].append(
+                        (
+                            tuple(tuple(e.data) for e in (ins or [])),
+                            tuple(tuple(e.data) for e in (rem or [])),
+                        )
+                    ),
+                )
+            rt.start()
+            ts, cols = _feed(96)
+            h = rt.get_input_handler("S")
+            h.send_columns(ts, cols, now=int(ts[-1]))  # fused chunk
+            short_ts, short_cols = _feed(8, seed=3)  # per-batch fallback
+            h.send_columns(short_ts, short_cols, now=int(short_ts[-1]))
+            h.send_columns(ts, cols, now=int(ts[-1]))  # re-fuses
+            import jax
+
+            q1, q2 = rt.queries["q1"], rt.queries["q2"]
+            alias = [
+                a is b
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(q1.state["chain"]),
+                    jax.tree_util.tree_leaves(q2.state["chain"]),
+                )
+            ]
+            rt.shutdown()
+            mgr.shutdown()
+            return rows, alias
+
+        fused_rows, alias = run(GROUP_QL)
+        assert all(alias)  # the final fused send re-shared the ring
+        unfused_rows, _ = run("@app:fuse(disable='true')\n" + GROUP_QL)
+        for qid in fused_rows:
+            assert fused_rows[qid] == unfused_rows[qid], qid
+
+    def test_row_send_after_fused_send_keeps_parity(self):
+        """Row-based send() events after a fused send reach the shared-ring
+        queries through StreamJunction.send_rows -> publish_batch — a path
+        that never consults try_send. The receive-side unshare guard
+        (QueryRuntime._unshare_guard) must split the aliased chains before
+        each per-batch step donates them: without it, q1's step donates the
+        shared ring buffers and q2's step consumes freed device memory."""
+
+        def run(ql):
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(ql)
+            for s in ("A", "B", "C", "D"):
+                mgr.interner.intern(s)
+            rows = {qid: [] for qid in rt.queries}
+            for qid in rt.queries:
+                rt.add_callback(
+                    qid,
+                    lambda ts, ins, rem, _q=qid: rows[_q].append(
+                        (
+                            tuple(tuple(e.data) for e in (ins or [])),
+                            tuple(tuple(e.data) for e in (rem or [])),
+                        )
+                    ),
+                )
+            rt.start()
+            ts, cols = _feed(96)
+            h = rt.get_input_handler("S")
+            h.send_columns(ts, cols, now=int(ts[-1]))  # fused: aliases rings
+            base = int(ts[-1]) + 1
+            for k in range(6):  # row path: publish_batch, never try_send
+                h.send(("A", 60.0 + k, 500), timestamp=base + k)
+            h.send_columns(ts, cols, now=int(ts[-1]))  # re-fuses
+            rt.shutdown()
+            mgr.shutdown()
+            return rows
+
+        fused_rows = run(GROUP_QL)
+        unfused_rows = run("@app:fuse(disable='true')\n" + GROUP_QL)
+        for qid in fused_rows:
+            assert fused_rows[qid] == unfused_rows[qid], qid
+
+
+class TestFuseAnnotation:
+    def test_malformed_disable_raises_at_creation(self):
+        with pytest.raises(SiddhiAppCreationError, match="disable"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "@app:fuse(disable='maybe')\n" + GROUP_QL
+            )
+
+    def test_unknown_option_raises_at_creation(self):
+        with pytest.raises(SiddhiAppCreationError, match="turbo"):
+            SiddhiManager().create_siddhi_app_runtime(
+                "@app:fuse(turbo='on')\n" + GROUP_QL
+            )
+
+    def test_analyzer_sa125_same_rule_set(self):
+        from siddhi_tpu.analysis import analyze
+
+        r = analyze("@app:fuse(disable='maybe', turbo='on')\n" + GROUP_QL)
+        codes = [d.code for d in r.diagnostics]
+        assert codes.count("SA125") == 2
+
+    def test_valid_annotation_lints_clean(self):
+        from siddhi_tpu.analysis import analyze
+
+        r = analyze("@app:fuse(disable='false')\n" + GROUP_QL)
+        assert not [d for d in r.diagnostics if d.code == "SA125"]
+
+
+class TestObservability:
+    def test_explain_and_profile_surface_the_group(self):
+        _rows, (mgr, rt) = _run(
+            "@app:statistics(reporter='none')\n" + GROUP_QL,
+            keep_runtime=True,
+        )
+        try:
+            plan = rt.explain_plan()
+            snode = next(
+                n for n in plan["nodes"] if n["id"] == "stream:S"
+            )
+            g = snode["counters"]["fusedgroup"]
+            assert g["component"] == "stream.S.fusedgroup.0"
+            assert g["queries"] == ["q1", "q2", "q3"]
+            assert g["dispatches_per_chunk_after"] == 1
+            text = rt.explain()
+            assert "fusedgroup[q1,q2,q3]" in text
+            prof = rt.profile_report()
+            groups = prof["fused_groups"]
+            assert groups[0]["stream"] == "S"
+            assert groups[0]["chunks"] >= 1
+            # the chunk program's compile ledger rides the SAME component
+            # name the cost model predicts (stream.<S>.fusedgroup.<g>)
+            assert any(
+                comp.startswith("stream.S.fusedgroup.0")
+                for comp in prof["compile"]
+            )
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_plan_component_matches_engine(self):
+        from siddhi_tpu.analysis.fusion import build_fusion_plan
+
+        _rows, (mgr, rt) = _run(GROUP_QL, keep_runtime=True)
+        try:
+            plan = build_fusion_plan(rt.app)
+            fi = rt.junctions["S"].fused_ingest
+            assert plan.groups[0]["component"] == fi.component
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+
+class TestResidualSafety:
+    def test_feedback_into_fused_stream_vetoes_partial_fusion(self):
+        """A rate-limited (blocked) query whose output re-enters S must NOT
+        ride the residual path: post-chunk re-dispatch would reorder the
+        group's input. The junction falls back to the legacy all-or-nothing
+        path (which never engages here)."""
+        ql = HEAD + """
+        @info(name='q1') from S[price > 50]#window.length(16) select symbol, avg(price) as ap insert into Out1;
+        @info(name='q2') from S#window.lengthBatch(8) select symbol, sum(volume) as tv group by symbol insert into Out2;
+        @info(name='q4') from S select symbol, price, volume output every 5 events insert into Loop;
+        @info(name='q5') from Loop select symbol, price, volume insert into S;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        rt.start()
+        try:
+            fi = rt.junctions["S"].fused_ingest
+            assert fi is None or fi.plan_group is None
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_group_engine_respects_late_subscriber_count(self):
+        """eligible() re-checks subscriber accounting every send: detaching
+        nothing but adding a raw subscriber after start() must disengage the
+        fused path (count mismatch), not corrupt it."""
+        _rows, (mgr, rt) = _run(GROUP_QL, keep_runtime=True)
+        try:
+            j = rt.junctions["S"]
+            fi = j.fused_ingest
+            before = fi.chunks_dispatched
+            j.subscribe(lambda b, now: None, name="late")
+            ts, cols = _feed(96)
+            rt.get_input_handler("S").send_columns(
+                ts, cols, now=int(ts[-1])
+            )
+            assert fi.chunks_dispatched == before  # fell back per-batch
+        finally:
+            rt.shutdown()
+            mgr.shutdown()

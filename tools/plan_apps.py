@@ -46,6 +46,9 @@ def main() -> int:
 
     for name, (ql, _stream, _mult, _batch) in sorted(bench.WORKLOADS.items()):
         jobs.append((f"bench_{name}", ql))
+    # the timebudget leg's multi-query fused-group app: the one bench app
+    # whose plan actually FORMS a group (the headline legs are single-query)
+    jobs.append(("bench_fusedgroup", bench.FUSED_GROUP_QL))
 
     failures = 0
     index = []
