@@ -17,9 +17,13 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
+from siddhi_tpu.core.admission import (  # noqa: E402,F401
+    AdmissionRejectedError,
+)
 from siddhi_tpu.core.error_store import (  # noqa: E402,F401
     FileErrorStore,
     InMemoryErrorStore,
+    SqliteErrorStore,
 )
 from siddhi_tpu.core.manager import SiddhiManager  # noqa: E402,F401
 from siddhi_tpu.core.types import AttrType  # noqa: E402,F401
@@ -46,6 +50,8 @@ __all__ = [
     "AttrType",
     "InMemoryErrorStore",
     "FileErrorStore",
+    "SqliteErrorStore",
+    "AdmissionRejectedError",
     "analyze",
     "AnalysisResult",
     "Diagnostic",

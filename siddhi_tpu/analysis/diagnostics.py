@@ -56,6 +56,12 @@ CODES: dict[str, str] = {
              "its stream's fusable group (warning)",
     "SA125": "invalid @app:fuse annotation (unknown option or bad "
              "disable value)",
+    "SA126": "invalid @app:persist annotation (bad interval / bad keep / "
+             "unknown key)",
+    "SA127": "invalid @app:restart annotation (unknown policy / bad "
+             "max.attempts / bad backoff)",
+    "SA128": "invalid @app:admission annotation (unknown policy / bad "
+             "rate.limit or max.pending / no bound declared)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

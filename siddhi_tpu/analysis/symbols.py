@@ -122,6 +122,31 @@ def _check_fuse_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
         diags.append(Diagnostic("SA125", problem))
 
 
+def _check_supervision_annotations(
+    app: SiddhiApp, diags: list[Diagnostic]
+) -> None:
+    """Validate the supervised-runtime app annotations — `@app:persist`
+    (SA126), `@app:restart` (SA127), `@app:admission` (SA128) — using the
+    SAME rule sets the runtime resolvers raise on (core/supervision.py,
+    core/admission.py), so analyzer and runtime can never drift."""
+    from siddhi_tpu.core.admission import iter_admission_annotation_problems
+    from siddhi_tpu.core.supervision import (
+        iter_persist_annotation_problems,
+        iter_restart_annotation_problems,
+    )
+
+    for name, code, rules in (
+        ("app:persist", "SA126", iter_persist_annotation_problems),
+        ("app:restart", "SA127", iter_restart_annotation_problems),
+        ("app:admission", "SA128", iter_admission_annotation_problems),
+    ):
+        ann = find_annotation(app.annotations, name)
+        if ann is None:
+            continue
+        for problem in rules(ann):
+            diags.append(Diagnostic(code, problem))
+
+
 def _apply_selfmon_annotation(
     app: SiddhiApp, sym: SymbolTable, diags: list[Diagnostic]
 ) -> None:
@@ -188,11 +213,45 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
             fault["_error"] = AttrType.STRING
             sym.streams["!" + sid] = fault
 
+    from siddhi_tpu.core.error_store import (
+        iter_definition_onerror_problems,
+        resolve_definition_onerror_action,
+    )
+
     for tid, d in app.table_definitions.items():
         sym.tables[tid] = _attrs_schema(d, diags, "table")
+        oe = find_annotation(d.annotations, "OnError")
+        if oe is None:
+            continue
+        # ONE rule set with the runtime wiring (core/error_store.py —
+        # like SA126-128 ride the core/supervision.py resolvers)
+        for tag, msg in iter_definition_onerror_problems(oe, "table", tid):
+            diags.append(Diagnostic(
+                "SA110" if tag == "action" else "SA111", msg,
+                getattr(d, "line", None), getattr(d, "col", None),
+            ))
 
     for wid, d in app.window_definitions.items():
         sym.windows[wid] = _attrs_schema(d, diags, "window")
+        oe = find_annotation(d.annotations, "OnError")
+        if oe is None:
+            continue
+        schema = sym.windows[wid] or {}
+        problems = list(iter_definition_onerror_problems(
+            oe, "window", wid, schema
+        ))
+        for tag, msg in problems:
+            diags.append(Diagnostic(
+                "SA110" if tag == "action" else "SA111", msg,
+                getattr(d, "line", None), getattr(d, "col", None),
+            ))
+        if any(tag == "action" for tag, _msg in problems):
+            continue
+        if resolve_definition_onerror_action(oe) == "STREAM":
+            sym.fault_parents.add(wid)
+            fault = dict(schema)
+            fault["_error"] = AttrType.STRING
+            sym.streams["!" + wid] = fault
 
     # triggers each define a stream <id>(triggered_time long)
     # (reference: DefinitionParserHelper trigger stream registration)
@@ -209,5 +268,6 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
 
     _apply_selfmon_annotation(app, sym, diags)
     _check_fuse_annotation(app, diags)
+    _check_supervision_annotations(app, diags)
 
     return sym

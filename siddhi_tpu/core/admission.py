@@ -1,0 +1,339 @@
+"""Per-app admission control: bounded ingress with overload policies.
+
+One bursting tenant must degrade ITSELF, not the manager: without a bound,
+a single app's ingest burst eats the host CPU (encode, dispatch) and the
+device queue that every other app on the manager shares. The
+`@app:admission(...)` annotation (validated as SA128, shared rule set with
+the analyzer) puts a gate in front of every input handler of the app:
+
+    @app:admission(rate.limit='50000', policy='shed_newest',
+                   max.pending='8192', block.timeout='5 sec')
+
+- `rate.limit` — events/second quota, enforced by a token bucket whose
+  burst equals one second of quota (the same smoothing horizon as the
+  EWMA rate trackers that report it).
+- `max.pending` — bound on the app's buffered ingress (@async ring/queue
+  depth); senders into an over-bound app hit the policy below.
+- `policy` — what happens to events over quota/bound:
+    block        back-pressure the sender until capacity frees (bounded by
+                 `block.timeout`, default 5 sec; remainder sheds, counted)
+    shed_newest  keep the head of the incoming call, drop the tail
+    shed_oldest  keep the tail (freshest data), drop the head; on python-
+                 queue @async junctions the oldest QUEUED events are
+                 drained first
+    error        raise AdmissionRejectedError to the sender
+
+Shed/blocked counts are metered: `runtime.snapshot_status()['admission']`
+(=> `/status.json`), Prometheus (`siddhi_admission_shed_total`,
+`siddhi_admission_blocked_ms_total` via `manager.prometheus_text()`), and
+the selfmon stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+
+class AdmissionRejectedError(RuntimeError):
+    """Raised to the sender under `policy='error'` when the app is over its
+    admission bound/quota."""
+
+
+ADMISSION_POLICIES = ("block", "shed_oldest", "shed_newest", "error")
+_DEFAULT_BLOCK_TIMEOUT_MS = 5_000
+
+
+def _parse_time_ms(v) -> Optional[int]:
+    from siddhi_tpu.core.supervision import _parse_time_ms as p
+
+    return p(v)
+
+
+@dataclass
+class AdmissionConfig:
+    policy: str = "shed_newest"
+    rate_eps: Optional[float] = None  # events/second quota
+    max_pending: Optional[int] = None  # bound on buffered ingress
+    block_timeout_ms: int = _DEFAULT_BLOCK_TIMEOUT_MS
+
+
+def iter_admission_annotation_problems(ann):
+    """Yield one message per `@app:admission` problem — THE validation
+    rules, shared by the runtime resolver and the analyzer's SA128."""
+    keys = {k for k, _v in ann.elements}
+    for k, v in ann.elements:
+        if k == "policy":
+            if str(v).strip().lower() not in ADMISSION_POLICIES:
+                yield (
+                    f"@app:admission policy '{v}' must be one of "
+                    f"{ADMISSION_POLICIES}"
+                )
+        elif k == "rate.limit":
+            try:
+                ok = float(str(v).strip()) > 0
+            except ValueError:
+                ok = False
+            if not ok:
+                yield (
+                    f"@app:admission rate.limit '{v}' must be a positive "
+                    "events/second number"
+                )
+        elif k == "max.pending":
+            try:
+                ok = int(str(v).strip()) > 0
+            except ValueError:
+                ok = False
+            if not ok:
+                yield (
+                    f"@app:admission max.pending '{v}' must be a positive "
+                    "event count"
+                )
+        elif k == "block.timeout":
+            if _parse_time_ms(v) is None:
+                yield (
+                    f"@app:admission block.timeout '{v}' must be a time "
+                    "constant (e.g. '5 sec')"
+                )
+        else:
+            yield (
+                f"unknown @app:admission option "
+                f"'{k if k is not None else v}' (expected policy, "
+                "rate.limit, max.pending, block.timeout)"
+            )
+    if "rate.limit" not in keys and "max.pending" not in keys:
+        yield (
+            "@app:admission needs at least one bound: rate.limit (events/s) "
+            "or max.pending (buffered events)"
+        )
+
+
+def resolve_admission_annotation(ann) -> AdmissionConfig:
+    """AdmissionConfig from `@app:admission(...)`. Raises
+    SiddhiAppCreationError on malformed options — the runtime analog of
+    SA128."""
+    for problem in iter_admission_annotation_problems(ann):
+        raise SiddhiAppCreationError(problem)
+    cfg = AdmissionConfig()
+    v = ann.element("policy")
+    if v is not None:
+        cfg.policy = str(v).strip().lower()
+    v = ann.element("rate.limit")
+    if v is not None:
+        cfg.rate_eps = float(v)
+    v = ann.element("max.pending")
+    if v is not None:
+        cfg.max_pending = int(v)
+    v = ann.element("block.timeout")
+    if v is not None:
+        cfg.block_timeout_ms = _parse_time_ms(v)
+    return cfg
+
+
+class AdmissionController:
+    """One per app (owned by SiddhiAppRuntime). Thread-safe: concurrent
+    senders contend on one lock around the token-bucket arithmetic only —
+    blocking sleeps happen outside it."""
+
+    def __init__(self, app_name: str, config: AdmissionConfig) -> None:
+        self.app_name = app_name
+        self.config = config
+        self._lock = threading.Lock()
+        # token bucket: burst = one second of quota (>= 1 so a quota under
+        # 1 ev/s still admits single events)
+        self._burst = max(config.rate_eps or 0.0, 1.0)
+        self._tokens = self._burst
+        self._t_last = time.monotonic()
+        self.admitted = 0
+        self.shed = 0
+        self.blocked_ms = 0.0
+        self.rejected = 0
+
+    # ---- token bucket ----------------------------------------------------
+
+    def _refill(self, now: float) -> None:
+        rate = self.config.rate_eps
+        if rate is None:
+            return
+        self._tokens = min(
+            self._burst, self._tokens + (now - self._t_last) * rate
+        )
+        self._t_last = now
+
+    def _take(self, n: int) -> int:
+        """Take up to n tokens; returns how many were granted."""
+        if self.config.rate_eps is None:
+            return n
+        with self._lock:
+            now = time.monotonic()
+            self._refill(now)
+            k = int(min(n, self._tokens))
+            self._tokens -= k
+            return k
+
+    def _refund(self, k: int) -> None:
+        """Return unused tokens to the bucket (events that were quota-
+        granted but not admitted — pending-bound overflow, clean reject)."""
+        if self.config.rate_eps is None or k <= 0:
+            return
+        with self._lock:
+            self._tokens = min(self._burst, self._tokens + k)
+
+    def _pending_room(self, junction, n: int) -> int:
+        """How many of n rows fit under max.pending right now."""
+        mp = self.config.max_pending
+        if mp is None:
+            return n
+        room = mp - junction.queued()
+        return max(0, min(n, room))
+
+    # ---- admission -------------------------------------------------------
+
+    def admit(self, n: int, junction) -> tuple[int, int]:
+        """Admit up to `n` incoming rows against the quota and the pending
+        bound. Returns (start, end): the slice of the incoming rows that
+        was admitted (shed_oldest drops the head, every other policy drops
+        the tail). Raises AdmissionRejectedError under policy='error'."""
+        if n <= 0:
+            return 0, 0
+        policy = self.config.policy
+        taken = self._take(n)
+        granted = min(taken, self._pending_room(junction, n))
+        if granted >= n:
+            self.admitted += n
+            return 0, n
+        queued_shed = 0
+        if policy == "block":
+            # tokens drained for room-refused events go back before the
+            # wait — _block_for re-takes them as capacity frees
+            self._refund(taken - granted)
+            granted += self._block_for(n - granted, junction)
+        elif policy == "error":
+            # put the WHOLE take back: the sender gets a clean reject, not
+            # a partially-drained bucket
+            self._refund(taken)
+            self.rejected += n
+            raise AdmissionRejectedError(
+                f"app '{self.app_name}': over admission "
+                f"{'quota' if self.config.rate_eps else 'bound'} "
+                f"({n} events, {granted} admissible)"
+            )
+        elif policy == "shed_oldest":
+            # only ROOM-blocked events (already token-granted) may displace
+            # older queued events: freeing queue slots mints no quota, so
+            # token-refused events stay refused and the rate limit holds
+            want = taken - granted
+            if want > 0:
+                queued_shed = self._shed_queued(junction, want)
+                granted += min(queued_shed, want)
+            self._refund(taken - granted)
+        else:  # shed_newest
+            # quota tokens drained for events the pending bound then
+            # refused must go back: otherwise a full queue starves the
+            # sender of quota it never used once the queue frees
+            self._refund(taken - granted)
+        dropped = n - granted
+        self.admitted += granted
+        # queued events destroyed to make room were admitted once — they
+        # count as shed too, or the meter under-reports the loss
+        self.shed += dropped + queued_shed
+        if policy == "shed_oldest":
+            # keep the TAIL: the freshest events survive
+            return dropped, n
+        return 0, granted
+
+    def _block_for(self, need: int, junction) -> int:
+        """Back-pressure: wait (in small sleeps) until `need` more rows are
+        admissible or block.timeout elapses; returns how many more were
+        granted."""
+        deadline = time.monotonic() + self.config.block_timeout_ms / 1000.0
+        got = 0
+        t0 = time.monotonic()
+        while got < need:
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            time.sleep(0.001)
+            taken = self._take(need - got)
+            k = min(taken, self._pending_room(junction, need - got))
+            self._refund(taken - k)
+            got += k
+        self.blocked_ms += (time.monotonic() - t0) * 1000.0
+        return got
+
+    @staticmethod
+    def _shed_queued(junction, n: int) -> int:
+        """Drop up to n of the OLDEST queued events from a python-queue
+        @async junction (freshest-data-wins). Native MPSC rings are single-
+        consumer — popping from the admission thread would race the drain
+        worker — and synchronous junctions hold no queue; both shed from
+        the incoming call instead."""
+        q = getattr(junction, "_queue", None)
+        if q is None or not getattr(junction, "is_async", False):
+            return 0
+        import queue as _q
+
+        shed = 0
+        for _ in range(n):
+            try:
+                q.get_nowait()
+                shed += 1
+            except _q.Empty:
+                break
+        return shed
+
+    # ---- surfacing -------------------------------------------------------
+
+    def describe_state(self) -> dict:
+        d: dict = {
+            "policy": self.config.policy,
+            "admitted": self.admitted,
+            "shed": self.shed,
+            "blocked_ms": round(self.blocked_ms, 3),
+            "rejected": self.rejected,
+        }
+        if self.config.rate_eps is not None:
+            d["rate_limit_eps"] = self.config.rate_eps
+        if self.config.max_pending is not None:
+            d["max_pending"] = self.config.max_pending
+        return d
+
+
+class AdmittedInputHandler:
+    """InputHandler facade applying the app's AdmissionController before
+    delegating (wraps the playback handler too — admission is outermost)."""
+
+    def __init__(self, inner, controller: AdmissionController, junction):
+        self._inner = inner
+        self._ctl = controller
+        self._junction = junction
+
+    def send(self, data, timestamp=None):
+        lo, hi = self._ctl.admit(1, self._junction)
+        if hi > lo:
+            self._inner.send(data, timestamp)
+
+    def send_many(self, rows, timestamps=None):
+        lo, hi = self._ctl.admit(len(rows), self._junction)
+        if hi <= lo:
+            return
+        self._inner.send_many(
+            rows[lo:hi],
+            timestamps[lo:hi] if timestamps is not None else None,
+        )
+
+    def send_columns(self, timestamps, cols, now=None):
+        n = len(timestamps)
+        lo, hi = self._ctl.admit(n, self._junction)
+        if hi <= lo:
+            return
+        if lo == 0 and hi == n:
+            self._inner.send_columns(timestamps, cols, now)
+            return
+        self._inner.send_columns(
+            timestamps[lo:hi], {k: v[lo:hi] for k, v in cols.items()}, now
+        )

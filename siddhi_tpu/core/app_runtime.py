@@ -147,6 +147,42 @@ class SiddhiAppRuntime:
         # the reference's synchronous junction dispatch + ThreadBarrier)
         self._process_lock = threading.RLock()
 
+        # supervised runtime (core/supervision.py, core/admission.py):
+        # @app:persist auto-checkpoint, @app:restart policy (validated here,
+        # consumed by manager.supervise()), @app:admission ingress gate.
+        # All three raise at creation on malformed options — the runtime
+        # analogs of SA126/SA127/SA128.
+        from siddhi_tpu.core.admission import (
+            AdmissionController,
+            resolve_admission_annotation,
+        )
+        from siddhi_tpu.core.supervision import (
+            AutoPersist,
+            resolve_persist_annotation,
+            resolve_restart_annotation,
+        )
+
+        self._autopersist = None
+        pa = find_annotation(app.annotations, "app:persist")
+        if pa is not None:
+            interval_ms, keep = resolve_persist_annotation(pa)
+            self._autopersist = AutoPersist(self, interval_ms, keep)
+        ra = find_annotation(app.annotations, "app:restart")
+        if ra is not None:
+            resolve_restart_annotation(ra)  # fail fast; supervisor re-reads
+        self._admission = None
+        aa = find_annotation(app.annotations, "app:admission")
+        if aa is not None:
+            self._admission = AdmissionController(
+                self.name, resolve_admission_annotation(aa)
+            )
+        # supervision health hook (core/supervision.AppHealth), installed by
+        # Supervisor.attach(); _junction() wires it onto every junction
+        self._health = None
+        # callbacks retained for supervised rebuild: a restart re-creates
+        # every junction/runtime, so user callbacks must be re-registered
+        self._user_callbacks: list[tuple[str, Callable]] = []
+
         # @OnError(action='LOG'|'STREAM'|'STORE') failure policies
         # (reference: StreamJunction OnErrorAction + util/error/handler/*);
         # STREAM auto-defines the fault stream `!S` = S's attributes + _error
@@ -291,6 +327,34 @@ class SiddhiAppRuntime:
         }
         self._store_query_cache: dict[str, object] = {}
 
+        # @OnError on table definitions: mutation failures (the mutating
+        # query's dispatch + record-store flushes) route to the error store
+        # or the log instead of propagating to the sender. STREAM is
+        # stream/window-only: the failing unit is the mutating query's
+        # input batch, which does not carry the table's schema, so there is
+        # no well-typed '!T' row to publish (analyzer analog: SA110).
+        from siddhi_tpu.core.error_store import (
+            iter_definition_onerror_problems,
+            resolve_definition_onerror_action,
+        )
+
+        self._table_fault: dict[str, str] = {}
+        for tid, td in app.table_definitions.items():
+            oe = find_annotation(td.annotations, "OnError")
+            if oe is None:
+                continue
+            for _tag, msg in iter_definition_onerror_problems(
+                oe, "table", tid
+            ):
+                raise SiddhiAppCreationError(msg)
+            action = resolve_definition_onerror_action(oe)
+            self._table_fault[tid] = action
+            t = self.tables[tid]
+            t.fault_policy = action
+            t.app_name = self.name
+            if action == "STORE":
+                t.error_store_fn = lambda: self.manager.error_store
+
         # named windows: input junction under the window id, processing runtime
         # in between, output junction feeding `from W` queries
         from siddhi_tpu.core.window_runtime import NamedWindow
@@ -339,6 +403,34 @@ class SiddhiAppRuntime:
                     _recv(self._timer_batch(_nw.schema, t_ms), t_ms)
 
                 nw.timer_target = fire
+
+        # @OnError on named windows: mutation failures (the shared window
+        # processor exploding on an inserted batch) ride the SAME junction
+        # failure machinery streams use — the window's input junction
+        # carries the window's schema, so STREAM routes to a well-typed
+        # fault stream '!W' (attributes + _error)
+        for wid, wd in app.window_definitions.items():
+            oe = find_annotation(wd.annotations, "OnError")
+            if oe is None:
+                continue
+            for _tag, msg in iter_definition_onerror_problems(
+                oe, "window", wid, [a.name for a in wd.attributes]
+            ):
+                raise SiddhiAppCreationError(msg)
+            action = resolve_definition_onerror_action(oe)
+            j = self.junctions[wid]
+            j.fault_policy = action
+            j.app_name = self.name
+            if action == "STREAM":
+                fid = "!" + wid
+                self.stream_schemas[fid] = StreamSchema(
+                    fid,
+                    [(a.name, a.type) for a in wd.attributes]
+                    + [("_error", _AttrType.STRING)],
+                )
+                j.fault_junction = self._junction(fid)
+            elif action == "STORE":
+                j.error_store_fn = lambda: self.manager.error_store
 
         # incremental aggregations: duration tables are registered app tables
         # (reference: AggregationParser.java:701-708 table map registration)
@@ -405,6 +497,7 @@ class SiddhiAppRuntime:
             build_sink,
             build_source,
             wire_sink_error_handling,
+            wire_source_error_handling,
         )
         from siddhi_tpu.query_api.annotation import find_all
 
@@ -416,9 +509,32 @@ class SiddhiAppRuntime:
                 # transport payloads carry no timestamps: sourced events are
                 # stamped with the app clock (wall time, or the current
                 # virtual time in @app:playback apps)
-                self.sources.append(
-                    build_source(ann, sid, schema, self.get_input_handler(sid))
+                src = build_source(
+                    ann, sid, schema, self.get_input_handler(sid)
                 )
+                fault_sender = None
+                if self.on_error_actions.get(sid) == "STREAM":
+                    fj = self._junction("!" + sid)
+
+                    def fault_sender(rows, err, _fj=fj):
+                        now = self.clock()
+                        _fj.send_rows(
+                            [now] * len(rows),
+                            [tuple(r) + (err,) for r in rows],
+                            now=now,
+                        )
+
+                sm = self.statistics_manager
+                wire_source_error_handling(
+                    src,
+                    lambda: self.manager.error_store,
+                    self.name,
+                    fault_sender,
+                    sm.error_tracker(f"source.{sid}").add
+                    if sm is not None
+                    else None,
+                )
+                self.sources.append(src)
             for n_sink, ann in enumerate(find_all(d.annotations, "sink")):
                 sink = build_sink(ann, sid, schema)
                 sm = self.statistics_manager
@@ -488,6 +604,14 @@ class SiddhiAppRuntime:
             j = StreamJunction(schema, self.interner, self.batch_size)
             j.exception_handler = getattr(self, "_exception_handler", None)
             j.tracer = self.tracer
+            # snapshot barrier: the fan-out holds the app process lock so a
+            # checkpoint can't capture a torn cross-query state mid-batch
+            j.process_lock = self._process_lock
+            # supervised apps: unguarded dispatch/worker failures signal the
+            # manager's Supervisor through the app's health hook
+            health = getattr(self, "_health", None)
+            if health is not None:
+                j.on_fatal = health.mark_fatal
             # SIDDHI_TPU_FLIGHT=N arms the flight recorder on EVERY junction
             # — internal insert-into targets and fault streams included
             # (explicit @flightRecorder sizes are applied after, and win
@@ -544,6 +668,56 @@ class SiddhiAppRuntime:
         qr.publish_fn = publish
         # fused-ingest eligibility checks the live target junction directly
         qr._insert_target_junction = target_junction
+
+    def _table_guard(self, qr, receive, in_schema: StreamSchema):
+        """Wrap a query receive with the @OnError policy of the table it
+        mutates: the mutating query's dispatch is the table's host-side
+        failure boundary (mutations compile into the query step), so its
+        failures route to the table's policy instead of the input stream's
+        — or the sender. Identity when the query mutates no guarded table."""
+        tid = getattr(qr, "_mutates_table", None)
+        action = self._table_fault.get(tid) if tid is not None else None
+        if action is None:
+            return receive
+
+        def guarded(batch: EventBatch, now: int, *a, **kw) -> None:
+            try:
+                receive(batch, now, *a, **kw)
+            except Exception as e:
+                self._on_table_failure(tid, action, in_schema, batch, now, e)
+
+        return guarded
+
+    def _on_table_failure(
+        self, tid: str, action: str, in_schema: StreamSchema,
+        batch: EventBatch, now: int, exc: Exception,
+    ) -> None:
+        import logging
+
+        log = logging.getLogger(__name__)
+        sm = self.statistics_manager
+        if sm is not None:
+            sm.error_tracker(f"table.{tid}").add(1)
+        if action == "STORE":
+            from siddhi_tpu.core.error_store import ORIGIN_TABLE, make_entry
+
+            store = self.manager.error_store
+            try:
+                events = in_schema.from_batch(batch, self.interner)
+            except Exception:
+                events = []
+            store.store(make_entry(
+                self.name, ORIGIN_TABLE, tid, exc,
+                events=[(ts, tuple(d)) for ts, _k, d in events],
+                # the mutating query's input stream: replay re-drives the
+                # batch through it (the table itself takes no direct input)
+                sink_ref=in_schema.stream_id,
+            ))
+            return
+        log.error(
+            "table '%s': dropping a failed mutation batch "
+            "(@OnError action='LOG'): %s", tid, exc, exc_info=exc,
+        )
 
     def _wire_query_stats(self, qr, qid: str):
         """Attach latency + device-budget trackers to a query runtime;
@@ -638,7 +812,9 @@ class SiddhiAppRuntime:
                 )
             self._maybe_schedule(_qr, aux)
 
-        in_junction.subscribe(receive, name=f"query.{qid}")
+        in_junction.subscribe(
+            self._table_guard(qr, receive, in_schema), name=f"query.{qid}"
+        )
         from siddhi_tpu.core.ingest import FuseEndpoint
 
         in_junction.fuse_candidates.append(FuseEndpoint(
@@ -710,7 +886,11 @@ class SiddhiAppRuntime:
         for sid in qr.prog.stream_ids:
             sj = self._junction(sid)
             sj.subscribe(
-                lambda b, now, _sid=sid: receive(b, now, _sid),
+                self._table_guard(
+                    qr,
+                    lambda b, now, _sid=sid: receive(b, now, _sid),
+                    self.stream_schemas[sid],
+                ),
                 name=f"query.{qid}",
             )
             sj.fuse_candidates.append(FuseEndpoint(
@@ -826,8 +1006,12 @@ class SiddhiAppRuntime:
         if join.left.stream_id == join.right.stream_id:
             j = self._junction(join.left.stream_id)
             j.subscribe(
-                lambda b, now: (
-                    receive_side(b, now, "l"), receive_side(b, now, "r")
+                self._table_guard(
+                    qr,
+                    lambda b, now: (
+                        receive_side(b, now, "l"), receive_side(b, now, "r")
+                    ),
+                    schemas[0],
                 ),
                 name=f"query.{qid}",
             )
@@ -872,7 +1056,11 @@ class SiddhiAppRuntime:
                 elif not qr.table_sides[side]:
                     sj = self._junction(stream.stream_id)
                     sj.subscribe(
-                        lambda b, now, _s=side: receive_side(b, now, _s),
+                        self._table_guard(
+                            qr,
+                            lambda b, now, _s=side: receive_side(b, now, _s),
+                            schemas[0 if side == "l" else 1],
+                        ),
                         name=f"query.{qid}",
                     )
                     sj.fuse_candidates.append(FuseEndpoint(
@@ -938,31 +1126,118 @@ class SiddhiAppRuntime:
     # ---- public API (reference: SiddhiAppRuntime callbacks/handlers) -----
 
     def get_input_handler(self, stream_id: str) -> InputHandler:
-        h = InputHandler(self._junction(stream_id), lambda: self.clock())
+        j = self._junction(stream_id)
+        h = InputHandler(j, lambda: self.clock())
         if self._playback_clock is not None:
-            return _PlaybackInputHandler(h, self._playback_clock)
+            h = _PlaybackInputHandler(h, self._playback_clock)
+        if self._admission is not None:
+            # @app:admission gate, outermost: over-quota/over-bound sends
+            # block/shed/error BEFORE any encode work (core/admission.py)
+            from siddhi_tpu.core.admission import AdmittedInputHandler
+
+            h = AdmittedInputHandler(h, self._admission, j)
         return h
 
     input_handler = get_input_handler
 
+    def replay_target_available(self, entry) -> bool:
+        """May `replay_error(entry)` be dispatched WITHOUT blocking? False
+        for sink entries whose target transport is still disconnected and
+        publishes under `on.error='WAIT'` (the replay would block until the
+        transport reconnects) — `manager.replay_errors(skip_unavailable=
+        True)` consults this so one dead sink cannot hold every other app's
+        entries hostage."""
+        from siddhi_tpu.core.error_store import ORIGIN_SINK
+
+        if not self._running:
+            return False
+        if entry.origin != ORIGIN_SINK:
+            return True
+        for sink in self.sinks:
+            for s in getattr(sink, "sinks", None) or [sink]:
+                if s.stream_id != entry.stream_id:
+                    continue
+                if entry.sink_ref and s.sink_ref != entry.sink_ref:
+                    continue
+                return s.on_error != "WAIT" or s.connected
+        return True  # no matching sink: replay_error returns False quickly
+
     def replay_error(self, entry) -> bool:
         """Re-drive one stored ErroneousEvent through its origin. Stream
-        entries re-enter the input handler (and re-run every downstream
-        query); sink entries re-publish their mapped payload under the sink's
-        on.error policy. Returns True when the replay was dispatched."""
-        from siddhi_tpu.core.error_store import ORIGIN_SINK, ORIGIN_STREAM
+        (and table-mutation) entries re-enter the input handler (and re-run
+        every downstream query); sink entries re-publish their mapped
+        payload under the sink's on.error policy; source entries re-deliver
+        the raw wire payload through the source's mapper. Returns True when
+        the replay was dispatched."""
+        from siddhi_tpu.core.error_store import (
+            ORIGIN_SINK,
+            ORIGIN_SOURCE,
+            ORIGIN_STREAM,
+            ORIGIN_TABLE,
+        )
 
         if entry.app_name != self.name:
             return False
-        if entry.origin == ORIGIN_STREAM:
-            if entry.stream_id not in self.stream_schemas or not entry.events:
-                return False
-            h = self.get_input_handler(entry.stream_id)
-            h.send_many(
-                [row for _ts, row in entry.events],
-                timestamps=[ts for ts, _row in entry.events],
+        if not self._running:
+            # sinks/sources aren't connected before start(): the entry stays
+            # stored until the app is up (supervisor replays AFTER resume)
+            return False
+        if entry.origin in (ORIGIN_STREAM, ORIGIN_TABLE):
+            # table entries re-drive the mutating query's input batch
+            # through its input stream (stashed in sink_ref)
+            sid = (
+                entry.stream_id
+                if entry.origin == ORIGIN_STREAM
+                else entry.sink_ref
             )
+            if sid not in self.stream_schemas or not entry.events:
+                return False
+            # RAW handler, not get_input_handler(): the admission gate must
+            # not apply — these events were admitted once already, and a
+            # quota-starved gate would silently shed the replay while the
+            # caller purges the entry (permanent loss). Timestamps are
+            # explicit, so the playback wrapper is unnecessary too.
+            from siddhi_tpu.core.supervision import failure_ownership
+
+            h = InputHandler(self._junction(sid), lambda: self.clock())
+            # failure_ownership: a replay that explodes raises to the
+            # replay caller and the entry stays stored — it must not ALSO
+            # flag the app as crashed, or a poison entry puts a supervised
+            # app into a restart->replay->crash loop
+            with failure_ownership():
+                h.send_many(
+                    [row for _ts, row in entry.events],
+                    timestamps=[ts for ts, _row in entry.events],
+                )
             return True
+        if entry.origin == ORIGIN_SOURCE:
+            for src in self.sources:
+                if src.stream_id != entry.stream_id:
+                    continue
+                # replay through the mapper again; True means "safe to
+                # purge": delivered, or the source's own on.error path
+                # re-captured the payload (STORE re-stores on failure)
+                if src.paused:
+                    # deliver() returns False WITHOUT running the failure
+                    # path — nothing was re-stored, so the entry must stay
+                    return False
+                # raw handler override: the wired one is admission-gated,
+                # and a shed replay would report delivered -> purged
+                raw = InputHandler(
+                    self._junction(src.stream_id), lambda: self.clock()
+                )
+                ok = src.deliver(entry.payload, handler=raw)
+                if ok:
+                    return True
+                # STORE only re-captured the payload when a store is
+                # actually wired; otherwise _on_deliver_failure dropped it
+                # and purging here would make the loss permanent
+                return (
+                    src.on_error == "STORE"
+                    and src.error_store_fn is not None
+                    and src.error_store_fn() is not None
+                )
+            return False
         if entry.origin == ORIGIN_SINK:
             # target the exact sink that failed (by sink_ref); fall back to
             # the first stream_id match for entries from older stores. True
@@ -1088,6 +1363,13 @@ class SiddhiAppRuntime:
         }
         if self._selfmon is not None:
             status["selfmon"] = self._selfmon.describe_state()
+        if self._admission is not None:
+            status["admission"] = self._admission.describe_state()
+        if self._autopersist is not None:
+            status["autopersist"] = self._autopersist.describe_state()
+        health = getattr(self, "_health", None)
+        if health is not None:
+            status["health"] = health.describe_state()
         return status
 
     # ---- flight recorder (observability/flight.py) ------------------------
@@ -1133,6 +1415,9 @@ class SiddhiAppRuntime:
         `cb(timestamp, in_events, removed_events)` — dispatched on arity by
         target: stream name vs @info query name (reference: addCallback overloads).
         """
+        # retained for supervised rebuild (core/supervision.Supervisor
+        # re-registers these on the replacement runtime after a restart)
+        self._user_callbacks.append((name, callback))
         if name in self.queries:
             qr = self.queries[name]
 
@@ -1314,6 +1599,20 @@ class SiddhiAppRuntime:
 
             self._junction(SELFMON_STREAM_ID)
             self._selfmon.start()
+        # @app:persist auto-checkpoint (core/supervision.AutoPersist): armed
+        # only when a persistence store is actually wired — a missing store
+        # would otherwise fail EVERY interval until someone noticed
+        if self._autopersist is not None:
+            if self.manager.persistence_store is None:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "app '%s' declares @app:persist but the manager has no "
+                    "persistence store; auto-checkpointing is disabled "
+                    "(call manager.set_persistence_store(...))", self.name,
+                )
+            else:
+                self._autopersist.start()
         # lifecycle ordering (reference: SiddhiAppRuntime.start:353-394):
         # sinks connect before sources so no event finds a dead egress;
         # triggers and sources begin last, into fully-wired queries
@@ -1392,11 +1691,28 @@ class SiddhiAppRuntime:
         now = max(now, last + 1)
         self._last_rev_ms = now
         revision = f"{now}_{self.name}"
+        # fault-injection site `persist_save` (testing/faults.py): a failing
+        # store save surfaces to the caller — AutoPersist counts it and
+        # retries next interval, a manual persist() raises
+        from siddhi_tpu.testing import faults as _faults
+
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.check("persist_save", self.name)
         store.save(self.name, revision, data)
+        # only now is the full payload durable: promote the staged delta
+        # base (a failed save must NOT shift it, or every later cycle
+        # emits deltas against a base revision that never reached the
+        # store and restore silently no-ops or applies the wrong base)
+        svc.commit_base()
         return revision
 
     def restore_revision(self, revision: str) -> None:
         store = self._store()
+        # fault-injection site `persist_load` (testing/faults.py)
+        from siddhi_tpu.testing import faults as _faults
+
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.check("persist_load", self.name)
         data = store.load(self.name, revision)
         if data is None:
             raise SiddhiAppCreationError(f"no revision '{revision}'")

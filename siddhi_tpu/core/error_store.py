@@ -19,6 +19,58 @@ from typing import Any, Optional
 # ErroneousEvent.origin values
 ORIGIN_STREAM = "stream"
 ORIGIN_SINK = "sink"
+# ingress-transport map/deliver failures (@source on.error='STORE'); the
+# raw wire payload is retained and replay re-delivers it through the source
+ORIGIN_SOURCE = "source"
+# table mutation failures (@OnError on a table definition): `stream_id` is
+# the TABLE id (attribution), `sink_ref` carries the mutating query's input
+# stream so replay can re-drive the batch
+ORIGIN_TABLE = "table"
+
+# @OnError actions on table / named-window DEFINITIONS. STREAM is
+# stream/window-only: a table mutation's failing unit is the mutating
+# query's input batch, which does not carry the table's schema — there is
+# no well-typed '!T' row to publish.
+TABLE_ONERROR_ACTIONS = ("LOG", "STORE")
+WINDOW_ONERROR_ACTIONS = ("LOG", "STREAM", "STORE")
+
+
+def resolve_definition_onerror_action(ann) -> str:
+    """Normalized action of a table/window `@OnError` annotation: keyed
+    `action=...` or a single positional (`@OnError('STORE')`). A single
+    UNRELATED keyed element must not leak in as the action, so this does
+    not use `ann.element(None)` (whose single-element fallback ignores
+    the key)."""
+    v = ann.element("action")
+    if v is None and len(ann.elements) == 1 and ann.elements[0][0] is None:
+        v = ann.elements[0][1]
+    return str(v or "LOG").upper()
+
+
+def iter_definition_onerror_problems(ann, kind: str, name: str, attr_names=()):
+    """Yield (tag, message) per problem with a table/window `@OnError`
+    annotation — ONE rule set shared by the analyzer (tag 'action' -> SA110,
+    'reserved' -> SA111) and the runtime wiring (SiddhiAppCreationError),
+    like the supervised-runtime annotations in core/supervision.py."""
+    action = resolve_definition_onerror_action(ann)
+    if kind == "table":
+        if action not in TABLE_ONERROR_ACTIONS:
+            yield "action", (
+                f"table '{name}': unknown @OnError action '{action}' "
+                "(tables support LOG or STORE)"
+            )
+        return
+    if action not in WINDOW_ONERROR_ACTIONS:
+        yield "action", (
+            f"window '{name}': unknown @OnError action '{action}' "
+            "(expected LOG, STREAM, or STORE)"
+        )
+        return
+    if action == "STREAM" and "_error" in attr_names:
+        yield "reserved", (
+            f"window '{name}': @OnError(action='STREAM') reserves "
+            "the attribute name '_error'"
+        )
 
 
 @dataclasses.dataclass
@@ -377,6 +429,207 @@ class FileErrorStore(ErrorStore):
                 "by_app": by_app,
                 "path": self.base_path,
             }
+
+
+class SqliteErrorStore(ErrorStore):
+    """DB-backed persistent store on stdlib `sqlite3`, through the same
+    `store/load/purge` SPI as every other backend.
+
+    One `errors` table; `events`/`payload`/`flight` serialize as JSON text
+    (non-JSON payloads are stringified, mirroring `FileErrorStore`). Ids
+    ride an AUTOINCREMENT rowid, which sqlite guarantees never reuses even
+    after deletes — the same id-uniqueness-across-restarts contract
+    `FileErrorStore` keeps by scanning for the max id. Capacity is FIFO:
+    over-capacity inserts evict the oldest ids in one DELETE.
+
+    Thread-safe via one connection guarded by one lock (`sqlite3`
+    serializes per-connection anyway; the lock keeps the
+    capacity-check-then-evict sequence atomic).
+    """
+
+    def __init__(self, path: str, capacity: int = 100_000):
+        import sqlite3
+
+        if capacity <= 0:
+            raise ValueError("error store capacity must be positive")
+        self.path = path
+        self.capacity = int(capacity)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS errors ("
+            " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+            " stored_at_ms INTEGER NOT NULL,"
+            " app_name TEXT NOT NULL,"
+            " origin TEXT NOT NULL,"
+            " stream_id TEXT NOT NULL,"
+            " error TEXT NOT NULL,"
+            " events TEXT,"
+            " payload TEXT,"
+            " sink_ref TEXT NOT NULL DEFAULT '',"
+            " flight TEXT)"
+        )
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS errors_app ON errors(app_name)"
+        )
+        self._conn.commit()
+        # running count: one seed scan here, then maintained by store/purge
+        # — `SELECT COUNT(*)` is a full table scan in sqlite, and paying it
+        # per insert (capacity check) or per selfmon poll would serialize
+        # error bursts behind repeated 100k-row scans
+        self._count = int(
+            self._conn.execute("SELECT COUNT(*) FROM errors").fetchone()[0]
+        )
+
+    @staticmethod
+    def _json_or_repr(v) -> Optional[str]:
+        import json
+
+        if v is None:
+            return None
+        try:
+            return json.dumps(v)
+        except (TypeError, ValueError):
+            return json.dumps(repr(v))
+
+    def store(self, entry: ErroneousEvent) -> None:
+        import json
+
+        with self._lock:
+            if entry.stored_at_ms == 0:
+                entry.stored_at_ms = int(time.time() * 1000)
+            cols = (
+                entry.stored_at_ms, entry.app_name, entry.origin,
+                entry.stream_id, entry.error,
+                # default=str like FileErrorStore: event rows off a
+                # device batch carry numpy scalars, and the STORE path
+                # must never throw back at the sender it shields
+                json.dumps(entry.events, default=str)
+                if entry.events is not None else None,
+                self._json_or_repr(entry.payload),
+                entry.sink_ref,
+                json.dumps(entry.flight, default=str)
+                if entry.flight is not None else None,
+            )
+            if entry.id:
+                # honor a pre-set id like the other stores do (re-storing a
+                # loaded entry must stay purgeable by ITS id); OR REPLACE
+                # keeps a same-id re-store idempotent. Explicit ids bump
+                # sqlite's AUTOINCREMENT sequence, so uniqueness holds.
+                replacing = self._conn.execute(
+                    "SELECT 1 FROM errors WHERE id = ?", (int(entry.id),)
+                ).fetchone() is not None
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO errors (id, stored_at_ms,"
+                    " app_name, origin, stream_id, error, events, payload,"
+                    " sink_ref, flight) VALUES (?,?,?,?,?,?,?,?,?,?)",
+                    (int(entry.id),) + cols,
+                )
+                if not replacing:
+                    self._count += 1
+            else:
+                cur = self._conn.execute(
+                    "INSERT INTO errors (stored_at_ms, app_name, origin,"
+                    " stream_id, error, events, payload, sink_ref, flight)"
+                    " VALUES (?,?,?,?,?,?,?,?,?)",
+                    cols,
+                )
+                entry.id = int(cur.lastrowid)
+                self._count += 1
+            if self._count > self.capacity:
+                evict = self._count - self.capacity
+                self._conn.execute(
+                    "DELETE FROM errors WHERE id IN"
+                    " (SELECT id FROM errors ORDER BY id LIMIT ?)",
+                    (evict,),
+                )
+                self.dropped += evict
+                self._count = self.capacity
+            self._conn.commit()
+
+    def load(
+        self,
+        app_name: Optional[str] = None,
+        stream_id: Optional[str] = None,
+        origin: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> list[ErroneousEvent]:
+        import json
+
+        q = "SELECT id, stored_at_ms, app_name, origin, stream_id, error," \
+            " events, payload, sink_ref, flight FROM errors"
+        conds, args = [], []
+        for col, v in (
+            ("app_name", app_name), ("stream_id", stream_id), ("origin", origin),
+        ):
+            if v is not None:
+                conds.append(f"{col} = ?")
+                args.append(v)
+        if conds:
+            q += " WHERE " + " AND ".join(conds)
+        q += " ORDER BY id"
+        if limit is not None:
+            q += " LIMIT ?"
+            args.append(int(limit))
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        out = []
+        for (eid, at, app, origin_, sid, err, events, payload, ref, flight) in rows:
+            ev = json.loads(events) if events is not None else None
+            if ev is not None:
+                ev = [(int(ts), tuple(row)) for ts, row in ev]
+            fl = json.loads(flight) if flight is not None else None
+            if fl is not None:
+                fl = [(int(ts), tuple(row)) for ts, row in fl]
+            out.append(ErroneousEvent(
+                id=eid, stored_at_ms=at, app_name=app, origin=origin_,
+                stream_id=sid, error=err, events=ev,
+                payload=json.loads(payload) if payload is not None else None,
+                cause=None, sink_ref=ref, flight=fl,
+            ))
+        return out
+
+    def purge(self, ids: Optional[list[int]] = None) -> int:
+        with self._lock:
+            if ids is None:
+                n = self._count
+                self._conn.execute("DELETE FROM errors")
+                self._conn.commit()
+                self._count = 0
+                return n
+            n = 0
+            for i in ids:
+                n += self._conn.execute(
+                    "DELETE FROM errors WHERE id = ?", (int(i),)
+                ).rowcount
+            self._conn.commit()
+            self._count = max(0, self._count - n)
+            return n
+
+    def size(self) -> int:
+        """O(1): the running count — selfmon polls this every tick, and a
+        COUNT(*) table scan per poll would stall the scheduler thread."""
+        with self._lock:
+            return self._count
+
+    def describe_state(self) -> dict:
+        with self._lock:
+            by_app = dict(self._conn.execute(
+                "SELECT app_name, COUNT(*) FROM errors GROUP BY app_name"
+            ).fetchall())
+            depth = self._count
+        return {
+            "depth": depth,
+            "capacity": self.capacity,
+            "dropped": self.dropped,
+            "by_app": by_app,
+            "path": self.path,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
 
 
 def make_entry(

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from siddhi_tpu.core.event import WireNarrowMisfit
+from siddhi_tpu.testing import faults as _faults
 
 
 class FuseEndpoint:
@@ -698,6 +699,12 @@ class FusedJunctionIngest:
                 else 0
             )
             try:
+                # fault-injection site `device_dispatch` (testing/faults.py):
+                # inside the try so an injected failure rides the exact
+                # donated-state reset + junction-failure-policy path a real
+                # chunk-program explosion takes
+                if _faults.ACTIVE is not None:
+                    _faults.ACTIVE.check("device_dispatch", self.component)
                 new_all, tstates, aux_red, packs = prog(
                     arg0, tstates, wire,
                     counts, bases, np.int64(now),

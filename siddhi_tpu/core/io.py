@@ -25,6 +25,7 @@ from siddhi_tpu.core.errors import (
 )
 from siddhi_tpu.core.event import Event
 from siddhi_tpu.core.extension import lookup
+from siddhi_tpu.testing import faults as _faults
 
 
 # ---------------------------------------------------------------------------
@@ -306,10 +307,28 @@ SINK_MAPPERS = {
 # ---------------------------------------------------------------------------
 
 
+SOURCE_ON_ERROR_ACTIONS = ("LOG", "STREAM", "STORE")
+
+
 class Source:
     """Transport SPI (reference: Source.java:42-126). Subclasses implement
     connect/disconnect; arriving payloads go through self.mapper into
-    self.input_handler."""
+    self.input_handler.
+
+    `on.error` gives ingress the same failure policies sinks and junctions
+    have — a payload the mapper cannot decode or the handler rejects:
+
+    LOG     log + drop the payload
+    STREAM  route the mapped rows (plus the error) to the stream's fault
+            stream `!S` — requires the stream to declare
+            @OnError(action='STREAM'); an UNMAPPABLE payload has no typed
+            rows to publish and falls back to STORE (store wired) or LOG
+    STORE   spill the raw wire payload to the manager's ErrorStore; replay
+            re-delivers it through the mapper
+
+    Without the option, failures propagate to the delivering thread —
+    the pre-policy behavior transports already rely on.
+    """
 
     def init(self, stream_id: str, options: dict, mapper: SourceMapper, input_handler) -> None:
         self.stream_id = stream_id
@@ -322,6 +341,19 @@ class Source:
         self._stopped = False
         self._reconnecting = False
         self._conn_lock = threading.Lock()
+        oe = options.get("on.error")
+        self.on_error = str(oe).upper() if oe is not None else None
+        if self.on_error is not None and self.on_error not in SOURCE_ON_ERROR_ACTIONS:
+            raise SiddhiAppCreationError(
+                f"@source on stream '{stream_id}': unknown on.error "
+                f"'{self.on_error}' (expected one of "
+                f"{SOURCE_ON_ERROR_ACTIONS})"
+            )
+        # wired by the app runtime after build_source
+        self.error_store_fn: Optional[Callable[[], object]] = None
+        self.app_name = ""
+        self.fault_sender: Optional[Callable] = None  # rows+error -> '!S'
+        self.on_error_stats: Optional[Callable[[int], None]] = None
 
     def connect(self) -> None:
         raise NotImplementedError
@@ -345,12 +377,83 @@ class Source:
         daemon thread until the transport comes up (or disconnect() cancels)."""
         _connect_with_retry(self)
 
-    def deliver(self, payload) -> None:
+    def deliver(self, payload, handler=None) -> bool:
+        """Map + inject one wire payload; True when it reached the stream.
+        With no `on.error` policy, failures propagate to the delivering
+        thread (pre-policy behavior). `handler` overrides the wired input
+        handler for ONE delivery — the error-replay path passes a raw
+        (admission-free) handler, because a replayed payload was admitted
+        once already and an over-quota gate would silently shed it while
+        the replay caller purges the entry."""
+        h = handler if handler is not None else self.input_handler
         if self.paused:
-            return
-        rows = self.mapper.map(payload)
-        if rows:
-            self.input_handler.send_many(rows)
+            return False
+        if self.on_error is None:
+            rows = self.mapper.map(payload)
+            if rows:
+                h.send_many(rows)
+            return True
+        try:
+            rows = self.mapper.map(payload)
+        except Exception as e:
+            return self._on_deliver_failure(payload, None, e)
+        try:
+            # failure_ownership: a downstream dispatch failure is caught and
+            # handled RIGHT HERE by this source's on.error policy — it must
+            # not double as a crash signal that restarts a supervised app
+            # over a payload the policy already captured
+            from siddhi_tpu.core.supervision import failure_ownership
+
+            with failure_ownership():
+                if rows:
+                    h.send_many(rows)
+            return True
+        except Exception as e:
+            return self._on_deliver_failure(payload, rows, e)
+
+    def _on_deliver_failure(self, payload, rows, exc: Exception) -> bool:
+        import logging
+
+        log = logging.getLogger(f"siddhi_tpu.source.{self.stream_id}")
+        if self.on_error_stats is not None:
+            self.on_error_stats(1)
+        mode = self.on_error
+        if mode == "STREAM" and rows and self.fault_sender is not None:
+            try:
+                self.fault_sender(rows, f"{type(exc).__name__}: {exc}")
+                return True
+            except Exception:
+                log.exception(
+                    "source '%s': fault-stream routing failed; falling "
+                    "back to the error store / log", self.stream_id,
+                )
+            mode = "STORE"
+        elif mode == "STREAM":
+            # no typed rows (the mapper itself failed) or no fault stream
+            mode = "STORE"
+        if mode == "STORE":
+            from siddhi_tpu.core.error_store import ORIGIN_SOURCE, make_entry
+
+            store = (
+                self.error_store_fn() if self.error_store_fn is not None
+                else None
+            )
+            if store is not None:
+                store.store(make_entry(
+                    self.app_name, ORIGIN_SOURCE, self.stream_id, exc,
+                    payload=payload,
+                ))
+                return False
+            log.error(
+                "source '%s': on.error needs an error store but none is "
+                "available; the payload was dropped", self.stream_id,
+            )
+            return False
+        log.error(
+            "source '%s': payload could not be mapped/delivered (%s); it "
+            "was dropped (on.error='LOG')", self.stream_id, exc,
+        )
+        return False
 
 
 class InMemorySource(Source):
@@ -455,6 +558,13 @@ class Sink:
         """Publish under the sink's on.error policy; True when the payload was
         delivered (reference: Sink.java:128-160 onError/connectAndPublish)."""
         try:
+            # fault-injection site `sink_publish` (testing/faults.py):
+            # defaults to ConnectionUnavailableError so the sink's on.error
+            # policy engages exactly like a real transport outage
+            if _faults.ACTIVE is not None:
+                _faults.ACTIVE.check(
+                    "sink_publish", f"{self.app_name}:{self.stream_id}"
+                )
             self.publish(payload)
             return True
         except ConnectionUnavailableError as e:
@@ -640,6 +750,28 @@ class DistributedSink:
                 buckets.setdefault(h % n, []).append(e)
             for i, evs in buckets.items():
                 self.sinks[i].on_events(evs)
+
+
+def wire_source_error_handling(
+    source: Source, error_store_fn: Callable[[], object], app_name: str,
+    fault_sender: Optional[Callable] = None,
+    on_error_stats: Optional[Callable[[int], None]] = None,
+) -> None:
+    """Attach app-level error plumbing to a source. `fault_sender(rows,
+    error)` publishes typed rows to the stream's `!S` fault junction —
+    required for `on.error='STREAM'` (the app runtime passes None when the
+    stream declares no @OnError(action='STREAM'), which is a creation
+    error for a STREAM-policy source)."""
+    if source.on_error == "STREAM" and fault_sender is None:
+        raise SiddhiAppCreationError(
+            f"@source on stream '{source.stream_id}': on.error='STREAM' "
+            f"needs the stream to declare @OnError(action='STREAM') so the "
+            f"fault stream '!{source.stream_id}' exists"
+        )
+    source.error_store_fn = error_store_fn
+    source.app_name = app_name
+    source.fault_sender = fault_sender
+    source.on_error_stats = on_error_stats
 
 
 def wire_sink_error_handling(

@@ -20,6 +20,7 @@ class SiddhiManager:
         self._error_store = None
         self._runtimes: dict[str, object] = {}
         self._metrics_server = None
+        self._supervisor = None
 
     # app: SiddhiQL source text or a programmatic SiddhiApp AST
     def create_siddhi_app_runtime(
@@ -51,6 +52,8 @@ class SiddhiManager:
         if old is not None:
             old.shutdown()
         self._runtimes[runtime.name] = runtime
+        if self._supervisor is not None:
+            self._supervisor.attach(runtime)
         return runtime
 
     # short alias, mirroring the analyzer docs: create_runtime(app, strict=...)
@@ -93,20 +96,41 @@ class SiddhiManager:
     def set_error_store(self, store) -> None:
         self._error_store = store
 
-    def replay_errors(self, entries=None, purge: bool = True) -> int:
+    def replay_errors(
+        self,
+        entries=None,
+        purge: bool = True,
+        timeout: float | None = None,
+        skip_unavailable: bool = False,
+    ) -> int:
         """Re-drive stored erroneous events through their origin: stream
         entries re-enter the input handler, sink entries re-publish. Returns
         the number of entries replayed; replayed entries are purged by default
         (a replay that fails again re-enters the store through the normal
-        failure path, so nothing is lost)."""
+        failure path, so nothing is lost).
+
+        `skip_unavailable=True` skips sink entries whose target transport is
+        still disconnected instead of letting an `on.error='WAIT'` sink
+        block the replay loop — the skipped entries stay stored for the
+        next replay. `timeout` (seconds) bounds the whole loop: entries not
+        reached before the deadline stay stored. Both exist so one wedged
+        app cannot hold every other app's entries hostage (the supervisor's
+        post-restart replay always passes skip_unavailable=True)."""
+        import time as _time
+
         if self._error_store is None:
             return 0
         if entries is None:
             entries = self.error_store.load()
+        deadline = _time.monotonic() + timeout if timeout is not None else None
         replayed = 0
         for e in entries:
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
             rt = self._runtimes.get(e.app_name)
             if rt is None:
+                continue
+            if skip_unavailable and not rt.replay_target_available(e):
                 continue
             if rt.replay_error(e):
                 replayed += 1
@@ -121,6 +145,29 @@ class SiddhiManager:
     def set_config_manager(self, config_manager) -> None:
         """Deployment config SPI (reference: SiddhiManager.setConfigManager)."""
         self.config_manager = config_manager
+
+    # ---- supervision (core/supervision.py) --------------------------------
+
+    def supervise(self, poll_interval_s: float = 0.25):
+        """Start (or return) this manager's Supervisor: every registered app
+        — current and future — is watched for crash signals (unguarded
+        dispatch failures, dead drain workers) and restarted per its
+        `@app:restart(...)` policy: shutdown -> rebuild from the retained
+        AST -> `restore_last_revision()` -> replay this app's stored errors
+        -> resume. Idempotent; `poll_interval_s` applies to the first call."""
+        if self._supervisor is None:
+            from siddhi_tpu.core.supervision import Supervisor
+
+            self._supervisor = Supervisor(self, poll_interval_s)
+            for rt in list(self._runtimes.values()):
+                self._supervisor.attach(rt)
+        return self._supervisor
+
+    @property
+    def supervisor(self):
+        """The running Supervisor, or None when `supervise()` was never
+        called."""
+        return self._supervisor
 
     # ---- metrics exposition (observability/http_server.py) ----------------
 
@@ -173,7 +220,33 @@ class SiddhiManager:
     def prometheus_text(self) -> str:
         from siddhi_tpu.observability.reporters import render_prometheus
 
-        return render_prometheus(self.observability_reports())
+        text = render_prometheus(self.observability_reports())
+        # supervision + admission families live outside the per-app
+        # statistics registries (they meter apps with statistics OFF too)
+        if self._supervisor is not None:
+            text += self._supervisor.prometheus_text()
+        adm_lines = []
+        for name, rt in list(self._runtimes.items()):
+            ctl = getattr(rt, "_admission", None)
+            if ctl is None:
+                continue
+            lab = f'{{app="{name}",policy="{ctl.config.policy}"}}'
+            adm_lines.append(f"siddhi_admission_shed_total{lab} {ctl.shed}")
+            adm_lines.append(
+                f"siddhi_admission_blocked_ms_total{lab} "
+                f"{round(ctl.blocked_ms, 3)}"
+            )
+        if adm_lines:
+            text += (
+                "# HELP siddhi_admission_shed_total Events shed by the "
+                "per-app admission gate\n"
+                "# TYPE siddhi_admission_shed_total counter\n"
+                "# HELP siddhi_admission_blocked_ms_total Sender wall time "
+                "spent blocked by admission back-pressure\n"
+                "# TYPE siddhi_admission_blocked_ms_total counter\n"
+                + "\n".join(adm_lines) + "\n"
+            )
+        return text
 
     def profile_reports(self) -> list:
         """One `profile_report()` dict per stats-enabled app (`/profile`):
@@ -222,6 +295,8 @@ class SiddhiManager:
         store = self._error_store
         if store is not None and hasattr(store, "describe_state"):
             status["error_store"] = store.describe_state()
+        if self._supervisor is not None:
+            status["supervisor"] = self._supervisor.describe_state()
         return status
 
     def status_text(self) -> str:
@@ -247,6 +322,11 @@ class SiddhiManager:
             rt.restore_last_revision()
 
     def shutdown(self) -> None:
+        # stop the supervisor FIRST: a mid-shutdown crash signal must not
+        # race a restart against the teardown below
+        sup, self._supervisor = self._supervisor, None
+        if sup is not None:
+            sup.stop()
         self.stop_metrics()
         for rt in list(self._runtimes.values()):
             rt.shutdown()

@@ -65,6 +65,12 @@ class InMemoryPersistenceStore:
         with self._lock:
             self._data.pop(app_name, None)
 
+    def delete_revision(self, app_name: str, revision: str) -> None:
+        """Drop one revision (auto-checkpoint retention pruning — see
+        core/supervision.prune_revisions)."""
+        with self._lock:
+            self._data.get(app_name, {}).pop(revision, None)
+
 
 class FileSystemPersistenceStore:
     """reference: util/persistence/FileSystemPersistenceStore.java:32."""
@@ -112,6 +118,13 @@ class FileSystemPersistenceStore:
             for f in os.listdir(d):
                 os.unlink(os.path.join(d, f))
 
+    def delete_revision(self, app_name: str, revision: str) -> None:
+        """Drop one revision (auto-checkpoint retention pruning — see
+        core/supervision.prune_revisions)."""
+        p = os.path.join(self._dir(app_name), revision)
+        if os.path.exists(p):
+            os.unlink(p)
+
 
 class IncrementalFileSystemPersistenceStore(FileSystemPersistenceStore):
     """Marker subclass: SnapshotService stores base + delta revisions here
@@ -126,13 +139,21 @@ class IncrementalFileSystemPersistenceStore(FileSystemPersistenceStore):
 
 
 def _to_host(tree):
-    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+    # OWNING copies, never views: np.asarray over a jax array can be
+    # zero-copy on CPU backends, leaving the snapshot (and the incremental
+    # delta base kept in `_last_full`) viewing the live XLA buffer — which
+    # the next DONATED dispatch frees out from under it (flaky reads, then
+    # a crash when the view outlives the backend)
+    return jax.tree_util.tree_map(lambda x: np.array(x, copy=True), tree)
 
 
 def _to_device(tree):
     import jax.numpy as jnp
 
-    return jax.tree_util.tree_map(lambda x: jnp.asarray(x), tree)
+    # copy=True: jnp.asarray may alias the unpickled host buffer on CPU,
+    # and the restored state's first donated dispatch would then free
+    # memory numpy still owns (the restore-then-fused-send hazard)
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), tree)
 
 
 def _flat_with_paths(tree) -> dict:
@@ -148,6 +169,13 @@ class SnapshotService:
     def __init__(self, app_runtime) -> None:
         self.rt = app_runtime
         self._last_full: Optional[dict] = None  # {element: {path: leaf}}
+        # base STAGED by full_snapshot(track_base=True), promoted to
+        # _last_full only by commit_base() — i.e. only once the caller has
+        # actually persisted the full payload. Committing eagerly would,
+        # after one failed save, leave every later cycle emitting deltas
+        # against a base revision that never reached the store (restore
+        # then silently no-ops or applies deltas to the wrong base).
+        self._pending_base: Optional[dict] = None
 
     # ---- collection -------------------------------------------------------
 
@@ -218,8 +246,11 @@ class SnapshotService:
             interner = list(self.rt.interner._from_id[1:])
         if track_base:
             # deltas are diffed against the last PERSISTED full snapshot only
-            # (a bytes-API snapshot must not shift the delta base)
-            self._last_full = {k: _flat_with_paths(v) for k, v in elements.items()}
+            # (a bytes-API snapshot must not shift the delta base) — staged
+            # here, promoted by commit_base() after the save succeeds
+            self._pending_base = {
+                k: _flat_with_paths(v) for k, v in elements.items()
+            }
         payload = {
             "type": "full",
             "app": self.rt.name,
@@ -231,6 +262,13 @@ class SnapshotService:
         buf = io.BytesIO()
         pickle.dump(payload, buf, protocol=pickle.HIGHEST_PROTOCOL)
         return buf.getvalue()
+
+    def commit_base(self) -> None:
+        """Promote the base staged by `full_snapshot(track_base=True)` —
+        call ONLY after the payload actually reached the store."""
+        if self._pending_base is not None:
+            self._last_full = self._pending_base
+            self._pending_base = None
 
     def incremental_snapshot(self) -> bytes:
         """Leaves changed since the last full snapshot (falls back to full

@@ -337,6 +337,16 @@ class IngestPipeline:
     def _drain_one(self, packs, K: int, wf=None) -> None:
         import time
 
+        from siddhi_tpu.testing import faults as _faults
+
+        # fault-injection site `drain_worker` (testing/faults.py): the
+        # pipelined analog of the @async drain-worker site — an injected
+        # fault rides the same guarded/unguarded routing a poisoned
+        # delivery takes (_route_drain_error / barrier re-raise)
+        if _faults.ACTIVE is not None:
+            _faults.ACTIVE.check(
+                "drain_worker", self.junction.schema.stream_id
+            )
         ps = self.stats
         t0 = time.perf_counter_ns() if ps is not None else 0
         try:

@@ -18,6 +18,7 @@ import numpy as np
 
 from siddhi_tpu.core.event import EventBatch, StreamSchema
 from siddhi_tpu.core.types import InternTable
+from siddhi_tpu.testing import faults as _faults
 
 # subscriber: fn(batch: EventBatch, now_ms: int) -> None
 Subscriber = Callable[[EventBatch, int], None]
@@ -44,6 +45,11 @@ class StreamJunction:
         # RLock: a query may legally insert into its own input stream
         # (reference allows self-feeding junctions); recursion stays on-thread
         self.lock = threading.RLock()
+        # the owning app's process RLock (set by app_runtime._junction):
+        # held across the whole per-batch fan-out so the snapshot barrier
+        # (SnapshotService.full_snapshot) can never observe a torn
+        # cross-query state mid-batch; None for junctions outside an app
+        self.process_lock = None
         self.on_publish_stats: Callable[[int], None] | None = None
         self.on_error_stats: Callable[[int], None] | None = None
         # per-subscriber error attribution: factory(subscriber_name) -> add fn
@@ -72,6 +78,11 @@ class StreamJunction:
         # user hook for subscriber failures (reference: the pluggable
         # Disruptor ExceptionHandler, SiddhiAppRuntime.java:664)
         self.exception_handler: Callable[[Exception], None] | None = None
+        # supervisor health signal (core/supervision.AppHealth.mark_fatal):
+        # called with (exc, who) on UNGUARDED dispatch failures and worker
+        # errors so manager.supervise() can restart the app; None when the
+        # app is not supervised (one attribute check)
+        self.on_fatal: Callable[[Exception, str], None] | None = None
         # @OnError policy (reference: StreamJunction.handleError + OnErrorAction):
         # None propagates to the sender; 'LOG' logs and drops the failing
         # batch; 'STREAM' redirects it (plus the error) to fault_junction;
@@ -196,6 +207,12 @@ class StreamJunction:
         dtypes = [np.dtype(PHYSICAL_DTYPE[t]) for _n, t in self.schema.attrs]
         names = self.schema.attr_names
         while not self._async_stop.is_set():
+            # fault-injection site `drain_worker` (testing/faults.py):
+            # OUTSIDE the poison-batch guard, so an injected fault kills the
+            # worker thread — the "drain worker death" failure mode the
+            # supervisor's health probe detects
+            if _faults.ACTIVE is not None:
+                _faults.ACTIVE.check("drain_worker", self.schema.stream_id)
             try:
                 ring = self._ring
                 if ring is None:
@@ -231,6 +248,12 @@ class StreamJunction:
                 item = self._queue.get(timeout=0.1)
             except _q.Empty:
                 continue
+            # fault-injection site `drain_worker`: outside the poison-batch
+            # guard — an injected fault KILLS the worker thread (the failure
+            # mode the supervisor's health probe watches for), unlike a
+            # poison batch which _on_worker_error survives
+            if _faults.ACTIVE is not None:
+                _faults.ACTIVE.check("drain_worker", self.schema.stream_id)
             ts_list, rows, now = [item[0]], [item[1]], item[2]
             # opportunistically batch up to batch_max (reference:
             # batch.size.max on the Disruptor consumer)
@@ -263,6 +286,23 @@ class StreamJunction:
         )
         if self.on_error_stats is not None:
             self.on_error_stats(1)
+        nf = self.on_fatal
+        if (
+            nf is not None
+            and self.exception_handler is None
+            and self.fault_policy is None
+        ):
+            # supervised apps treat a poisoned worker as a health signal —
+            # but only when NOBODY owns the failure: with an exception
+            # handler or an @OnError policy configured, the operator chose
+            # handle-and-continue, and restarting would roll state back
+            # over a handled poison batch. This also matters on the replay
+            # path: failure_ownership is thread-local, so a poison entry
+            # replayed into an @async stream fails HERE on the drain
+            # worker thread, and an unconditional flag would put a
+            # supervised app into a restart->replay->crash loop over one
+            # bad stored entry.
+            nf(exc, who)
         handler = self.exception_handler
         if handler is not None:
             try:
@@ -313,6 +353,20 @@ class StreamJunction:
 
     def publish_batch(self, batch: EventBatch, now: int) -> None:
         """Fan a device batch out to all subscribers (already this stream's schema)."""
+        pl = self.process_lock
+        if pl is None:
+            return self._publish_batch(batch, now)
+        # hold the app's snapshot barrier across the WHOLE fan-out: each
+        # subscriber's receive takes the same RLock (nested, free), but
+        # without the outer hold a checkpoint could land BETWEEN two
+        # queries' dispatches of one batch — a torn cross-query snapshot
+        # that diverges on restore+refeed (the chaos harness caught this).
+        # Acquired BEFORE self.lock so lock order is process -> junction
+        # on every path (insert-into chains re-enter under the same RLock)
+        with pl:
+            return self._publish_batch(batch, now)
+
+    def _publish_batch(self, batch: EventBatch, now: int) -> None:
         with self.lock:
             fl = self.flight
             if fl is not None:
@@ -386,15 +440,30 @@ class StreamJunction:
         for fn, name in pairs:
             sp = tr.start_span(name, n_valid) if tr is not None else None
             try:
-                if not guarded:
-                    fn(batch, now)
-                else:
-                    try:
-                        fn(batch, now)
-                    except Exception as e:  # user-owned failure policy
-                        routed |= self._on_dispatch_error(
-                            batch, now, e, routed, subscriber=name,
+                try:
+                    # fault-injection site `junction_dispatch` (testing/
+                    # faults.py): inside the dispatch so an injected
+                    # failure rides the exact path a real subscriber
+                    # explosion takes — the guarded branch routes it per
+                    # the failure policy, the unguarded branch propagates
+                    # it to the sender
+                    if _faults.ACTIVE is not None:
+                        _faults.ACTIVE.check(
+                            "junction_dispatch",
+                            f"{self.schema.stream_id}:{name}",
                         )
+                    fn(batch, now)
+                except Exception as e:
+                    if not guarded:
+                        # unguarded: a fatal health signal for the
+                        # supervisor, then on to the sender
+                        nf = self.on_fatal
+                        if nf is not None:
+                            nf(e, f"dispatch to {name}")
+                        raise
+                    routed |= self._on_dispatch_error(  # user-owned policy
+                        batch, now, e, routed, subscriber=name,
+                    )
             finally:
                 if sp is not None:
                     tr.end_span(sp)
@@ -409,6 +478,13 @@ class StreamJunction:
         fused commit already counted and recorded these events; recording
         again would double them. Per-subscriber failure policy and trace
         spans ride the same _fan_out loop publish_batch uses."""
+        pl = self.process_lock
+        if pl is None:
+            return self._dispatch_subset(batch, now, subset)
+        with pl:  # same snapshot-barrier hold as publish_batch
+            return self._dispatch_subset(batch, now, subset)
+
+    def _dispatch_subset(self, batch: EventBatch, now: int, subset) -> None:
         with self.lock:
             tr = self.tracer
             n_valid = (

@@ -1,0 +1,674 @@
+"""Supervised runtime: auto-checkpoint, crash detection, and restart.
+
+The engine already has the recovery primitives — full checkpoint/restore
+(`core/persistence.SnapshotService`, `persist()`/`restore_revision()`), a
+restart-surviving error store with `replay_errors()`, and health signals on
+every junction — but nothing *drives* them: checkpoints are manual and a
+poisoned drain worker or fatal dispatch error leaves the app dead until a
+human intervenes. This module closes the loop:
+
+- `@app:persist(interval='30 sec', keep='5')` rides the app scheduler to
+  call `persist()` periodically and prune retained revisions to the last N
+  (`AutoPersist`; validated as SA126, shared rule set with the analyzer).
+- `manager.supervise()` starts one `Supervisor` thread per manager that
+  watches the health signals that already exist — unguarded dispatch
+  failures and worker errors (`StreamJunction.on_fatal`), @async drain
+  worker death, pipeline drain-thread death — and on crash executes
+  shutdown -> rebuild the runtime from the retained AST ->
+  `restore_last_revision()` -> `replay_errors()` for that app -> resume,
+  with `BackoffRetryCounter`-capped attempts per `@app:restart(...)`
+  (SA127). Restart events surface in `/status`, Prometheus
+  (`siddhi_supervisor_restarts_total`), and the selfmon stream.
+
+Determinism note: the restart sequence loses nothing that reached a
+checkpoint or the error store — events processed after the last checkpoint
+but before the crash are at-most-once unless their failure path stored
+them (`@OnError(action='STORE')` / sink `on.error='STORE'`), which is the
+zero-loss contract the chaos harness (`siddhi_tpu/testing/faults.py`,
+`tools/chaos_smoke.py`) proves end-to-end.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_MIN_PERSIST_INTERVAL_MS = 50
+
+
+def _sole_positional(ann):
+    """The value of a single UNKEYED element (`@app:restart('never')`),
+    else None. NOT `ann.element(None)`: that falls back to a KEYED single
+    element's value, so `@app:persist(keep='5')` would resolve keep as a
+    5 ms interval and `@app:restart(max.attempts='5')` as policy='5'."""
+    if len(ann.elements) == 1 and ann.elements[0][0] is None:
+        return ann.elements[0][1]
+    return None
+
+
+def _parse_time_ms(v) -> Optional[int]:
+    """'30 sec' / '500 millisec' / bare integer ms -> ms, None if malformed."""
+    from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+    s = str(v).strip()
+    try:
+        return int(s)
+    except ValueError:
+        try:
+            return SiddhiCompiler.parse_time_constant(s)
+        except Exception:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# @app:persist — auto-checkpoint (SA126 shares these rules)
+# ---------------------------------------------------------------------------
+
+
+def iter_persist_annotation_problems(ann):
+    """Yield one message per `@app:persist` problem — THE validation rules,
+    shared by the runtime resolver (raises on the first) and the analyzer's
+    SA126 diagnostic (reports them all)."""
+    for k, v in ann.elements:
+        if k == "interval" or (k is None and len(ann.elements) == 1):
+            ms = _parse_time_ms(v)
+            if ms is None or ms < _MIN_PERSIST_INTERVAL_MS:
+                yield (
+                    f"@app:persist interval '{v}' must be a time constant of "
+                    f"at least {_MIN_PERSIST_INTERVAL_MS} millisec "
+                    "(e.g. '30 sec')"
+                )
+        elif k == "keep":
+            try:
+                keep = int(str(v).strip())
+            except ValueError:
+                keep = 0
+            if keep < 1:
+                yield (
+                    f"@app:persist keep '{v}' must be a positive revision "
+                    "count (e.g. keep='5')"
+                )
+        else:
+            yield (
+                f"unknown @app:persist option '{k if k is not None else v}' "
+                "(expected interval, keep)"
+            )
+
+
+def resolve_persist_annotation(ann) -> tuple[int, Optional[int]]:
+    """(interval_ms, keep) for one `@app:persist` annotation. Raises
+    SiddhiAppCreationError on malformed options — the runtime analog of the
+    analyzer's SA126."""
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    for problem in iter_persist_annotation_problems(ann):
+        raise SiddhiAppCreationError(problem)
+    v = ann.element("interval") or _sole_positional(ann)
+    interval = _parse_time_ms(v) if v is not None else 30_000
+    keep = ann.element("keep")
+    return interval, (int(keep) if keep is not None else None)
+
+
+def prune_revisions(store, app_name: str, keep: int) -> list[str]:
+    """Drop all but the newest `keep` revisions; returns what was pruned.
+    For incremental stores the newest FULL snapshot at-or-before the oldest
+    kept revision is retained too — it is the base every kept delta replays
+    from (`SiddhiAppRuntime._incremental_chain`). Stores without
+    `delete_revision` are left untouched."""
+    delete = getattr(store, "delete_revision", None)
+    if delete is None:
+        return []
+    revs = store.list_revisions(app_name)
+    if len(revs) <= keep:
+        return []
+    drop = revs[: len(revs) - keep]
+    if getattr(store, "incremental", False):
+        import pickle
+
+        base = None
+        for r in revs[: len(revs) - keep + 1]:  # up to and incl. oldest kept
+            data = store.load(app_name, r)
+            if data is None:
+                continue
+            try:
+                if pickle.loads(data)["type"] == "full":
+                    base = r
+            except Exception:
+                continue
+        drop = [r for r in drop if r != base]
+    for r in drop:
+        delete(app_name, r)
+    return drop
+
+
+class AutoPersist:
+    """Recurring scheduler target calling `runtime.persist()` every
+    `interval_ms` and pruning retained revisions to the last `keep` (owned
+    by SiddhiAppRuntime, armed at start() — mirrors SelfMonitor)."""
+
+    def __init__(self, runtime, interval_ms: int, keep: Optional[int]):
+        self.runtime = runtime
+        self.interval_ms = int(interval_ms)
+        self.keep = keep
+        self.persists = 0
+        self.failures = 0
+        self.pruned = 0
+        self.last_revision: Optional[str] = None
+        self.last_error: Optional[str] = None
+        # ONE stable target: the scheduler dedups pending fires by id(target)
+        self._target = self._fire
+
+    def start(self) -> None:
+        rt = self.runtime
+        rt._scheduler.start()
+        rt._scheduler.notify_at(rt.clock() + self.interval_ms, self._target)
+
+    def _fire(self, t_ms: int) -> None:
+        rt = self.runtime
+        if not rt._running:
+            return
+        try:
+            self.last_revision = rt.persist()
+            if self.keep is not None:
+                self.pruned += len(
+                    prune_revisions(
+                        rt.manager.persistence_store, rt.name, self.keep
+                    )
+                )
+            # incremented last: observers polling `persists` may assume the
+            # cycle's retention pruning has already happened and the error
+            # field reflects this cycle
+            self.last_error = None
+            self.persists += 1
+        except Exception as e:
+            # a failing store (disk full, injected persist_save fault) must
+            # not kill the scheduler thread or stop future attempts
+            self.failures += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            log.exception("auto-persist for app '%s' failed", rt.name)
+        finally:
+            rt._scheduler.notify_at(t_ms + self.interval_ms, self._target)
+
+    def describe_state(self) -> dict:
+        d = {
+            "interval_ms": self.interval_ms,
+            "keep": self.keep,
+            "persists": self.persists,
+            "failures": self.failures,
+            "pruned": self.pruned,
+        }
+        if self.last_revision is not None:
+            d["last_revision"] = self.last_revision
+        if self.last_error is not None:
+            d["last_error"] = self.last_error
+        return d
+
+
+# ---------------------------------------------------------------------------
+# @app:restart — restart policy (SA127 shares these rules)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RestartPolicy:
+    policy: str = "on-failure"  # on-failure | never
+    max_attempts: int = 3
+    backoff_cap_ms: Optional[int] = None
+    reset_after_ms: int = 300_000  # healthy this long -> attempt streak resets
+
+
+_RESTART_POLICIES = ("on-failure", "never")
+
+
+def iter_restart_annotation_problems(ann):
+    """Yield one message per `@app:restart` problem (SA127 + runtime)."""
+    for k, v in ann.elements:
+        if k == "policy" or (k is None and len(ann.elements) == 1):
+            if str(v).strip().lower() not in _RESTART_POLICIES:
+                yield (
+                    f"@app:restart policy '{v}' must be one of "
+                    f"{_RESTART_POLICIES}"
+                )
+        elif k == "max.attempts":
+            try:
+                n = int(str(v).strip())
+            except ValueError:
+                n = 0
+            if n < 1:
+                yield (
+                    f"@app:restart max.attempts '{v}' must be a positive "
+                    "integer"
+                )
+        elif k in ("backoff", "reset.after"):
+            if _parse_time_ms(v) is None:
+                yield (
+                    f"@app:restart {k} '{v}' must be a time constant "
+                    "(e.g. '5 sec')"
+                )
+        else:
+            yield (
+                f"unknown @app:restart option '{k if k is not None else v}' "
+                "(expected policy, max.attempts, backoff, reset.after)"
+            )
+
+
+def resolve_restart_annotation(ann) -> RestartPolicy:
+    """RestartPolicy from `@app:restart(...)`. Raises SiddhiAppCreationError
+    on malformed options — the runtime analog of SA127."""
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    for problem in iter_restart_annotation_problems(ann):
+        raise SiddhiAppCreationError(problem)
+    rp = RestartPolicy()
+    v = ann.element("policy") or _sole_positional(ann)
+    if v is not None:
+        rp.policy = str(v).strip().lower()
+    v = ann.element("max.attempts")
+    if v is not None:
+        rp.max_attempts = int(v)
+    v = ann.element("backoff")
+    if v is not None:
+        rp.backoff_cap_ms = _parse_time_ms(v)
+    v = ann.element("reset.after")
+    if v is not None:
+        rp.reset_after_ms = _parse_time_ms(v)
+    return rp
+
+
+# ---------------------------------------------------------------------------
+# health signals
+# ---------------------------------------------------------------------------
+
+
+_OWNED = threading.local()
+
+
+class failure_ownership:
+    """Context manager suppressing `AppHealth.mark_fatal` on this thread:
+    entered by callers that CATCH AND HANDLE dispatch failures themselves —
+    a source delivering under its own `on.error` policy, or an error-replay
+    loop whose caller keeps the entry on failure. Without it, a failure the
+    upstream policy fully owns (stored, routed, logged) would still flag
+    the app as crashed and a supervised runtime would restart — rolling
+    state back over a handled poison payload, potentially forever."""
+
+    def __enter__(self):
+        _OWNED.depth = getattr(_OWNED, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _OWNED.depth -= 1
+
+
+def failures_owned() -> bool:
+    return getattr(_OWNED, "depth", 0) > 0
+
+
+class AppHealth:
+    """Per-app crash-signal collector. `mark_fatal` is the junction
+    `on_fatal` hook — called on unguarded dispatch failures and worker
+    errors; it never raises and never blocks (one append + one notify)."""
+
+    def __init__(self, app_name: str, notify) -> None:
+        self.app_name = app_name
+        self._notify = notify  # Supervisor wake-up
+        self.fatal = collections.deque(maxlen=32)  # (ts_ms, who, error)
+        self.flagged = False
+
+    def mark_fatal(self, exc: BaseException, who: str) -> None:
+        if failures_owned():
+            return  # an upstream on.error policy will capture this failure
+        try:
+            self.fatal.append(
+                (int(time.time() * 1000), who, f"{type(exc).__name__}: {exc}")
+            )
+            self.flagged = True
+            self._notify()
+        except Exception:  # pragma: no cover - must never re-raise mid-crash
+            pass
+
+    def describe_state(self) -> dict:
+        return {
+            "flagged": self.flagged,
+            "fatal_signals": len(self.fatal),
+            "last_fatal": list(self.fatal)[-1] if self.fatal else None,
+        }
+
+
+def _probe_runtime(rt) -> Optional[str]:
+    """Liveness probe beyond explicit signals: a dead @async drain worker or
+    a dead pipeline drain thread means events queue forever with nobody
+    draining — the junction never reports it (the thread is simply gone)."""
+    for sid, j in list(rt.junctions.items()):
+        if j.is_async:
+            workers = getattr(j, "_workers", [])
+            if workers and not any(t.is_alive() for t in workers):
+                return f"stream '{sid}': every async drain worker is dead"
+        fi = j.fused_ingest
+        pl = getattr(fi, "pipeline", None) if fi is not None else None
+        if pl is not None:
+            t = getattr(pl, "_thread", None)
+            if t is not None and not t.is_alive() and not pl._closed:
+                return f"stream '{sid}': pipeline drain thread is dead"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the supervisor
+# ---------------------------------------------------------------------------
+
+
+class _StableInputHandler:
+    """Restart-stable ingress facade: resolves the app's CURRENT runtime on
+    every call, so a handle obtained before a supervised restart keeps
+    working after it (the raw InputHandler binds the dead junction)."""
+
+    def __init__(self, manager, app_name: str, stream_id: str) -> None:
+        self._manager = manager
+        self._app = app_name
+        self._sid = stream_id
+
+    def _h(self):
+        rt = self._manager.get_siddhi_app_runtime(self._app)
+        if rt is None:
+            from siddhi_tpu.core.errors import DefinitionNotExistError
+
+            raise DefinitionNotExistError(
+                f"no app '{self._app}' on this manager"
+            )
+        return rt.get_input_handler(self._sid)
+
+    def send(self, data, timestamp=None):
+        return self._h().send(data, timestamp)
+
+    def send_many(self, rows, timestamps=None):
+        return self._h().send_many(rows, timestamps)
+
+    def send_columns(self, timestamps, cols, now=None):
+        return self._h().send_columns(timestamps, cols, now)
+
+
+class Supervisor:
+    """One per manager (`manager.supervise()`): watches every attached app's
+    health signals and liveness, restarts crashed apps under their
+    `@app:restart` policy, and surfaces restart events in `/status`,
+    Prometheus, and selfmon."""
+
+    def __init__(self, manager, poll_interval_s: float = 0.25) -> None:
+        self.manager = manager
+        self.poll_interval_s = float(poll_interval_s)
+        self._cv = threading.Condition()
+        self._stop = False
+        self._health: dict[str, AppHealth] = {}
+        self._attempts: dict[str, int] = {}  # restart streak per app
+        self._last_restart_ms: dict[str, int] = {}
+        self.restarts: dict[str, int] = {}  # app -> successful restarts
+        self.gave_up: dict[str, str] = {}  # app -> reason
+        # apps whose last restart ATTEMPT failed (e.g. restore raised): the
+        # rebuilt runtime is down (_running=False), so liveness probing
+        # can't see it — this map keeps the next poll retrying until the
+        # attempt budget runs out instead of abandoning the app
+        self._down: dict[str, str] = {}  # app -> reason
+        # first sighting of the CURRENT crash episode (cleared on a
+        # successful restart): the reset-after-healthy check measures the
+        # healthy stretch up to here, not wall time since the last attempt
+        # — an app sitting dead through its backoff window is not healthy
+        self._crash_seen_ms: dict[str, int] = {}
+        self._rebuilding: Optional[str] = None  # app mid-_do_restart
+        self.events = collections.deque(maxlen=64)  # (ts, app, what)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="siddhi-supervisor"
+        )
+        self._thread.start()
+
+    # ---- wiring ----------------------------------------------------------
+
+    def attach(self, rt) -> None:
+        """Supervise one runtime: install the AppHealth hook on every
+        junction (lazily-created junctions pick it up in `_junction()`)."""
+        if self._rebuilding != rt.name:
+            # an OPERATOR redeploy under the same name starts a fresh
+            # supervision life: the exhausted-budget verdict and the
+            # attempt streak belong to the replaced deployment. The
+            # supervisor's own rebuild (create inside _do_restart) must
+            # NOT reset them, or max.attempts could never exhaust.
+            self.gave_up.pop(rt.name, None)
+            self._down.pop(rt.name, None)
+            self._attempts.pop(rt.name, None)
+            self._crash_seen_ms.pop(rt.name, None)
+        health = AppHealth(rt.name, self._wake)
+        self._health[rt.name] = health
+        rt._health = health
+        for j in list(rt.junctions.values()):
+            j.on_fatal = health.mark_fatal
+
+    def detach(self, app_name: str) -> None:
+        self._health.pop(app_name, None)
+
+    def input_handler(self, app_name: str, stream_id: str):
+        """A restart-stable input handler for `stream_id` of `app_name`."""
+        return _StableInputHandler(self.manager, app_name, stream_id)
+
+    def _wake(self) -> None:
+        with self._cv:
+            self._cv.notify()
+
+    # ---- the loop --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+                self._cv.wait(timeout=self.poll_interval_s)
+                if self._stop:
+                    return
+            try:
+                self._check_all()
+            except Exception:  # pragma: no cover - loop must survive
+                log.exception("supervisor check failed")
+
+    def _check_all(self) -> None:
+        for name, health in list(self._health.items()):
+            rt = self.manager.get_siddhi_app_runtime(name)
+            if rt is None:
+                # intentionally shut down and deregistered
+                self.detach(name)
+                continue
+            if getattr(rt, "_health", None) is not health:
+                continue  # replaced mid-restart; the new health is tracked
+            if name in self.gave_up:
+                continue
+            if name in self._down:
+                # the last restart ATTEMPT failed and left the app down
+                # (_running=False) — keep retrying against the remaining
+                # attempt budget rather than abandoning it
+                self._restart(name, rt, self._down[name])
+                continue
+            if not rt._running:
+                continue  # not started (or stopping) — nothing to probe
+            reason = None
+            if health.flagged:
+                reason = (
+                    health.fatal[-1][2] if health.fatal else "fatal signal"
+                )
+            else:
+                reason = _probe_runtime(rt)
+            if reason is not None:
+                self._restart(name, rt, reason)
+
+    # ---- restart ---------------------------------------------------------
+
+    def _policy_for(self, rt) -> RestartPolicy:
+        from siddhi_tpu.query_api.annotation import find_annotation
+
+        ann = find_annotation(rt.app.annotations, "app:restart")
+        if ann is None:
+            return RestartPolicy()
+        try:
+            return resolve_restart_annotation(ann)
+        except Exception:  # validated at creation; belt and braces
+            return RestartPolicy()
+
+    def _restart(self, name: str, rt, reason: str) -> None:
+        from siddhi_tpu.core.io import BackoffRetryCounter
+
+        now_ms = int(time.time() * 1000)
+        policy = self._policy_for(rt)
+        if policy.policy == "never":
+            self._down.pop(name, None)
+            self.gave_up[name] = f"policy=never ({reason})"
+            self.events.append((now_ms, name, f"not restarted: {reason}"))
+            log.error(
+                "supervisor: app '%s' crashed (%s); @app:restart policy is "
+                "'never' — leaving it down", name, reason,
+            )
+            rt.shutdown()
+            return
+        # streak reset only after a genuinely HEALTHY stretch: from the
+        # last restart attempt to the first sighting of THIS crash. Using
+        # `now` instead would count backoff/down time as healthy, and a
+        # crash-looping app whose backoff ladder reaches reset.after would
+        # reset its streak forever — gave_up unreachable.
+        seen = self._crash_seen_ms.setdefault(name, now_ms)
+        last = self._last_restart_ms.get(name, 0)
+        if seen - last > policy.reset_after_ms:
+            self._attempts[name] = 0
+        attempts = self._attempts.get(name, 0)
+        if attempts >= policy.max_attempts:
+            self._down.pop(name, None)
+            self.gave_up[name] = (
+                f"max.attempts={policy.max_attempts} exhausted ({reason})"
+            )
+            self.events.append((now_ms, name, f"gave up: {reason}"))
+            log.error(
+                "supervisor: app '%s' crashed (%s) but its restart budget "
+                "(max.attempts=%d) is exhausted — leaving it down",
+                name, reason, policy.max_attempts,
+            )
+            rt.shutdown()
+            return
+        # backoff BEFORE the attempt (attempt 0 restarts immediately): the
+        # same ladder transports use, capped by @app:restart(backoff=...).
+        # A due-time gate, NOT a sleep: the one supervisor thread serves
+        # every app on the manager, and a crash-looping app must not hold
+        # the others' crash detection hostage for its backoff window — the
+        # still-flagged health (or the _down marker) re-enters here on a
+        # later poll until the window has elapsed.
+        if attempts > 0:
+            counter = BackoffRetryCounter(max_interval_ms=policy.backoff_cap_ms)
+            iv = 0
+            for _ in range(attempts):
+                iv = counter.next_interval_ms()
+            if now_ms < self._last_restart_ms.get(name, 0) + iv:
+                return
+        self._attempts[name] = attempts + 1
+        self._last_restart_ms[name] = now_ms
+        log.warning(
+            "supervisor: restarting app '%s' (attempt %d/%d): %s",
+            name, attempts + 1, policy.max_attempts, reason,
+        )
+        try:
+            self._do_restart(name, rt)
+        except Exception as e:
+            # the app is now down with budget left: _down keeps the next
+            # poll retrying (the rebuilt-but-unstarted runtime fails the
+            # _running liveness probe, so nothing else would re-trigger)
+            self._down[name] = f"{type(e).__name__}: {e}"
+            self.events.append(
+                (now_ms, name, f"restart failed: {type(e).__name__}: {e}")
+            )
+            log.exception("supervisor: restart of app '%s' failed", name)
+            return
+        self._down.pop(name, None)
+        # this crash episode is over: the next crash is a fresh sighting
+        self._crash_seen_ms.pop(name, None)
+        self.restarts[name] = self.restarts.get(name, 0) + 1
+        self.events.append((now_ms, name, f"restarted: {reason}"))
+
+    def _do_restart(self, name: str, rt) -> None:
+        """shutdown -> rebuild from the retained AST -> restore the last
+        checkpoint -> replay this app's stored errors -> resume."""
+        mgr = self.manager
+        app_ast = rt.app
+        callbacks = list(getattr(rt, "_user_callbacks", []))
+        handler = getattr(rt, "_exception_handler", None)
+        try:
+            rt.shutdown()
+        except Exception:
+            log.exception(
+                "supervisor: shutdown of crashed app '%s' raised; "
+                "rebuilding anyway", name,
+            )
+        # create_siddhi_app_runtime re-attaches supervision (manager hook);
+        # _rebuilding tells attach() this is OUR rebuild, not an operator
+        # redeploy, so the attempt streak survives the re-attach
+        self._rebuilding = name
+        try:
+            new_rt = mgr.create_siddhi_app_runtime(app_ast)
+        finally:
+            self._rebuilding = None
+        for cb_name, cb in callbacks:
+            try:
+                new_rt.add_callback(cb_name, cb)
+            except Exception:
+                log.exception(
+                    "supervisor: could not re-register callback '%s' on "
+                    "app '%s'", cb_name, name,
+                )
+        if handler is not None:
+            new_rt.set_exception_handler(handler)
+        if mgr.persistence_store is not None:
+            new_rt.restore_last_revision()
+        new_rt.start()
+        # replay ONLY this app's entries, without letting a WAIT-blocked
+        # sink wedge the supervisor thread
+        store = mgr._error_store
+        if store is not None:
+            entries = store.load(app_name=name)
+            if entries:
+                n = mgr.replay_errors(entries=entries, skip_unavailable=True)
+                log.info(
+                    "supervisor: replayed %d/%d stored entries for app '%s'",
+                    n, len(entries), name,
+                )
+
+    # ---- surfacing -------------------------------------------------------
+
+    def describe_state(self) -> dict:
+        return {
+            "apps_supervised": sorted(self._health),
+            "restarts": dict(self.restarts),
+            "restarts_total": sum(self.restarts.values()),
+            "gave_up": dict(self.gave_up),
+            "down": dict(self._down),
+            "events": [list(e) for e in self.events],
+        }
+
+    def prometheus_text(self) -> str:
+        lines = [
+            "# HELP siddhi_supervisor_restarts_total Successful supervised "
+            "app restarts",
+            "# TYPE siddhi_supervisor_restarts_total counter",
+        ]
+        apps = set(self._health) | set(self.restarts)
+        for app in sorted(apps):
+            lines.append(
+                f'siddhi_supervisor_restarts_total{{app="{app}"}} '
+                f"{self.restarts.get(app, 0)}"
+            )
+        return "\n".join(lines) + "\n"
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)

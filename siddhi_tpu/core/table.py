@@ -120,6 +120,14 @@ class InMemoryTable:
         # table; flush_latency times record-store write-through snapshots
         self.mutation_stats = None
         self.flush_latency = None
+        # @OnError on the table definition (wired by the app runtime):
+        # mutation failures — the mutating query's dispatch AND record-store
+        # flushes here — route to the error store ('STORE') or the log
+        # ('LOG') instead of propagating to the sender; None keeps the
+        # propagate-to-sender behavior
+        self.fault_policy = None
+        self.app_name = ""
+        self.error_store_fn = None
 
         # @store(type='...'): external record store — load initial contents,
         # write a snapshot through after each mutation (reference:
@@ -205,9 +213,35 @@ class InMemoryTable:
                 self._flush_timer = None
             from siddhi_tpu.observability.metrics import timed
 
-            with timed(self.flush_latency):
-                rows = self.rows()
-                store.on_change(rows)
+            try:
+                with timed(self.flush_latency):
+                    rows = self.rows()
+                    store.on_change(rows)
+            except Exception as e:
+                # @OnError on the table owns flush failures too (a record
+                # store outage must not poison the mutating dispatch or the
+                # deferred-flush timer thread); the table stays dirty so
+                # the next flush retries
+                if self.fault_policy is None:
+                    raise
+                import logging
+
+                log = logging.getLogger(__name__)
+                # flush failures are NOT stored even under STORE: the table
+                # stays dirty and the next flush retries with the full
+                # current rows, so nothing is lost — while a stored flush
+                # entry carries no events and no input stream (sink_ref),
+                # can never be replayed or purged, and a sustained outage
+                # would flood the FIFO store, evicting genuinely
+                # replayable entries. STORE applies to MUTATION failures
+                # (wired by the app runtime around the mutating dispatch,
+                # with the query's input batch attached).
+                log.error(
+                    "table '%s': record-store flush failed (@OnError "
+                    "action='%s'); the table stays dirty and the next "
+                    "flush retries: %s", self.table_id, self.fault_policy, e,
+                )
+                return
             self._dirty = False
             self._last_flush = _time.monotonic()
 

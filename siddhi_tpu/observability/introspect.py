@@ -77,10 +77,14 @@ def render_status(status: dict) -> str:
             lines.append(f"  {section}:")
             for cid, d in comps.items():
                 _render_component(lines, cid, d, "    ")
-        sm = app.get("selfmon")
-        if sm:
-            _render_component(lines, "selfmon", sm, "  ")
+        for extra in ("selfmon", "admission", "autopersist", "health"):
+            d = app.get(extra)
+            if d:
+                _render_component(lines, extra, d, "  ")
     es = status.get("error_store")
     if es:
         _render_component(lines, "error_store", es, "")
+    sup = status.get("supervisor")
+    if sup:
+        _render_component(lines, "supervisor", sup, "")
     return "\n".join(lines) + "\n"

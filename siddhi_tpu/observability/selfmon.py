@@ -160,6 +160,28 @@ class SelfMonitor:
                 ))
             except Exception:
                 pass
+        # supervised-runtime health: restart + admission counters ride the
+        # same CEP-queryable stream (core/supervision.py, core/admission.py)
+        sup = getattr(rt.manager, "_supervisor", None)
+        if sup is not None:
+            out.append((
+                "supervisor", "restarts",
+                float(sup.restarts.get(rt.name, 0)), 0.0,
+            ))
+        adm = getattr(rt, "_admission", None)
+        if adm is not None:
+            out.append(("admission", "shed", float(adm.shed), 0.0))
+            out.append((
+                "admission", "blocked_ms", float(adm.blocked_ms), 0.0
+            ))
+        ap = getattr(rt, "_autopersist", None)
+        if ap is not None:
+            out.append((
+                "autopersist", "persists", float(ap.persists), 0.0
+            ))
+            out.append((
+                "autopersist", "failures", float(ap.failures), 0.0
+            ))
         return out
 
     # ---- scheduling ------------------------------------------------------

@@ -1,0 +1,20 @@
+"""Testing utilities: the deterministic fault-injection harness used by the
+supervised-runtime recovery tests and the CI chaos leg (see `faults`)."""
+
+from siddhi_tpu.testing.faults import (  # noqa: F401
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+    install,
+    parse_plan,
+    uninstall,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "install",
+    "parse_plan",
+    "uninstall",
+]

@@ -1,0 +1,195 @@
+"""Deterministic fault injection: the chaos half of the supervised runtime.
+
+A `FaultPlan` is a seeded set of rules over NAMED INJECTION SITES — fixed
+points in the engine where real production failures originate. Each site
+calls `faults.hit(site, key)` on its hot path; with no plan installed that
+is one module-attribute check (`ACTIVE is None`), so the engine pays
+nothing in normal operation. With a plan installed, matching rules count
+the hit and deterministically decide whether to raise.
+
+Sites (the key passed at each):
+
+    sink_publish        "<app>:<stream>"  Sink.publish_guarded, raises
+                        ConnectionUnavailableError by default so the sink's
+                        on.error policy engages exactly like a real outage
+    junction_dispatch   "<stream>:<subscriber>"  StreamJunction._fan_out,
+                        inside the guarded region so @OnError policies own
+                        the failure when configured
+    device_dispatch     "<component>"  the fused chunk program dispatch
+                        (core/ingest.py _dispatch_chunk)
+    drain_worker        "<stream>"  @async drain workers and the pipelined
+                        ingest drain (poison-batch path)
+    persist_save        "<app>"  persistence-store save
+    persist_load        "<app>"  persistence-store load
+
+Determinism: rules fire by hit count (`after` skips the first N matching
+hits, `times` bounds how often the rule fires), optionally thinned by a
+probability `p` drawn from a `random.Random(seed:site:index)` — the same
+plan over the same call sequence always fails at the same points. Counting
+is lock-protected; multi-threaded call ORDER is the caller's to pin down
+(single-threaded feeds in tests).
+
+Activation: `install(plan)` / `uninstall()` from code, or the
+`SIDDHI_TPU_FAULTS` environment variable (parsed once at import, so
+subprocess chaos runs need no API access):
+
+    SIDDHI_TPU_FAULTS="seed=42;junction_dispatch:after=10,times=2;sink_publish@Out:p=0.2,times=-1"
+
+Rule grammar: `site[@key_substring]:opt=val[,opt=val...]` joined by `;`,
+with opts `after`, `times` (-1 = forever), `p`, `error` (`fault` raises
+InjectedFault, `conn` raises ConnectionUnavailableError). A bare
+`seed=N` entry seeds the plan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+from typing import Optional
+
+
+class InjectedFault(RuntimeError):
+    """An error raised by the fault-injection harness (never by real code)."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    site: str
+    match: str = ""          # substring filter over the site key ("" = any)
+    after: int = 0           # skip the first `after` matching hits
+    times: Optional[int] = 1  # fire at most this many times (None = forever)
+    p: float = 1.0           # thinning probability once past `after`
+    error: Optional[str] = None  # 'fault' | 'conn' (None = site default)
+    hits: int = 0
+    fired: int = 0
+
+
+# sites whose real-world failure mode is a transport outage default to
+# ConnectionUnavailableError so the engine's retry/on.error machinery engages
+_CONN_SITES = frozenset({"sink_publish"})
+
+
+class FaultPlan:
+    """Seeded, deterministic failure schedule over the named sites."""
+
+    def __init__(self, rules: list[FaultRule], seed: int = 0) -> None:
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._rngs = [
+            random.Random(f"{self.seed}:{r.site}:{i}")
+            for i, r in enumerate(self.rules)
+        ]
+        self.log: list[tuple[str, str]] = []  # (site, key) per fired fault
+
+    def check(self, site: str, key: str = "") -> None:
+        """Count one hit at `site`; raise when a matching rule fires."""
+        for i, r in enumerate(self.rules):
+            if r.site != site or (r.match and r.match not in key):
+                continue
+            with self._lock:
+                r.hits += 1
+                if r.hits <= r.after:
+                    continue
+                if r.times is not None and r.fired >= r.times:
+                    continue
+                if r.p < 1.0 and self._rngs[i].random() >= r.p:
+                    continue
+                r.fired += 1
+                self.log.append((site, key))
+            kind = r.error or ("conn" if site in _CONN_SITES else "fault")
+            if kind == "conn":
+                from siddhi_tpu.core.errors import ConnectionUnavailableError
+
+                raise ConnectionUnavailableError(
+                    f"injected fault at {site} ({key})"
+                )
+            raise InjectedFault(f"injected fault at {site} ({key})")
+
+    def report(self) -> dict:
+        """Fired/hit counts per rule (test assertions + chaos-run logs)."""
+        return {
+            "seed": self.seed,
+            "rules": [
+                {
+                    "site": r.site, "match": r.match, "after": r.after,
+                    "times": r.times, "p": r.p,
+                    "hits": r.hits, "fired": r.fired,
+                }
+                for r in self.rules
+            ],
+            "fired_total": sum(r.fired for r in self.rules),
+        }
+
+
+def parse_plan(spec: str) -> FaultPlan:
+    """Parse the SIDDHI_TPU_FAULTS grammar into a FaultPlan (see module
+    docstring). Raises ValueError on malformed specs — a chaos run with a
+    typo'd plan must fail loudly, not run fault-free."""
+    seed = 0
+    rules: list[FaultRule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if part.startswith("seed="):
+            seed = int(part[5:])
+            continue
+        head, sep, opts_s = part.partition(":")
+        if not sep:
+            raise ValueError(f"fault rule '{part}' needs ':opt=val' options")
+        site, _, match = head.partition("@")
+        kw: dict = {"site": site.strip(), "match": match.strip()}
+        for opt in opts_s.split(","):
+            k, sep, v = opt.partition("=")
+            if not sep:
+                raise ValueError(f"fault option '{opt}' is not k=v")
+            k = k.strip()
+            v = v.strip()
+            if k == "after":
+                kw["after"] = int(v)
+            elif k == "times":
+                kw["times"] = None if int(v) < 0 else int(v)
+            elif k == "p":
+                kw["p"] = float(v)
+                if not 0.0 < kw["p"] <= 1.0:
+                    raise ValueError(f"fault p={v} must be in (0, 1]")
+            elif k == "error":
+                if v not in ("fault", "conn"):
+                    raise ValueError(f"fault error='{v}' (fault|conn)")
+                kw["error"] = v
+            else:
+                raise ValueError(f"unknown fault option '{k}'")
+        rules.append(FaultRule(**kw))
+    return FaultPlan(rules, seed=seed)
+
+
+# the active plan; hot paths check `ACTIVE is not None` before calling hit()
+ACTIVE: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global ACTIVE
+    ACTIVE = plan
+    return plan
+
+
+def uninstall() -> Optional[FaultPlan]:
+    global ACTIVE
+    plan, ACTIVE = ACTIVE, None
+    return plan
+
+
+def hit(site: str, key: str = "") -> None:
+    """Injection-site hook: no-op without a plan; otherwise may raise."""
+    plan = ACTIVE
+    if plan is not None:
+        plan.check(site, key)
+
+
+# env activation: parsed once at import so subprocess chaos legs need no API
+_env = os.environ.get("SIDDHI_TPU_FAULTS")
+if _env:
+    ACTIVE = parse_plan(_env)

@@ -1,0 +1,319 @@
+"""Admission-control tests: per-app bounded ingress, overload policies, and
+tenant isolation (`@app:admission`, core/admission.py).
+
+The isolation contract (ISSUE 9): one bursting app degrades ITSELF — sheds
+or blocks per its policy, counts metered — while a steady app on the same
+manager keeps delivering every event.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import AdmissionRejectedError, SiddhiManager
+from siddhi_tpu.core.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    resolve_admission_annotation,
+)
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+
+def _wait_for(pred, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.01)
+    return pred()
+
+
+def _app(mgr, name, admission, collect="Out"):
+    rt = mgr.create_siddhi_app_runtime(f"""
+    @app:name('{name}')
+    {admission}
+    define stream S (v long);
+    @info(name='q')
+    from S select v insert into Out;
+    """)
+    got = []
+    rt.add_callback(collect, lambda evs: got.extend(e.data for e in evs))
+    rt.start()
+    return rt, got
+
+
+class TestAdmissionPolicies:
+    def test_shed_newest_keeps_head(self):
+        mgr = SiddhiManager()
+        rt, got = _app(
+            mgr, "ShedNew",
+            "@app:admission(policy='shed_newest', rate.limit='100')",
+        )
+        rt.get_input_handler("S").send_many([(i,) for i in range(500)])
+        st = rt.snapshot_status()["admission"]
+        assert st["shed"] == 400 and st["admitted"] == 100
+        assert got[0] == (0,) and got[-1] == (99,)
+        mgr.shutdown()
+
+    def test_shed_oldest_keeps_tail(self):
+        mgr = SiddhiManager()
+        rt, got = _app(
+            mgr, "ShedOld",
+            "@app:admission(policy='shed_oldest', rate.limit='100')",
+        )
+        rt.get_input_handler("S").send_many([(i,) for i in range(500)])
+        st = rt.snapshot_status()["admission"]
+        assert st["shed"] == 400
+        assert got[0] == (400,) and got[-1] == (499,), (
+            "shed_oldest must keep the freshest events"
+        )
+        mgr.shutdown()
+
+    def test_shed_oldest_drains_async_queue(self):
+        # a python-queue @async junction: admission drops QUEUED events
+        # first, so the freshest data survives end to end
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('ShedQ')
+        @app:admission(policy='shed_oldest', max.pending='4')
+        define stream S (v long, pad string);
+        @info(name='q')
+        from S select v insert into Out;
+        """)
+        rt.start()
+        j = rt.junctions["S"]
+        j.enable_async(buffer_size=64, workers=1)
+        # park the drain worker behind the junction lock so the queue fills
+        with j.lock:
+            h = rt.get_input_handler("S")
+            for i in range(12):
+                h.send((i, "x"))
+            ctl = rt._admission
+        assert ctl.shed > 0
+        assert _wait_for(lambda: j.queued() == 0)
+        mgr.shutdown()
+
+    def test_error_policy_raises_and_refunds(self):
+        mgr = SiddhiManager()
+        rt, got = _app(
+            mgr, "ErrPol",
+            "@app:admission(policy='error', rate.limit='100')",
+        )
+        h = rt.get_input_handler("S")
+        with pytest.raises(AdmissionRejectedError):
+            h.send_many([(i,) for i in range(500)])
+        assert not got, "a rejected send must deliver nothing"
+        st = rt.snapshot_status()["admission"]
+        assert st["rejected"] == 500 and st["shed"] == 0
+        # the refunded tokens admit an in-quota send immediately
+        h.send_many([(i,) for i in range(50)])
+        assert len(got) == 50
+        mgr.shutdown()
+
+    def test_block_backpressures_then_sheds_at_timeout(self):
+        mgr = SiddhiManager()
+        rt, got = _app(
+            mgr, "BlockPol",
+            "@app:admission(policy='block', rate.limit='100', "
+            "block.timeout='250 millisec')",
+        )
+        t0 = time.monotonic()
+        rt.get_input_handler("S").send_many([(i,) for i in range(500)])
+        wall = time.monotonic() - t0
+        st = rt.snapshot_status()["admission"]
+        assert wall >= 0.2, "block must back-pressure the sender"
+        assert st["blocked_ms"] >= 200
+        # ~25 more tokens refill during the wait; the rest sheds at timeout
+        assert 100 <= st["admitted"] < 200
+        assert st["shed"] == 500 - st["admitted"]
+        mgr.shutdown()
+
+    def test_send_columns_applies_admission(self):
+        mgr = SiddhiManager()
+        rt, got = _app(
+            mgr, "Cols",
+            "@app:admission(policy='shed_oldest', rate.limit='64')",
+        )
+        n = 256
+        ts = np.arange(1, n + 1, dtype=np.int64)
+        rt.get_input_handler("S").send_columns(
+            ts, {"v": np.arange(n, dtype=np.int64)}
+        )
+        st = rt.snapshot_status()["admission"]
+        assert st["admitted"] == 64 and st["shed"] == 192
+        assert got[-1] == (255,), "tail survives under shed_oldest"
+        mgr.shutdown()
+
+    def test_burst_after_idle_refills(self):
+        mgr = SiddhiManager()
+        rt, got = _app(
+            mgr, "Refill",
+            "@app:admission(policy='shed_newest', rate.limit='200')",
+        )
+        h = rt.get_input_handler("S")
+        h.send_many([(i,) for i in range(200)])
+        assert len(got) == 200
+        time.sleep(0.3)  # ~60 tokens refill
+        h.send_many([(i,) for i in range(50)])
+        assert len(got) == 250, "idle time must refill the bucket"
+        mgr.shutdown()
+
+
+class TestTenantIsolation:
+    def test_burster_sheds_while_steady_app_delivers(self):
+        """One manager, two tenants: the burster (tight quota, shed_newest)
+        degrades itself; the steady app receives every event it sent, and
+        the shed counts are metered in /status.json + Prometheus."""
+        mgr = SiddhiManager()
+        burst_rt, burst_got = _app(
+            mgr, "Burster",
+            "@app:admission(policy='shed_newest', rate.limit='500')",
+        )
+        steady_rt, steady_got = _app(mgr, "Steady", "")
+        bh = burst_rt.get_input_handler("S")
+        sh = steady_rt.get_input_handler("S")
+        lat = []
+        for round_ in range(5):
+            bh.send_many([(i,) for i in range(2000)])  # 4x over quota
+            t0 = time.perf_counter()
+            sh.send((round_,))
+            lat.append(time.perf_counter() - t0)
+        assert len(steady_got) == 5, "steady tenant must lose nothing"
+        bst = burst_rt.snapshot_status()["admission"]
+        assert bst["shed"] >= 2000 * 5 - 500 * 5 - 1000  # quota + refill slop
+        assert len(burst_got) == bst["admitted"]
+        # metered: /status.json carries the counts, Prometheus the family
+        assert "admission" in mgr.snapshot_status()["apps"]["Burster"]
+        text = mgr.prometheus_text()
+        assert 'siddhi_admission_shed_total{app="Burster"' in text
+        # steady sends never waited on the burster's gate (no admission on
+        # the steady app, and the burster's shed path does no sleeping)
+        assert max(lat) < 1.0, lat
+        mgr.shutdown()
+
+
+class TestAdmissionAnnotation:
+    def test_requires_a_bound(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime(
+                "@app:admission(policy='block')\n"
+                "define stream S (v long);\n"
+                "from S select v insert into Out;"
+            )
+        mgr.shutdown()
+
+    def test_resolver_full_options(self):
+        class _Ann:
+            elements = [
+                ("policy", "block"), ("rate.limit", "1000.5"),
+                ("max.pending", "64"), ("block.timeout", "2 sec"),
+            ]
+
+            def element(self, k, default=None):
+                for kk, v in self.elements:
+                    if kk == k:
+                        return v
+                return default
+
+        cfg = resolve_admission_annotation(_Ann())
+        assert cfg.policy == "block"
+        assert cfg.rate_eps == 1000.5
+        assert cfg.max_pending == 64
+        assert cfg.block_timeout_ms == 2000
+
+    def test_controller_without_rate_is_pending_only(self):
+        class _J:
+            def queued(self):
+                return 10
+
+        ctl = AdmissionController("x", AdmissionConfig(
+            policy="shed_newest", max_pending=12,
+        ))
+        lo, hi = ctl.admit(8, _J())  # room for 2 of 8
+        assert (lo, hi) == (0, 2)
+        assert ctl.shed == 6
+
+    def test_pending_bound_overflow_refunds_quota_tokens(self):
+        """Tokens drained for events the pending bound then refused must go
+        back to the bucket: a full queue must not quota-starve the sender
+        once the queue frees."""
+        class _J:
+            full = True
+
+            def queued(self):
+                return 10 if self.full else 0
+
+        j = _J()
+        ctl = AdmissionController("x", AdmissionConfig(
+            policy="shed_newest", rate_eps=100.0, max_pending=10,
+        ))
+        lo, hi = ctl.admit(50, j)  # room 0: all shed, 50 tokens refunded
+        assert (lo, hi) == (0, 0) and ctl.shed == 50
+        j.full = False
+        lo, hi = ctl.admit(10, j)  # the refunded quota is still there
+        assert (lo, hi) == (0, 10), "bucket was drained by refused events"
+        assert ctl.admitted == 10
+
+    def test_replay_bypasses_the_admission_gate(self):
+        """Stored entries were admitted once already: replay must not ride
+        the admission gate, or a quota-starved gate silently sheds the
+        replay while the caller purges the entry (permanent loss)."""
+        from siddhi_tpu.core.error_store import ORIGIN_STREAM, make_entry
+
+        mgr = SiddhiManager()
+        rt, got = _app(
+            mgr, "ReplayAdm",
+            "@app:admission(policy='shed_newest', rate.limit='100')",
+        )
+        # drain the whole quota so live traffic holds the bucket at zero
+        rt.get_input_handler("S").send_many([(i,) for i in range(200)])
+        assert rt._admission.shed > 0
+        n_live = len(got)
+        entry = make_entry(
+            "ReplayAdm", ORIGIN_STREAM, "S", RuntimeError("boom"),
+            events=[(1, (777,))],
+        )
+        mgr.error_store.store(entry)
+        assert mgr.replay_errors() == 1
+        assert _wait_for(lambda: (777,) in got[n_live:]), (
+            "replayed entry was shed by the admission gate"
+        )
+        mgr.shutdown()
+
+    def test_stable_handler_survives_restart(self, tmp_path):
+        # admission wiring is annotation-carried: the supervisor's rebuilt
+        # runtime re-applies it, and the restart-stable handler keeps
+        # gating (supervision + admission compose)
+        from siddhi_tpu.core.persistence import FileSystemPersistenceStore
+        from siddhi_tpu.testing import InjectedFault, faults
+
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+        sup = mgr.supervise(poll_interval_s=0.05)
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('AdmSup')
+        @app:admission(policy='shed_newest', rate.limit='100')
+        @app:restart(max.attempts='2')
+        define stream S (v long);
+        @info(name='q')
+        from S select v insert into Out;
+        """)
+        rt.start()
+        h = sup.input_handler("AdmSup", "S")
+        h.send_many([(i,) for i in range(50)])
+        faults.install(faults.parse_plan("junction_dispatch@S:times=1"))
+        try:
+            h.send((99,))
+        except InjectedFault:
+            pass
+        assert _wait_for(lambda: sup.restarts.get("AdmSup", 0) >= 1)
+        faults.uninstall()
+        rt2 = mgr.get_siddhi_app_runtime("AdmSup")
+        assert rt2 is not rt and rt2._admission is not None
+        rt2._admission.admitted = 0
+        h.send_many([(i,) for i in range(500)])
+        assert rt2._admission.shed > 0, "rebuilt app still gates ingress"
+        mgr.shutdown()

@@ -734,3 +734,382 @@ class TestErrorStatistics:
         assert rep["throughput"]["stream.S"] == 2
         rt.shutdown()
         mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# source-side on.error (ingress transports get the same policies)
+# ---------------------------------------------------------------------------
+
+
+def _source_app(on_error, stream_extra="", topic="src-err-topic"):
+    """App with an inMemory JSON source; malformed JSON published to the
+    broker exercises the map-failure path."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(f"""
+    @app:name('SRC_{on_error or "none"}')
+    {stream_extra}
+    @source(type='inMemory', topic='{topic}'
+            {", on.error='" + on_error + "'" if on_error else ""},
+            @map(type='json'))
+    define stream S (v int);
+    @info(name='q')
+    from S select v insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+    rt.start()
+    return mgr, rt, got
+
+
+class TestSourceOnError:
+    def test_default_propagates_to_publisher(self):
+        from siddhi_tpu.core.io import InMemoryBroker
+
+        mgr, rt, got = _source_app(None, topic="t-none")
+        with pytest.raises(Exception):
+            InMemoryBroker.publish("t-none", "{not json")
+        mgr.shutdown()
+
+    def test_log_drops_and_continues(self):
+        from siddhi_tpu.core.io import InMemoryBroker
+
+        mgr, rt, got = _source_app("LOG", topic="t-log")
+        InMemoryBroker.publish("t-log", "{not json")  # dropped, no raise
+        InMemoryBroker.publish("t-log", '{"v": 7}')
+        assert _wait_for(lambda: got)
+        assert got == [(7,)]
+        mgr.shutdown()
+
+    def test_store_spills_payload_and_replay_redelivers(self):
+        from siddhi_tpu.core.error_store import ORIGIN_SOURCE
+        from siddhi_tpu.core.io import InMemoryBroker
+
+        mgr, rt, got = _source_app("STORE", topic="t-store")
+        InMemoryBroker.publish("t-store", "{not json")
+        entries = mgr.error_store.load(origin=ORIGIN_SOURCE)
+        assert len(entries) == 1
+        assert entries[0].payload == "{not json"
+        assert entries[0].stream_id == "S"
+        # replay with the payload still unmappable: the entry re-stores
+        # (zero loss), THEN a fixed mapper path drains it
+        assert mgr.replay_errors() == 1
+        assert len(mgr.error_store.load(origin=ORIGIN_SOURCE)) == 1
+        e = mgr.error_store.load(origin=ORIGIN_SOURCE)[0]
+        e.payload = '{"v": 9}'  # operator fixed the payload
+        mgr.error_store.purge()
+        mgr.error_store.store(e)
+        assert mgr.replay_errors() == 1
+        assert _wait_for(lambda: (9,) in got)
+        assert not mgr.error_store.load()
+        mgr.shutdown()
+
+    def test_stream_routes_mapped_rows_to_fault_stream(self):
+        from siddhi_tpu.core.io import InMemoryBroker
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('SRC_STREAM')
+        @OnError(action='STREAM')
+        @source(type='inMemory', topic='t-fs', on.error='STREAM',
+                @map(type='json'))
+        define stream S (v int);
+        @info(name='qf')
+        from !S select v, _error insert into F;
+        """)
+        fgot = []
+        rt.add_callback("F", lambda evs: fgot.extend(e.data for e in evs))
+        rt.start()
+        # mapped rows whose delivery fails: poison the junction so
+        # send_many raises AFTER mapping succeeded
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 13))
+        InMemoryBroker.publish("t-fs", '{"v": 13}')
+        assert _wait_for(lambda: fgot)
+        assert fgot[0][0] == 13 and "poison" in fgot[0][1].lower()
+        mgr.shutdown()
+
+    def test_stream_policy_requires_fault_stream(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime("""
+            @source(type='inMemory', topic='t-bad', on.error='STREAM',
+                    @map(type='json'))
+            define stream S (v int);
+            from S select v insert into Out;
+            """)
+        mgr.shutdown()
+
+    def test_invalid_on_error_rejected(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime("""
+            @source(type='inMemory', topic='t-bad2', on.error='PANIC',
+                    @map(type='json'))
+            define stream S (v int);
+            from S select v insert into Out;
+            """)
+        mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# @OnError on named windows and tables
+# ---------------------------------------------------------------------------
+
+
+class TestWindowOnError:
+    def _window_app(self, action):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(f"""
+        @app:name('WOE_{action}')
+        define stream S (v int);
+        @OnError(action='{action}')
+        define window W (v int) length(3);
+        from S select v insert into W;
+        """)
+        rt.start()
+        return mgr, rt
+
+    def test_store_captures_window_mutation_failure(self):
+        mgr, rt = self._window_app("STORE")
+        rt.junctions["W"].subscribe(_poison_subscriber("v", 5))
+        h = rt.get_input_handler("S")
+        h.send((1,))  # healthy
+        h.send((5,))  # poison: the window junction's STORE policy owns it
+        entries = mgr.error_store.load(stream_id="W")
+        assert len(entries) == 1
+        assert entries[0].events[0][1] == (5,)
+        h.send((2,))  # the app keeps processing
+        mgr.shutdown()
+
+    def test_stream_routes_to_window_fault_stream(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('WOE_STREAM')
+        define stream S (v int);
+        @OnError(action='STREAM')
+        define window W (v int) length(3);
+        from S select v insert into W;
+        @info(name='qf')
+        from !W select v, _error insert into WF;
+        """)
+        fgot = []
+        rt.add_callback("WF", lambda evs: fgot.extend(e.data for e in evs))
+        rt.start()
+        rt.junctions["W"].subscribe(_poison_subscriber("v", 5))
+        rt.get_input_handler("S").send((5,))
+        assert _wait_for(lambda: fgot)
+        assert fgot[0][0] == 5
+        mgr.shutdown()
+
+    def test_no_policy_propagates(self):
+        mgr, rt = self._window_app("LOG")
+        # LOG: swallowed. Now check a policy-free window propagates.
+        mgr2 = SiddhiManager()
+        rt2 = mgr2.create_siddhi_app_runtime("""
+        define stream S (v int);
+        define window W2 (v int) length(3);
+        from S select v insert into W2;
+        """)
+        rt2.start()
+        rt2.junctions["W2"].subscribe(_poison_subscriber("v", 5))
+        with pytest.raises(Exception):
+            rt2.get_input_handler("S").send((5,))
+        mgr.shutdown()
+        mgr2.shutdown()
+
+    def test_reserved_error_attribute_rejected(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime("""
+            define stream S (v int, _error string);
+            @OnError(action='STREAM')
+            define window W (v int, _error string) length(3);
+            from S select v, _error insert into W;
+            """)
+        mgr.shutdown()
+
+
+class TestTableOnError:
+    def test_store_captures_mutating_query_failure(self):
+        from siddhi_tpu.core.error_store import ORIGIN_TABLE
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('TOE')
+        define stream S (v int);
+        @OnError(action='STORE')
+        define table T (v int);
+        from S select v insert into T;
+        """)
+        rt.start()
+        qr = next(iter(rt.queries.values()))
+        orig = qr.receive
+        calls = []
+
+        def exploding(batch, now, *a, **kw):
+            calls.append(1)
+            raise RuntimeError("table mutation exploded")
+
+        qr.receive = exploding
+        try:
+            rt.get_input_handler("S").send((3,))  # must NOT propagate
+        finally:
+            qr.receive = orig
+        assert calls
+        entries = mgr.error_store.load(origin=ORIGIN_TABLE)
+        assert len(entries) == 1
+        assert entries[0].stream_id == "T"
+        assert entries[0].sink_ref == "S"  # replay re-drives through S
+        # replay re-runs the (now healthy) mutating query
+        assert mgr.replay_errors() == 1
+        rows = rt.query("from T select v")
+        assert [e.data for e in rows] == [(3,)]
+        mgr.shutdown()
+
+    def test_stream_action_rejected_for_tables(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime("""
+            define stream S (v int);
+            @OnError(action='STREAM')
+            define table T (v int);
+            from S select v insert into T;
+            """)
+        mgr.shutdown()
+
+    def test_record_store_flush_failure_owned(self):
+        from siddhi_tpu.core.record_table import RECORD_STORES, RecordStore
+
+        flushes = []
+
+        class _FlakyStore(RecordStore):
+            def init(self, table_id, schema, options):
+                self.fail = False
+
+            def load(self):
+                return []
+
+            def on_change(self, rows):
+                flushes.append(len(rows))
+                if self.fail:
+                    raise IOError("store down")
+
+        RECORD_STORES["flakyrec"] = _FlakyStore
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime("""
+            @app:name('TOF')
+            define stream S (v int);
+            @OnError(action='LOG')
+            @store(type='flakyrec')
+            define table T (v int);
+            from S select v insert into T;
+            """)
+            rt.start()
+            t = rt.tables["T"]
+            store_impl = t.record_store
+            store_impl.fail = True
+            rt.get_input_handler("S").send((1,))  # flush fails, owned
+            assert t._dirty, "failed flush keeps the table dirty"
+            store_impl.fail = False
+            t.flush_record_store()  # retry succeeds
+            assert not t._dirty
+            mgr.shutdown()
+        finally:
+            del RECORD_STORES["flakyrec"]
+
+
+# ---------------------------------------------------------------------------
+# SqliteErrorStore (DB-backed SPI)
+# ---------------------------------------------------------------------------
+
+
+class TestSqliteErrorStore:
+    def _entry(self, app="DB", v=1):
+        from siddhi_tpu.core.error_store import ORIGIN_STREAM, make_entry
+
+        return make_entry(app, ORIGIN_STREAM, "S", "boom", events=[(v, (v,))])
+
+    def test_store_load_purge_roundtrip(self, tmp_path):
+        from siddhi_tpu import SqliteErrorStore
+
+        store = SqliteErrorStore(str(tmp_path / "err.db"))
+        for v in range(3):
+            store.store(self._entry(v=v))
+        assert store.size() == 3
+        loaded = store.load(app_name="DB")
+        assert [e.events[0][1] for e in loaded] == [(0,), (1,), (2,)]
+        assert loaded[0].events[0] == (0, (0,))  # tuples re-tupled
+        assert store.purge([loaded[0].id]) == 1
+        assert store.size() == 2
+        assert store.purge() == 2
+        assert store.size() == 0
+        store.close()
+
+    def test_ids_unique_across_restarts(self, tmp_path):
+        from siddhi_tpu import SqliteErrorStore
+
+        path = str(tmp_path / "err.db")
+        s1 = SqliteErrorStore(path)
+        s1.store(self._entry(v=1))
+        s1.store(self._entry(v=2))
+        ids1 = {e.id for e in s1.load()}
+        s1.purge()  # empty the table, then restart
+        s1.close()
+        s2 = SqliteErrorStore(path)
+        s2.store(self._entry(v=3))
+        ids2 = {e.id for e in s2.load()}
+        assert not ids1 & ids2, "AUTOINCREMENT must never reuse ids"
+        s2.close()
+
+    def test_capacity_evicts_oldest(self, tmp_path):
+        from siddhi_tpu import SqliteErrorStore
+
+        store = SqliteErrorStore(str(tmp_path / "err.db"), capacity=3)
+        for v in range(5):
+            store.store(self._entry(v=v))
+        assert store.size() == 3 and store.dropped == 2
+        assert [e.events[0][1] for e in store.load()] == [(2,), (3,), (4,)]
+        st = store.describe_state()
+        assert st["depth"] == 3 and st["by_app"] == {"DB": 3}
+        store.close()
+
+    def test_rides_manager_replay(self, tmp_path):
+        from siddhi_tpu import SqliteErrorStore
+
+        mgr = SiddhiManager()
+        mgr.set_error_store(SqliteErrorStore(str(tmp_path / "err.db")))
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:name('DBApp')
+        @OnError(action='STORE')
+        define stream S (v int);
+        @info(name='q')
+        from S select v insert into Out;
+        """)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(e.data for e in evs))
+        rt.junctions["S"].subscribe(_poison_subscriber("v", 5))
+        rt.start()
+        rt.get_input_handler("S").send((5,))
+        assert mgr.error_store.size() == 1
+        # un-poison (times out naturally: the poison fires on v==5 forever;
+        # replace subscriber list minus the poison instead)
+        j = rt.junctions["S"]
+        idx = len(j.subscribers) - 1
+        j.subscribers.pop(idx)
+        j.subscriber_names.pop(idx)
+        assert mgr.replay_errors() == 1
+        assert (5,) in got
+        assert mgr.error_store.size() == 0
+        mgr.shutdown()
+
+    def test_non_json_payload_stringified(self, tmp_path):
+        from siddhi_tpu import SqliteErrorStore
+        from siddhi_tpu.core.error_store import ORIGIN_SINK, make_entry
+
+        store = SqliteErrorStore(str(tmp_path / "err.db"))
+        store.store(make_entry(
+            "DB", ORIGIN_SINK, "Out", "boom", payload=object(),
+        ))
+        e = store.load()[0]
+        assert "object" in e.payload
+        store.close()
