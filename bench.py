@@ -641,6 +641,123 @@ def _fusedgroup_budget_modes(batch: int) -> dict:
     return out
 
 
+# stateless multi-query app for the sharded-execution leg: both consumers
+# are batch-axis shardable (parallel/shard.py router_eligible), so the whole
+# junction round-robins micro-batches across the mesh. Checksums are integer
+# sums over delivered rows — exact, so sharded == unsharded is a hard assert.
+SHARD_WORKLOADS = {
+    "shard_filter": """
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q')
+        from StockStream[price > 50] select symbol, volume insert into Out;
+        """,
+    "shard_project": """
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q')
+        from StockStream select symbol, volume * 2 as v2, volume % 7 as v7
+        insert into Out;
+        """,
+}
+
+
+def _leg_shard(n_shard: int, batch=4096, events=1_000_000) -> dict:
+    """Sharded-vs-unsharded A/B of the batch-axis router (`--shard N`,
+    meant to run under XLA_FLAGS=--xla_force_host_platform_device_count=N
+    on CPU): for each stateless workload, the same columnar feed runs once
+    with SIDDHI_TPU_SHARD=N and once unsharded; the leg reports per-device
+    dispatch/event counts (their sum must equal the unsharded event count),
+    an exact delivered-row checksum on both sides, per-workload scaling,
+    and the geomean scaling vs 1 device."""
+    import jax
+
+    from siddhi_tpu import SiddhiManager
+
+    out: dict = {
+        "shard_devices_requested": n_shard,
+        "shard_devices_visible": len(jax.devices()),
+        "shard_batch": batch,
+    }
+    data = _make_stock_data(events)
+    cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
+    scalings = []
+    for name, ql in SHARD_WORKLOADS.items():
+        ql = f"@app:batch(size='{batch}')\n" + ql
+        res = {}
+        for mode, env_val in (("unsharded", "0"), ("sharded", str(n_shard))):
+            saved = os.environ.get("SIDDHI_TPU_SHARD")
+            os.environ["SIDDHI_TPU_SHARD"] = env_val
+            try:
+                mgr = SiddhiManager()
+                rt = mgr.create_siddhi_app_runtime(ql)
+            finally:
+                if saved is None:
+                    os.environ.pop("SIDDHI_TPU_SHARD", None)
+                else:
+                    os.environ["SIDDHI_TPU_SHARD"] = saved
+            _prime_interner(mgr, data["names"])
+            sink = [0, 0]  # rows, integer checksum
+
+            def cb(ts, ins, removed, _s=sink):
+                for e in ins or ():
+                    _s[0] += 1
+                    _s[1] += int(e.data[-1])
+            rt.add_callback("q", cb)
+            rt.start()
+            h = rt.get_input_handler("StockStream")
+            warm = batch * 8
+            h.send_columns(
+                data["ts"][:warm], {k: v[:warm] for k, v in cols.items()}
+            )
+            _truth_sync(rt)
+            sink[0] = sink[1] = 0
+            t0 = time.perf_counter()
+            h.send_columns(data["ts"], cols)
+            _truth_sync(rt)
+            dt = time.perf_counter() - t0
+            res[mode] = {
+                "mev_s": round(events / dt / 1e6, 3),
+                "rows": sink[0],
+                "checksum": sink[1],
+            }
+            if mode == "sharded":
+                fi = rt.junctions["StockStream"].fused_ingest
+                sr = getattr(fi, "shard_router", None) if fi else None
+                if sr is not None:
+                    res["per_device_dispatches"] = list(sr.dispatches)
+                    res["per_device_events"] = list(sr.events)
+            rt.shutdown()
+            mgr.shutdown()
+        out[f"{name}_unsharded_mev_s"] = res["unsharded"]["mev_s"]
+        out[f"{name}_sharded_mev_s"] = res["sharded"]["mev_s"]
+        out[f"{name}_scaling"] = round(
+            res["sharded"]["mev_s"] / res["unsharded"]["mev_s"], 3
+        )
+        scalings.append(out[f"{name}_scaling"])
+        out[f"{name}_per_device_dispatches"] = res.get(
+            "per_device_dispatches", []
+        )
+        out[f"{name}_per_device_events"] = res.get("per_device_events", [])
+        # warmup events ride the router too, so compare the TIMED window
+        # via delivered rows + checksum, and the full per-device event sum
+        # against everything sent (warm + timed)
+        out[f"{name}_per_device_events_sum"] = int(
+            sum(res.get("per_device_events", []))
+        )
+        out[f"{name}_events_sent_total"] = events + batch * 8
+        out[f"{name}_rows_match"] = (
+            res["sharded"]["rows"] == res["unsharded"]["rows"]
+        )
+        out[f"{name}_checksum_match"] = (
+            res["sharded"]["checksum"] == res["unsharded"]["checksum"]
+        )
+        out[f"{name}_checksum"] = res["sharded"]["checksum"]
+    out["shard_scaling_geomean"] = round(
+        math.exp(sum(math.log(max(s, 1e-9)) for s in scalings) / len(scalings)),
+        3,
+    ) if scalings else 0.0
+    return out
+
+
 VERIFY_HEAD = (
     "@app:batch(size='32')\n"
     "define stream S (symbol string, price float, volume long);\n"
@@ -883,6 +1000,17 @@ def _run_leg(name: str, args) -> dict:
         return _leg_verify()
     if name == "verify":
         return _verify_tpu_vs_cpu(args)
+    if name == "shard":
+        if not args.shard:
+            return {"shard_error": "pass --shard N (e.g. --shard 8 under "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=8)"}
+        # honor --batch like every other leg, but keep this leg's own
+        # default: at the driver-wide 32768 a 200k-event feed is fewer
+        # micro-batches than devices and the router can't even engage
+        batch = args.batch if args.batch != 32768 else 4096
+        return _leg_shard(
+            args.shard, batch=batch, events=min(args.events, 1_000_000)
+        )
     raise SystemExit(f"unknown leg {name!r}")
 
 
@@ -893,6 +1021,12 @@ def main():
     # fitting the full suite back under the harness budget (ROADMAP item)
     ap.add_argument("--events", type=int, default=1_000_000)
     ap.add_argument("--batch", type=int, default=32768)
+    ap.add_argument(
+        "--shard", type=int, default=0,
+        help="device count for the sharded-execution leg (`--leg shard`); "
+        "also appends the leg to a full run. Run under XLA_FLAGS="
+        "--xla_force_host_platform_device_count=N for a virtual CPU mesh",
+    )
     ap.add_argument("--leg", help="run ONE leg in-process and print its JSON")
     ap.add_argument(
         "--deadline", type=float,
@@ -1013,6 +1147,8 @@ def main():
         "filter_window_avg_delivered", "pattern_2state_delivered",
         "tumbling_groupby_delivered", "p99", "tables", "timebudget", "verify",
     ]
+    if args.shard:
+        legs.append("shard")
     try:
         for leg in legs:
             current_leg[0] = leg
@@ -1034,6 +1170,8 @@ def main():
                 leg_timeout = min(leg_timeout, remaining - 30)
             cmd = [sys.executable, os.path.abspath(__file__), "--leg", leg,
                    "--events", str(args.events), "--batch", str(args.batch)]
+            if args.shard:
+                cmd += ["--shard", str(args.shard)]
             env = dict(os.environ)
             env["SIDDHI_TPU_AUX_DRAIN_S"] = "0"
             env.setdefault(

@@ -62,6 +62,8 @@ CODES: dict[str, str] = {
              "max.attempts / bad backoff)",
     "SA128": "invalid @app:admission annotation (unknown policy / bad "
              "rate.limit or max.pending / no bound declared)",
+    "SA129": "invalid @app:shard annotation (devices out of range / "
+             "unknown axis / unknown option)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

@@ -122,6 +122,21 @@ def _check_fuse_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
         diags.append(Diagnostic("SA125", problem))
 
 
+def _check_shard_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
+    """Validate `@app:shard(devices='N', axis='part|batch|auto')` — the
+    first-class sharded-execution mode. One SA129 per malformed element,
+    using the SAME rule set the runtime resolver raises on
+    (parallel/shard.py iter_shard_annotation_problems), so the two can
+    never drift."""
+    ann = find_annotation(app.annotations, "app:shard")
+    if ann is None:
+        return
+    from siddhi_tpu.parallel.shard import iter_shard_annotation_problems
+
+    for problem in iter_shard_annotation_problems(ann):
+        diags.append(Diagnostic("SA129", problem))
+
+
 def _check_supervision_annotations(
     app: SiddhiApp, diags: list[Diagnostic]
 ) -> None:
@@ -268,6 +283,7 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
 
     _apply_selfmon_annotation(app, sym, diags)
     _check_fuse_annotation(app, diags)
+    _check_shard_annotation(app, diags)
     _check_supervision_annotations(app, diags)
 
     return sym

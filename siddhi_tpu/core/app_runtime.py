@@ -141,6 +141,16 @@ class SiddhiAppRuntime:
         self._fuse_enabled = resolve_fuse_annotation(
             find_annotation(app.annotations, "app:fuse")
         )
+        # first-class sharded execution: @app:shard(devices='N', axis=...)
+        # / SIDDHI_TPU_SHARD (parallel/shard.py; malformed options raise
+        # here — the runtime analog of the analyzer's SA129). Resolved now,
+        # applied at start() once the fused engines exist.
+        from siddhi_tpu.parallel.shard import resolve_shard_annotation
+
+        self._shard_conf = resolve_shard_annotation(
+            find_annotation(app.annotations, "app:shard")
+        )
+        self._shard = None  # ShardRuntime, built at start()
         # one app-level processing lock: receive+route for every query runs
         # under it, so cyclic stream topologies cannot lock-order deadlock and
         # timer/input threads deliver outputs in state-step order (analog of
@@ -1325,6 +1335,10 @@ class SiddhiAppRuntime:
                 groups.append({"stream": j.schema.stream_id, **gr})
         if groups:
             rep["fused_groups"] = groups
+        if self._shard is not None:
+            # per-device dispatch/event counts of the sharded runtime mode
+            # (parallel/shard.py), beside the fused-group ledger
+            rep["shard"] = self._shard.describe_state()
         return rep
 
     # ---- state introspection (observability/introspect.py) ----------------
@@ -1361,6 +1375,8 @@ class SiddhiAppRuntime:
                 for aid, ar in self.aggregations.items()
             },
         }
+        if self._shard is not None:
+            status["shard"] = self._shard.describe_state()
         if self._selfmon is not None:
             status["selfmon"] = self._selfmon.describe_state()
         if self._admission is not None:
@@ -1538,6 +1554,16 @@ class SiddhiAppRuntime:
                     self, j, j.fuse_candidates, chunk_batches=chunk,
                     pipeline_enabled=pipe_on, pipeline_depth=pipe_depth,
                 )
+        # first-class sharded execution (parallel/shard.py): place
+        # partitioned [P] state on the device mesh and arm batch-axis
+        # routers on junctions whose fused endpoints are all stateless —
+        # resolved from @app:shard / SIDDHI_TPU_SHARD at creation
+        shard_devices, shard_axis = self._shard_conf
+        if shard_devices >= 2:
+            from siddhi_tpu.parallel.shard import ShardRuntime
+
+            self._shard = ShardRuntime(self, shard_devices, shard_axis)
+            self._shard.apply()
         if self.statistics_manager is not None:
             # device-memory metric per component (reference analog:
             # util/statistics/memory/ObjectSizeCalculator — here the bytes

@@ -172,6 +172,11 @@ class FusedJunctionIngest:
         # invalidates BOTH programs, and each must attribute its own
         # rebuild compile (tail-variant hints are computed per call)
         self._cause_hints: dict = {}
+        # batch-axis sharded execution (parallel/shard.py): armed by the
+        # app's ShardRuntime ONLY when every endpoint is provably stateless
+        # — micro-batches round-robin across devices, outputs merged back
+        # in batch order. None = one attribute check per send.
+        self.shard_router = None
         ps = getattr(junction, "pipeline_stats", None)
         if ps is not None:
             ps.depth = self.pipeline_depth if self.pipeline_enabled else 0
@@ -189,6 +194,8 @@ class FusedJunctionIngest:
         gr = self.group_report()
         if gr is not None:
             d["fusedgroup"] = gr
+        if self.shard_router is not None:
+            d["shard"] = self.shard_router.describe_state()
         ps = getattr(self.junction, "pipeline_stats", None)
         if ps is not None:
             d["occupancy"] = round(ps.occupancy(), 3)
@@ -591,6 +598,19 @@ class FusedJunctionIngest:
         ]
         tr = self.junction.tracer
         stream_span = f"stream.{self.junction.schema.stream_id}"
+
+        # batch-axis sharded execution (parallel/shard.py): round-robin the
+        # call's micro-batches across devices and merge outputs in batch
+        # order. None = not sharded; a None RESULT = the router declined
+        # (too few batches / narrow-wire misfit) and the single-device
+        # paths below own the call.
+        if self.shard_router is not None:
+            sent = self.shard_router.try_send(
+                self, prog, encode, deliver, ts_arr, cols, n, B, now,
+                ds, tracked, tr, stream_span,
+            )
+            if sent is not None:
+                return record_flight(sent)
 
         if self.pipeline_enabled:
             pl = self._pipeline()
@@ -1173,13 +1193,6 @@ class FusedJunctionIngest:
         callback wall), then closes the chunk's record."""
         import jax
 
-        from siddhi_tpu.core.event import (
-            KIND_CURRENT,
-            KIND_EXPIRED,
-            rows_from_arrays,
-        )
-        from siddhi_tpu.query_api.execution import OutputEventsFor
-
         if not hasattr(self, "_drain_guess"):
             self._drain_guess = {}
         ds = self.junction.device_stats
@@ -1258,70 +1271,7 @@ class FusedJunctionIngest:
                         first_get = False
                         wf_get_ns += dt
                 host = np.concatenate([head[hdr_rows:], tail])
-            lanes = {}
-            for name, dt, off in layout:
-                lanes[name] = np.ascontiguousarray(
-                    host[:total, off : off + dt.itemsize]
-                ).view(dt)[:, 0]
-            want = qr.output_events
-            cols = {n: lanes[f"c.{n}"] for n in qr.out_schema.attr_names}
-            raw = getattr(qr, "raw_query_callbacks", None)
-            if want is not OutputEventsFor.ALL and raw is not None and len(
-                raw
-            ) == len(qr.query_callbacks):
-                # single-kind fast path: decode straight to Event lists and
-                # invoke the USER callbacks (skips the triple intermediate)
-                from siddhi_tpu.core.event import events_from_arrays
-
-                events = events_from_arrays(
-                    qr.out_schema, lanes["ts"], cols, total, qr._interner
-                )
-                expired = want is OutputEventsFor.EXPIRED
-                off = 0
-                for k in range(len(cnts)):
-                    c = int(cnts[k])
-                    if c == 0:
-                        continue
-                    seg = events[off : off + c]
-                    off += c
-                    ts = seg[-1][0]
-                    for cb in raw:
-                        if expired:
-                            cb(ts, None, seg)
-                        else:
-                            cb(ts, seg, None)
-                continue
-            kind = (
-                lanes["kind"]
-                if want is OutputEventsFor.ALL
-                else int(
-                    KIND_CURRENT
-                    if want is not OutputEventsFor.EXPIRED
-                    else KIND_EXPIRED
-                )
-            )
-            rows = rows_from_arrays(
-                qr.out_schema, lanes["ts"], kind, cols, total, qr._interner
-            )
-            split = want is OutputEventsFor.ALL
-            off = 0
-            for k in range(len(cnts)):
-                c = int(cnts[k])
-                if c == 0:
-                    continue
-                seg = rows[off : off + c]
-                off += c
-                if split:
-                    ins = [e for e in seg if e[1] == KIND_CURRENT]
-                    removed = [e for e in seg if e[1] == KIND_EXPIRED]
-                elif want is OutputEventsFor.EXPIRED:
-                    ins, removed = [], seg
-                else:
-                    ins, removed = seg, []
-                if ins or removed:
-                    ts = seg[-1][0]
-                    for cb in qr.query_callbacks:
-                        cb(ts, ins or None, removed or None)
+            self.deliver_endpoint(i, host, cnts, total)
         if wf is not None:
             # deliver = the drain wall minus the blocking readbacks
             wf.stage(
@@ -1331,6 +1281,89 @@ class FusedJunctionIngest:
             prof = self.junction.profiler
             if prof is not None:
                 prof.end(wf)
+
+    def deliver_endpoint(self, i: int, host, cnts, total: int) -> None:
+        """Decode endpoint `i`'s packed output rows and fire its callbacks
+        per micro-batch segment. `host` is the header-stripped byte buffer
+        (rows at the front, `row_bytes` wide per `_deliver_layout[i]`),
+        `cnts` the deliverable-row count per micro-batch IN DELIVERY ORDER,
+        `total` their sum. Shared by `_drain` (one chunk's buffer) and the
+        batch shard router's merged drain (segments interleaved back into
+        global batch order, parallel/shard.py) — one delivery code path, so
+        callback grouping/ordering semantics cannot drift between them."""
+        from siddhi_tpu.core.event import (
+            KIND_CURRENT,
+            KIND_EXPIRED,
+            rows_from_arrays,
+        )
+        from siddhi_tpu.query_api.execution import OutputEventsFor
+
+        qr = self.endpoints[i].qr
+        layout, _row_bytes = self._deliver_layout[i]
+        lanes = {}
+        for name, dt, off in layout:
+            lanes[name] = np.ascontiguousarray(
+                host[:total, off : off + dt.itemsize]
+            ).view(dt)[:, 0]
+        want = qr.output_events
+        cols = {n: lanes[f"c.{n}"] for n in qr.out_schema.attr_names}
+        raw = getattr(qr, "raw_query_callbacks", None)
+        if want is not OutputEventsFor.ALL and raw is not None and len(
+            raw
+        ) == len(qr.query_callbacks):
+            # single-kind fast path: decode straight to Event lists and
+            # invoke the USER callbacks (skips the triple intermediate)
+            from siddhi_tpu.core.event import events_from_arrays
+
+            events = events_from_arrays(
+                qr.out_schema, lanes["ts"], cols, total, qr._interner
+            )
+            expired = want is OutputEventsFor.EXPIRED
+            off = 0
+            for k in range(len(cnts)):
+                c = int(cnts[k])
+                if c == 0:
+                    continue
+                seg = events[off : off + c]
+                off += c
+                ts = seg[-1][0]
+                for cb in raw:
+                    if expired:
+                        cb(ts, None, seg)
+                    else:
+                        cb(ts, seg, None)
+            return
+        kind = (
+            lanes["kind"]
+            if want is OutputEventsFor.ALL
+            else int(
+                KIND_CURRENT
+                if want is not OutputEventsFor.EXPIRED
+                else KIND_EXPIRED
+            )
+        )
+        rows = rows_from_arrays(
+            qr.out_schema, lanes["ts"], kind, cols, total, qr._interner
+        )
+        split = want is OutputEventsFor.ALL
+        off = 0
+        for k in range(len(cnts)):
+            c = int(cnts[k])
+            if c == 0:
+                continue
+            seg = rows[off : off + c]
+            off += c
+            if split:
+                ins = [e for e in seg if e[1] == KIND_CURRENT]
+                removed = [e for e in seg if e[1] == KIND_EXPIRED]
+            elif want is OutputEventsFor.EXPIRED:
+                ins, removed = [], seg
+            else:
+                ins, removed = seg, []
+            if ins or removed:
+                ts = seg[-1][0]
+                for cb in qr.query_callbacks:
+                    cb(ts, ins or None, removed or None)
 
     def _probe_aux_keys(self, i: int) -> list:
         """Sorted non-timer aux keys for endpoint i, discovered by tracing

@@ -230,6 +230,11 @@ def build_plan(app, runtime=None) -> dict:
                         gr = fi.group_report()
                         if gr is not None:
                             counters["fusedgroup"] = gr
+                        # batch-axis sharded execution (parallel/shard.py):
+                        # per-device dispatch/event counts on the stream node
+                        sr = getattr(fi, "shard_router", None)
+                        if sr is not None:
+                            counters["shard"] = sr.describe_state()
                 except Exception:
                     pass
         if ct is not None:
@@ -327,9 +332,16 @@ def _query_counters(
     flow, runtime, sm, ct, total_dev_ns, stream_events
 ) -> dict:
     counters: dict = {}
+    qid = flow.qid
+    # partition-axis mesh placement (parallel/shard.py): rendered even with
+    # statistics off — placement is topology, not a counter
+    shard_rt = getattr(runtime, "_shard", None) if runtime is not None else None
+    if shard_rt is not None:
+        pl = shard_rt.partitioned.get(qid)
+        if pl is not None:
+            counters["shard"] = pl
     if sm is None:
         return counters
-    qid = flow.qid
     lt = sm.latency.get(f"query.{qid}")
     if lt is not None and lt.samples:
         counters["dispatches"] = lt.samples
@@ -403,6 +415,21 @@ def _fmt_counters(c: Optional[dict]) -> str:
                 if g.get("residual") else ""
             )
         )
+    if "shard" in c:
+        s = c["shard"]
+        if "per_device_dispatches" in s:  # stream node: batch router counts
+            parts.append(
+                f"shard[devices={s.get('devices')}] "
+                f"dispatches={s.get('per_device_dispatches')} "
+                f"events={s.get('per_device_events')}"
+            )
+        elif s.get("sharded"):  # query node: partition-axis mesh placement
+            parts.append(
+                f"shard[devices={s.get('devices')} axis={s.get('axis')} "
+                f"local_slots={s.get('local_slots')}]"
+            )
+        else:
+            parts.append(f"shard[off: {s.get('reason')}]")
     if "compile" in c:
         comp = c["compile"]
         causes = ",".join(

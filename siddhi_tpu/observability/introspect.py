@@ -77,7 +77,7 @@ def render_status(status: dict) -> str:
             lines.append(f"  {section}:")
             for cid, d in comps.items():
                 _render_component(lines, cid, d, "    ")
-        for extra in ("selfmon", "admission", "autopersist", "health"):
+        for extra in ("shard", "selfmon", "admission", "autopersist", "health"):
             d = app.get(extra)
             if d:
                 _render_component(lines, extra, d, "  ")

@@ -118,6 +118,10 @@ class StatisticsManager:
         # pipelined fused ingest: component -> PipelineStats (stage
         # histograms ride device_time; occupancy/depth are gauges here)
         self.pipeline: dict[str, PipelineStats] = {}
+        # sharded execution (parallel/shard.py): component -> router-like
+        # object with describe_state() -> per-device dispatch/event counts
+        # + occupancy; rendered as the siddhi_shard_* Prometheus families
+        self.shard: dict[str, object] = {}
         # continuous profiler: compile telemetry + per-chunk stage
         # waterfalls (observability/profiler.py), gated by this registry
         from siddhi_tpu.observability.profiler import (
@@ -192,6 +196,12 @@ class StatisticsManager:
             p = self.pipeline[component] = PipelineStats(self, component)
         return p
 
+    def register_shard(self, component: str, router) -> None:
+        """Attach a shard router (parallel/shard.py BatchShardRouter) whose
+        describe_state() feeds the report's `shard` section and the
+        siddhi_shard_* Prometheus families."""
+        self.shard[component] = router
+
     # ---- reporting ---------------------------------------------------------
 
     def report(self) -> dict:
@@ -254,6 +264,9 @@ class StatisticsManager:
             "pipeline": {
                 n: {"occupancy": round(p.occupancy(), 3), "depth": p.depth}
                 for n, p in pipeline
+            },
+            "shard": {
+                n: r.describe_state() for n, r in list(self.shard.items())
             },
             "traces_sampled": (
                 self.tracer.sampled_count if self.tracer is not None else 0

@@ -135,6 +135,17 @@ _FAMILIES = {
         "gauge",
         "Configured max in-flight chunks of the pipelined fused ingest "
         "(0 = pipeline disabled)"),
+    "siddhi_shard_device_dispatches_total": (
+        "counter",
+        "Fused chunk dispatches per mesh device of a batch-sharded "
+        "junction (parallel/shard.py; device label: mesh position)"),
+    "siddhi_shard_device_events_total": (
+        "counter",
+        "Events routed to each mesh device of a batch-sharded junction"),
+    "siddhi_shard_device_occupancy": (
+        "gauge",
+        "Per-device share of a batch-sharded junction's events, "
+        "normalized so 1.0 = a perfectly even split across the mesh"),
     "siddhi_traces_sampled_total": ("counter", "Traces sampled per app"),
 }
 
@@ -200,6 +211,24 @@ def render_prometheus(reports: list[dict]) -> str:
                     f"{fam}{_labels(app=app, component=ent['component'])}"
                     f" {ent['count']}"
                 )
+        for n, ent in rep.get("shard", {}).items():
+            occ = ent.get("occupancy", [])
+            for d, v in enumerate(ent.get("per_device_dispatches", [])):
+                body["siddhi_shard_device_dispatches_total"].append(
+                    "siddhi_shard_device_dispatches_total"
+                    f"{_labels(app=app, component=n, device=str(d))} {v}"
+                )
+            for d, v in enumerate(ent.get("per_device_events", [])):
+                body["siddhi_shard_device_events_total"].append(
+                    "siddhi_shard_device_events_total"
+                    f"{_labels(app=app, component=n, device=str(d))} {v}"
+                )
+                if d < len(occ):
+                    body["siddhi_shard_device_occupancy"].append(
+                        "siddhi_shard_device_occupancy"
+                        f"{_labels(app=app, component=n, device=str(d))}"
+                        f" {occ[d]}"
+                    )
         for n, ent in rep.get("pipeline", {}).items():
             body["siddhi_pipeline_occupancy"].append(
                 f"siddhi_pipeline_occupancy{_labels(app=app, component=n)}"

@@ -28,6 +28,34 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def import_shard_map():
+    """Version-tolerant `shard_map` import: newer jax exports it at the top
+    level (`jax.shard_map`), older releases keep it under
+    `jax.experimental.shard_map`. The seed carried an ImportError here for
+    releases without the top-level export."""
+    try:
+        from jax import shard_map as sm  # jax >= 0.6-ish
+    except ImportError:  # pragma: no cover - depends on installed jax
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """`shard_map` with replication checking disabled, tolerant of the
+    `check_rep` (old) -> `check_vma` (new) kwarg rename."""
+    sm = import_shard_map()
+    try:
+        return sm(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return sm(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
 @dataclasses.dataclass
 class ShardedPartitionedQuery:
     """A partitioned query whose [P] state axis lives across a device mesh."""
@@ -53,18 +81,16 @@ class ShardedPartitionedQuery:
     def total_emitted(self, outs) -> int:
         """psum the per-shard emission counts across the mesh (an explicit
         ICI collective, mostly useful for validation/monitoring)."""
-        from functools import partial
-
-        from jax import lax, shard_map
+        from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        @partial(
-            shard_map, mesh=self.mesh, in_specs=P(self.axis), out_specs=P(None)
-        )
         def count(valid):
             return lax.psum(valid.sum()[None], self.axis)
 
-        return int(count(outs.valid)[0])
+        counted = shard_map_unchecked(
+            count, self.mesh, P(self.axis), P(None)
+        )
+        return int(counted(outs.valid)[0])
 
 
 def shard_partitioned_query(
@@ -132,9 +158,7 @@ def shard_partitioned_query(
 
 def _make_routed_step(qr, mesh, axis: str, n_dev: int):
     """Build the routed sharded step (see shard_partitioned_query)."""
-    from functools import partial
-
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     from siddhi_tpu.core.event import (
@@ -198,15 +222,6 @@ def _make_routed_step(qr, mesh, axis: str, n_dev: int):
         r_slot = lane(jnp.where(active, slot, qr.p), fill=qr.p)
 
         # ---- per-device local advance over its own sub-batch
-        @partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(
-                P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(),
-            ),
-            out_specs=(P(axis), P(axis), P()),
-            check_vma=False,
-        )
         def local(states_sl, ts_sl, kind_sl, valid_sl, cols_sl, slot_sl, now_):
             d = lax.axis_index(axis)
             ts1 = ts_sl[0]
@@ -240,7 +255,13 @@ def _make_routed_step(qr, mesh, axis: str, n_dev: int):
                 )
             return states2, outs, aux_red
 
-        states2, outs, aux = local(
+        local_sharded = shard_map_unchecked(
+            local,
+            mesh,
+            (P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+            (P(axis), P(axis), P()),
+        )
+        states2, outs, aux = local_sharded(
             states, r_ts, r_kind, r_valid, r_cols, r_slot, now
         )
         aux = dict(aux)

@@ -77,6 +77,18 @@ class TestBenchDriverExitPaths:
         assert failed and all(
             f["error"] == "skipped(deadline)" for f in failed
         ), failed
+        # the snapshot-line tail contract under the budget knob (the
+        # harness shape that shipped BENCH_r05 rc=124 with an EMPTY tail):
+        # every line on stdout — per-leg snapshots AND the final line —
+        # must parse, so a SIGKILL at any point leaves a consumable tail
+        lines = [
+            ln for ln in proc.stdout.strip().splitlines() if ln.strip()
+        ]
+        assert len(lines) >= 2, lines
+        for ln in lines[:-1]:
+            snap = json.loads(ln)
+            assert snap["detail"].get("partial_through_leg"), snap
+        assert "partial_through_leg" not in got["detail"]
 
     def test_per_leg_snapshot_lines_are_parseable(self):
         """Every completed leg prints a snapshot JSON line (the SIGKILL
