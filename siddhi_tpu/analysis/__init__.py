@@ -34,6 +34,7 @@ from siddhi_tpu.query_api.siddhi_app import SiddhiApp
 
 __all__ = [
     "analyze",
+    "analyze_add_query",
     "analyze_store_query",
     "build_fusion_plan",
     "compute_costs",
@@ -71,3 +72,23 @@ def compute_costs(app: "Union[str, SiddhiApp]"):
 def analyze(app: Union[str, SiddhiApp]) -> AnalysisResult:
     """Semantic analysis of a SiddhiApp (AST or SiddhiQL source text)."""
     return _analyze_app(_to_app(app))
+
+
+def analyze_add_query(app: "Union[str, SiddhiApp]", query) -> AnalysisResult:
+    """SA130: lint a hot `add_query` candidate against a LIVE app's symbols
+    (duplicate query id, undeclared stream) — the SAME rule set
+    `runtime.add_query` raises on (core/churn.iter_add_query_problems),
+    following the SA125–SA129 shared-rule-set pattern. `query` is SiddhiQL
+    query text or a Query AST; `app` is the deployed app (AST or source)."""
+    from siddhi_tpu.core.churn import iter_add_query_problems
+
+    app = _to_app(app)
+    if isinstance(query, str):
+        from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+        query = SiddhiCompiler.parse_query(query)
+    diags = [
+        Diagnostic("SA130", problem)
+        for problem in iter_add_query_problems(app, query)
+    ]
+    return AnalysisResult(app_name=app.name or "", diagnostics=diags)

@@ -64,6 +64,8 @@ CODES: dict[str, str] = {
              "rate.limit or max.pending / no bound declared)",
     "SA129": "invalid @app:shard annotation (devices out of range / "
              "unknown axis / unknown option)",
+    "SA130": "hot add_query candidate conflicts with the live app "
+             "(missing @info name / duplicate query id / undeclared stream)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

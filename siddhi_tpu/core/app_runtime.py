@@ -192,6 +192,12 @@ class SiddhiAppRuntime:
         # callbacks retained for supervised rebuild: a restart re-creates
         # every junction/runtime, so user callbacks must be re-registered
         self._user_callbacks: list[tuple[str, Callable]] = []
+        # hot-deploy wiring staging (core/churn.add_query): while set (a
+        # list), _wire_subscribe/_wire_fuse_candidate APPEND deferred
+        # actions instead of touching the live junctions, so the whole
+        # query builds off-line and the splice applies them atomically
+        # under the process lock
+        self._staged_wiring = None
 
         # @OnError(action='LOG'|'STREAM'|'STORE') failure policies
         # (reference: StreamJunction OnErrorAction + util/error/handler/*);
@@ -634,6 +640,26 @@ class SiddhiAppRuntime:
             self.junctions[stream_id] = j
         return j
 
+    def _wire_subscribe(self, junction, fn, name: str) -> None:
+        """Subscribe `fn` to `junction` — or, during a hot-deploy build
+        (core/churn.add_query), stage the subscription for the splice."""
+        if self._staged_wiring is not None:
+            self._staged_wiring.append(
+                lambda _j=junction, _f=fn, _n=name: _j.subscribe(_f, name=_n)
+            )
+        else:
+            junction.subscribe(fn, name=name)
+
+    def _wire_fuse_candidate(self, junction, ep) -> None:
+        """Register a FuseEndpoint on `junction` — staged during a
+        hot-deploy build, exactly like _wire_subscribe."""
+        if self._staged_wiring is not None:
+            self._staged_wiring.append(
+                lambda _j=junction, _e=ep: _j.fuse_candidates.append(_e)
+            )
+        else:
+            junction.fuse_candidates.append(ep)
+
     def _wire_insert(self, qr) -> None:
         """Route a query's output batches into its insert-into junction
         (reference: SiddhiAppRuntimeBuilder.addQuery:170-231 output wiring)."""
@@ -822,12 +848,13 @@ class SiddhiAppRuntime:
                 )
             self._maybe_schedule(_qr, aux)
 
-        in_junction.subscribe(
-            self._table_guard(qr, receive, in_schema), name=f"query.{qid}"
+        self._wire_subscribe(
+            in_junction, self._table_guard(qr, receive, in_schema),
+            name=f"query.{qid}",
         )
         from siddhi_tpu.core.ingest import FuseEndpoint
 
-        in_junction.fuse_candidates.append(FuseEndpoint(
+        self._wire_fuse_candidate(in_junction, FuseEndpoint(
             qr,
             impl_factory=lambda _qr=qr: _qr._step_impl,
             init_state=lambda now, _qr=qr: _qr.init_state(),
@@ -836,6 +863,8 @@ class SiddhiAppRuntime:
 
         if qr.needs_scheduler:
             def fire(t_ms: int, _qr=qr, _schema=in_schema) -> None:
+                if getattr(_qr, "_removed", False):
+                    return  # hot-undeployed with a timer still pending
                 batch = self._timer_batch(_schema, t_ms)
                 with self._process_lock:
                     out_batch, aux = _qr.receive(batch, t_ms)
@@ -895,7 +924,8 @@ class SiddhiAppRuntime:
 
         for sid in qr.prog.stream_ids:
             sj = self._junction(sid)
-            sj.subscribe(
+            self._wire_subscribe(
+                sj,
                 self._table_guard(
                     qr,
                     lambda b, now, _sid=sid: receive(b, now, _sid),
@@ -903,7 +933,7 @@ class SiddhiAppRuntime:
                 ),
                 name=f"query.{qid}",
             )
-            sj.fuse_candidates.append(FuseEndpoint(
+            self._wire_fuse_candidate(sj, FuseEndpoint(
                 qr,
                 impl_factory=lambda _qr=qr, _sid=sid: _qr._make_step(_sid),
                 init_state=lambda now, _qr=qr: _qr.init_state(now),
@@ -912,6 +942,8 @@ class SiddhiAppRuntime:
 
         if qr.needs_scheduler:
             def fire(t_ms: int, _qr=qr) -> None:
+                if getattr(_qr, "_removed", False):
+                    return
                 batch = _pattern_timer_batch(t_ms)
                 with self._process_lock:
                     out_batch, aux = _qr.receive_timer(batch, t_ms)
@@ -1015,7 +1047,8 @@ class SiddhiAppRuntime:
         # (reference: JoinInputStreamParser self-join double dispatch)
         if join.left.stream_id == join.right.stream_id:
             j = self._junction(join.left.stream_id)
-            j.subscribe(
+            self._wire_subscribe(
+                j,
                 self._table_guard(
                     qr,
                     lambda b, now: (
@@ -1047,7 +1080,7 @@ class SiddhiAppRuntime:
 
                 return impl
 
-            j.fuse_candidates.append(FuseEndpoint(
+            self._wire_fuse_candidate(j, FuseEndpoint(
                 qr, impl_factory=_both_sides_impl,
                 init_state=lambda now, _qr=qr: _qr.init_state(),
                 latency_tracker=lt,
@@ -1059,13 +1092,15 @@ class SiddhiAppRuntime:
                     # named-window side: driven by the window's emissions
                     # (no FuseEndpoint: that junction never sees send_columns,
                     # and the missing candidate keeps it per-batch)
-                    nw.out_junction.subscribe(
+                    self._wire_subscribe(
+                        nw.out_junction,
                         lambda b, now, _s=side: receive_side(b, now, _s),
                         name=f"query.{qid}",
                     )
                 elif not qr.table_sides[side]:
                     sj = self._junction(stream.stream_id)
-                    sj.subscribe(
+                    self._wire_subscribe(
+                        sj,
                         self._table_guard(
                             qr,
                             lambda b, now, _s=side: receive_side(b, now, _s),
@@ -1073,7 +1108,7 @@ class SiddhiAppRuntime:
                         ),
                         name=f"query.{qid}",
                     )
-                    sj.fuse_candidates.append(FuseEndpoint(
+                    self._wire_fuse_candidate(sj, FuseEndpoint(
                         qr,
                         impl_factory=lambda _qr=qr, _s=side: (
                             lambda st, tst, b, now: _qr._step_impl(
@@ -1086,7 +1121,9 @@ class SiddhiAppRuntime:
 
         for side, schema in qr.side_schemas.items():
             if qr.needs_scheduler[side]:
-                def fire(t_ms: int, _side=side, _schema=schema) -> None:
+                def fire(t_ms: int, _side=side, _schema=schema, _qr=qr) -> None:
+                    if getattr(_qr, "_removed", False):
+                        return
                     receive_side(self._timer_batch(_schema, t_ms), t_ms, _side)
 
                 qr.timer_targets[side] = fire
@@ -1114,8 +1151,8 @@ class SiddhiAppRuntime:
         period = rl.period_ms
 
         def fire(t_ms: int, _qr=qr, _rl=rl) -> None:
-            if not self._running:
-                return
+            if not self._running or getattr(_qr, "_removed", False):
+                return  # stopped, or hot-undeployed: stop re-arming
             with self._process_lock:
                 _qr._deliver(_rl.on_timer(t_ms), t_ms)
             self._scheduler.notify_at(t_ms + period, fire)
@@ -1149,6 +1186,31 @@ class SiddhiAppRuntime:
         return h
 
     input_handler = get_input_handler
+
+    # ---- zero-downtime churn (core/churn.py) ------------------------------
+
+    def add_query(self, query, seed="checkpoint") -> str:
+        """Hot-deploy one query into this (possibly running) app without
+        draining it: parse -> SA130 lint against the live symbols ->
+        construct + prewarm off-line -> splice into the junction fan-out
+        under the app process lock, seeding windows/patterns from the last
+        checkpoint when a compatible `query:<id>` element exists
+        (`seed='checkpoint'`, the default; `seed='cold'` skips).
+        Fusion groups re-form around the grown wiring; surviving queries'
+        emissions are byte-identical across the splice. Returns the
+        assigned query id. The retained AST grows too, so a supervised
+        restart rebuilds the app WITH the hot-deployed query."""
+        from siddhi_tpu.core.churn import add_query as _add
+
+        return _add(self, query, seed=seed)
+
+    def remove_query(self, qid: str) -> None:
+        """Hot-undeploy one top-level query (inverse of add_query): it is
+        unspliced under the process lock, dropped from the retained AST,
+        and the fusion groups re-form over the shrunk wiring."""
+        from siddhi_tpu.core.churn import remove_query as _remove
+
+        _remove(self, qid)
 
     def replay_target_available(self, entry) -> bool:
         """May `replay_error(entry)` be dispatched WITHOUT blocking? False
@@ -1386,6 +1448,11 @@ class SiddhiAppRuntime:
         health = getattr(self, "_health", None)
         if health is not None:
             status["health"] = health.describe_state()
+        # churn ledger (core/churn.py; manager-owned so it survives
+        # redeploys and supervised restarts)
+        churn = self.manager.churn_stats(self.name, create=False)
+        if churn is not None:
+            status["churn"] = churn.describe_state()
         return status
 
     # ---- flight recorder (observability/flight.py) ------------------------
@@ -1507,35 +1574,34 @@ class SiddhiAppRuntime:
             with self._process_lock:
                 return sqr.execute(self.clock())
 
-    def start(self) -> None:
-        self._running = True
-        # build per-junction fused ingest engines (core/ingest.py):
-        # plan-driven GROUP engines first (core/fusion_exec.py — the
-        # FusionPlan's fusable subset runs as one chunk program, blocked
-        # queries ride the residual per-batch path, shared-window candidates
-        # reference one ring), then the legacy all-or-nothing engine for
-        # junctions where every subscriber registered a FuseEndpoint.
-        # @app:fuse(disable='true') / SIDDHI_TPU_FUSE=0 skips all of it.
+    def _build_fused_ingest(self) -> None:
+        """(Re)build the per-junction fused ingest engines from the LIVE
+        wiring + the current FusionPlan (core/ingest.py, core/fusion_exec.py):
+        plan-driven GROUP engines first (the FusionPlan's fusable subset
+        runs as one chunk program, blocked queries ride the residual
+        per-batch path, shared-window candidates reference one ring), then
+        the legacy all-or-nothing engine for junctions where every
+        subscriber registered a FuseEndpoint. Called by start() and by the
+        churn splice (core/churn.py) after the wiring grows/shrinks — the
+        fusion groups re-form around the new query set. Batch shard
+        routers re-arm on the rebuilt engines."""
         from siddhi_tpu.core.ingest import FusedJunctionIngest
         from siddhi_tpu.core.pipeline import resolve_pipeline_annotation
 
         chunk = self._capacity_annotation("app:ingestChunk", 32)
         fusion_configs: dict = {}
-        if self._fuse_enabled:
-            try:
-                from siddhi_tpu.core.fusion_exec import (
-                    junction_fusion_configs,
-                )
+        try:
+            from siddhi_tpu.core.fusion_exec import junction_fusion_configs
 
-                fusion_configs = junction_fusion_configs(self)
-            except Exception:
-                import logging
+            fusion_configs = junction_fusion_configs(self)
+        except Exception:
+            import logging
 
-                logging.getLogger(__name__).warning(
-                    "fusion planning failed for app '%s'; falling back to "
-                    "per-junction fusion only", self.name, exc_info=True,
-                )
-        for j in self.junctions.values() if self._fuse_enabled else ():
+            logging.getLogger(__name__).warning(
+                "fusion planning failed for app '%s'; falling back to "
+                "per-junction fusion only", self.name, exc_info=True,
+            )
+        for j in list(self.junctions.values()):
             sid = j.schema.stream_id
             pipe_on, pipe_depth = self._pipeline_conf.get(
                 sid, resolve_pipeline_annotation(None)
@@ -1554,6 +1620,53 @@ class SiddhiAppRuntime:
                     self, j, j.fuse_candidates, chunk_batches=chunk,
                     pipeline_enabled=pipe_on, pipeline_depth=pipe_depth,
                 )
+        if self._shard is not None:
+            self._shard.rearm_routers()
+
+    def _teardown_fused_ingest(self) -> None:
+        """Disable and close every fused ingest engine, splitting any
+        cross-query aliased chain states first (PR 8's `_maybe_unshare`:
+        followers get device copies, losslessly re-shareable by the next
+        fused send). MUST run OUTSIDE the app process lock: a pipelined
+        sender holds the engine's send lock while acquiring the process
+        lock per chunk, so closing under the process lock would deadlock
+        against it. While engines are down, sends ride the per-batch path
+        — byte-identical by the fuse-on/off CI contract."""
+        for j in list(self.junctions.values()):
+            fi = j.fused_ingest
+            if fi is None:
+                continue
+            j.fused_ingest = None  # new sends fall back per-batch now
+            fi._disabled = True  # senders that already read `fi` bail out
+            # close FIRST: it serializes on the engine's send lock, so an
+            # in-flight send (already past the _disabled check) finishes —
+            # and its writeback may re-alias shared chains — before the
+            # unshare below splits them. Unsharing first would leave those
+            # late-aliased states guardless: two per-batch steps donating
+            # the same ring buffers.
+            fi.close()
+            try:
+                fi._maybe_unshare()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "unsharing stream '%s' during churn teardown failed",
+                    j.schema.stream_id,
+                )
+            # shared-ring bookkeeping detaches: the members' states are
+            # private buffers again until a rebuilt engine re-shares
+            for ep in fi.endpoints:
+                if getattr(ep.qr, "shared_ring", None) is not None:
+                    ep.qr.shared_ring = None
+                ep.qr._unshare_guard = None
+
+    def start(self) -> None:
+        self._running = True
+        # @app:fuse(disable='true') / SIDDHI_TPU_FUSE=0 skips the fused
+        # ingest engines entirely (see _build_fused_ingest)
+        if self._fuse_enabled:
+            self._build_fused_ingest()
         # first-class sharded execution (parallel/shard.py): place
         # partitioned [P] state on the device mesh and arm batch-axis
         # routers on junctions whose fused endpoints are all stateless —

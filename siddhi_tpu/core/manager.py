@@ -21,6 +21,9 @@ class SiddhiManager:
         self._runtimes: dict[str, object] = {}
         self._metrics_server = None
         self._supervisor = None
+        # per-app churn ledgers (core/churn.ChurnStats): manager-owned so
+        # deploy/undeploy/redeploy counters survive redeploys and restarts
+        self._churn: dict[str, object] = {}
 
     # app: SiddhiQL source text or a programmatic SiddhiApp AST
     def create_siddhi_app_runtime(
@@ -80,6 +83,34 @@ class SiddhiManager:
     def set_persistence_store(self, store) -> None:
         self.persistence_store = store
 
+    # ---- zero-downtime churn (core/churn.py) ------------------------------
+
+    def churn_stats(self, app_name: str, create: bool = True):
+        """The app's churn ledger (deploys/undeploys/redeploys/rollbacks,
+        last splice wall time, last state-seed outcomes). With
+        `create=False`, returns None for apps that never churned."""
+        stats = self._churn.get(app_name)
+        if stats is None and create:
+            from siddhi_tpu.core.churn import ChurnStats
+
+            stats = self._churn[app_name] = ChurnStats()
+        return stats
+
+    def redeploy(self, name: str, app: Union[str, SiddhiApp], **kw) -> dict:
+        """Rolling upgrade of one deployed app: checkpoint -> build the
+        replacement off-line -> restore every structurally-compatible
+        component's state -> atomic swap under the supervisor's rebuild
+        guard, with ingress buffered (bounded, admission-metered) rather
+        than dropped across the swap window, then drained into the new
+        runtime in arrival order. Stale input handlers keep working (the
+        released gates forward them). Returns the redeploy report; on
+        failure the OLD deployment is rolled back to and keeps serving.
+        See core/churn.redeploy for the knobs (`strict`, `gate_capacity`,
+        `gate_block_s`)."""
+        from siddhi_tpu.core.churn import redeploy as _redeploy
+
+        return _redeploy(self, name, app, **kw)
+
     # ---- error store (reference: SiddhiManager.setErrorStore) -------------
 
     @property
@@ -102,6 +133,7 @@ class SiddhiManager:
         purge: bool = True,
         timeout: float | None = None,
         skip_unavailable: bool = False,
+        mode: str = "live",
     ) -> int:
         """Re-drive stored erroneous events through their origin: stream
         entries re-enter the input handler, sink entries re-publish. Returns
@@ -115,31 +147,67 @@ class SiddhiManager:
         next replay. `timeout` (seconds) bounds the whole loop: entries not
         reached before the deadline stay stored. Both exist so one wedged
         app cannot hold every other app's entries hostage (the supervisor's
-        post-restart replay always passes skip_unavailable=True)."""
+        post-restart replay always passes skip_unavailable=True).
+
+        `mode='paused'` pauses each target stream's ingress for the loop
+        (an admission-gate HOLD — live sends buffer in arrival order, not
+        drop; core/churn.IngressGate) so replayed entries land in strict
+        stored order before live traffic resumes. The default `'live'`
+        mode interleaves replays with concurrent traffic."""
         import time as _time
 
+        if mode not in ("live", "paused"):
+            raise ValueError(f"replay_errors mode '{mode}' (live|paused)")
         if self._error_store is None:
             return 0
         if entries is None:
             entries = self.error_store.load()
+        gates: list = []
+        if mode == "paused":
+            from siddhi_tpu.core.churn import IngressGate
+            from siddhi_tpu.core.error_store import ORIGIN_SINK, ORIGIN_TABLE
+
+            paused = set()
+            for e in entries:
+                if e.origin == ORIGIN_SINK:
+                    continue  # sink replays re-publish; no ingress involved
+                sid = e.sink_ref if e.origin == ORIGIN_TABLE else e.stream_id
+                rt = self._runtimes.get(e.app_name)
+                if rt is None or sid is None or (e.app_name, sid) in paused:
+                    continue
+                j = rt.junctions.get(sid)
+                if j is None or j.ingress_gate is not None:
+                    continue
+                g = IngressGate(j, admission=getattr(rt, "_admission", None))
+                j.ingress_gate = g
+                gates.append((j, g))
+                paused.add((e.app_name, sid))
         deadline = _time.monotonic() + timeout if timeout is not None else None
         replayed = 0
-        for e in entries:
-            if deadline is not None and _time.monotonic() >= deadline:
-                break
-            rt = self._runtimes.get(e.app_name)
-            if rt is None:
-                continue
-            if skip_unavailable and not rt.replay_target_available(e):
-                continue
-            if rt.replay_error(e):
-                replayed += 1
-                if purge:
-                    # purge only DISPATCHED entries: a replay that fails again
-                    # re-enters the store as a fresh entry through the live
-                    # failure path, while an undispatchable one (origin gone)
-                    # must stay stored rather than silently vanish
-                    self.error_store.purge([e.id])
+        try:
+            for e in entries:
+                if deadline is not None and _time.monotonic() >= deadline:
+                    break
+                rt = self._runtimes.get(e.app_name)
+                if rt is None:
+                    continue
+                if skip_unavailable and not rt.replay_target_available(e):
+                    continue
+                if rt.replay_error(e):
+                    replayed += 1
+                    if purge:
+                        # purge only DISPATCHED entries: a replay that fails
+                        # again re-enters the store as a fresh entry through
+                        # the live failure path, while an undispatchable one
+                        # (origin gone) must stay stored rather than
+                        # silently vanish
+                        self.error_store.purge([e.id])
+        finally:
+            # resume live traffic: drain the held backlog in arrival order
+            # (behind every replayed entry), then open each gate
+            for j, g in gates:
+                g.release(target=None, redirect=None)
+                j.ingress_gate = None
         return replayed
 
     def set_config_manager(self, config_manager) -> None:
@@ -245,6 +313,26 @@ class SiddhiManager:
                 "spent blocked by admission back-pressure\n"
                 "# TYPE siddhi_admission_blocked_ms_total counter\n"
                 + "\n".join(adm_lines) + "\n"
+            )
+        # churn family (core/churn.py): manager-owned, so it meters apps
+        # whose runtimes were replaced since
+        churn_lines = []
+        for name, stats in sorted(self._churn.items()):
+            for op, v in (
+                ("deploy", stats.deploys),
+                ("undeploy", stats.undeploys),
+                ("redeploy", stats.redeploys),
+                ("rollback", stats.rollbacks),
+            ):
+                churn_lines.append(
+                    f'siddhi_churn_total{{app="{name}",op="{op}"}} {v}'
+                )
+        if churn_lines:
+            text += (
+                "# HELP siddhi_churn_total Hot deploy/undeploy/redeploy/"
+                "rollback operations per app\n"
+                "# TYPE siddhi_churn_total counter\n"
+                + "\n".join(churn_lines) + "\n"
             )
         return text
 

@@ -162,6 +162,49 @@ def _flat_with_paths(tree) -> dict:
     return {jax.tree_util.keystr(path): leaf for path, leaf in leaves}
 
 
+def merge_snapshot_interner(interner, payload: dict) -> None:
+    """Install a snapshot payload's intern table into `interner`: restored
+    states carry interned string ids minted by the CHECKPOINTING process,
+    so they must resolve to the original strings here. A conflicting id
+    raises rather than silently mis-decoding. Shared by
+    `SnapshotService.restore` and the churn state-seeding path
+    (core/churn._seed_query_state)."""
+    for i, v in enumerate(payload["interner"], start=1):
+        if i < len(interner._from_id):
+            if interner._from_id[i] != v:
+                raise ValueError(
+                    f"intern table conflict at id {i}: "
+                    f"{interner._from_id[i]!r} != {v!r}"
+                )
+        else:
+            interner._to_id[v] = i
+            interner._from_id.append(v)
+
+
+def merge_snapshot_elements(payloads: list) -> tuple:
+    """Fold one full payload plus trailing incremental deltas into
+    (elements, rates) — THE base+delta merge, shared by
+    `SnapshotService.restore` and the churn seeding path."""
+    if payloads[0]["type"] != "full":
+        raise ValueError("restore needs a full snapshot first")
+    elements = dict(payloads[0]["elements"])
+    rates = dict(payloads[0].get("rates", {}))
+    for p in payloads[1:]:
+        if p["type"] != "incremental":
+            raise ValueError("later snapshots must be incremental")
+        for k, changed in p["delta"].items():
+            if k not in elements:
+                continue
+            paths, treedef = jax.tree_util.tree_flatten_with_path(elements[k])
+            leaves = [
+                changed.get(jax.tree_util.keystr(path), leaf)
+                for path, leaf in paths
+            ]
+            elements[k] = jax.tree_util.tree_unflatten(treedef, leaves)
+        rates.update(p.get("rates", {}))
+    return elements, rates
+
+
 class SnapshotService:
     """reference: util/snapshot/SnapshotService.java — here the registry is
     the app runtime's component maps; the app process lock is the barrier."""
@@ -316,35 +359,9 @@ class SnapshotService:
         if not snapshots:
             return
         payloads = [pickle.loads(s) for s in snapshots]
-        if payloads[0]["type"] != "full":
-            raise ValueError("restore needs a full snapshot first")
         with self.rt._process_lock:
             # interner: restored ids must resolve to their original strings
-            interner = self.rt.interner
-            for i, v in enumerate(payloads[-1]["interner"], start=1):
-                if i < len(interner._from_id):
-                    if interner._from_id[i] != v:
-                        raise ValueError(
-                            f"intern table conflict at id {i}: "
-                            f"{interner._from_id[i]!r} != {v!r}"
-                        )
-                else:
-                    interner._to_id[v] = i
-                    interner._from_id.append(v)
-            elements = dict(payloads[0]["elements"])
-            rates = dict(payloads[0].get("rates", {}))
-            for p in payloads[1:]:
-                if p["type"] != "incremental":
-                    raise ValueError("later snapshots must be incremental")
-                for k, changed in p["delta"].items():
-                    if k not in elements:
-                        continue
-                    paths, treedef = jax.tree_util.tree_flatten_with_path(elements[k])
-                    leaves = [
-                        changed.get(jax.tree_util.keystr(path), leaf)
-                        for path, leaf in paths
-                    ]
-                    elements[k] = jax.tree_util.tree_unflatten(treedef, leaves)
-                rates.update(p.get("rates", {}))
+            merge_snapshot_interner(self.rt.interner, payloads[-1])
+            elements, rates = merge_snapshot_elements(payloads)
             self._restore_elements(elements)
             self._restore_elements(rates)

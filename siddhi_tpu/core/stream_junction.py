@@ -91,6 +91,11 @@ class StreamJunction:
         self.fault_junction: "StreamJunction | None" = None
         self.error_store_fn: Callable[[], object] | None = None
         self.app_name: str = ""
+        # churn ingress gate (core/churn.IngressGate): when set, input
+        # handlers buffer (hold) or forward their sends instead of
+        # publishing — the redeploy swap window and the paused replay mode
+        # ride this. None = one attribute check on the ingest path.
+        self.ingress_gate = None
 
     def enable_flight(self, size: int) -> None:
         """Attach a flight recorder of the last `size` events. Idempotent
@@ -135,6 +140,24 @@ class StreamJunction:
         self.subscriber_names.append(
             name if name else f"subscriber{len(self.subscribers) - 1}"
         )
+
+    def unsubscribe(self, name: str) -> int:
+        """Remove every subscriber registered under `name` (hot undeploy,
+        core/churn.py). Caller holds the app process lock, so no fan-out
+        can be mid-iteration over the lists. Returns how many were
+        removed."""
+        removed = 0
+        with self.lock:
+            keep = [
+                (fn, n)
+                for fn, n in zip(self.subscribers, self.subscriber_names)
+                if n != name
+            ]
+            removed = len(self.subscribers) - len(keep)
+            if removed:
+                self.subscribers = [fn for fn, _n in keep]
+                self.subscriber_names = [n for _fn, n in keep]
+        return removed
 
     def add_stream_callback(self, fn: Callable, name: str | None = None) -> None:
         self.stream_callbacks.append(fn)
@@ -660,6 +683,11 @@ class InputHandler:
 
     def send(self, data: Sequence[Any], timestamp: int | None = None) -> None:
         ts = timestamp if timestamp is not None else self.clock()
+        g = self.junction.ingress_gate
+        if g is not None and g.intercept(
+            "rows", ([ts], [tuple(data)], self.clock()), 1
+        ):
+            return
         self.junction.send_rows([ts], [tuple(data)], now=self.clock())
 
     def send_many(
@@ -668,7 +696,14 @@ class InputHandler:
         if timestamps is None:
             t = self.clock()
             timestamps = [t] * len(rows)
-        self.junction.send_rows(list(timestamps), [tuple(r) for r in rows], now=self.clock())
+        timestamps = list(timestamps)
+        rows = [tuple(r) for r in rows]
+        g = self.junction.ingress_gate
+        if g is not None and g.intercept(
+            "rows", (timestamps, rows, self.clock()), len(rows)
+        ):
+            return
+        self.junction.send_rows(timestamps, rows, now=self.clock())
 
     def send_columns(
         self,
@@ -688,6 +723,9 @@ class InputHandler:
         n = len(timestamps)
         if now is None:
             now = self.clock()  # same wall-clock default as send/send_many
+        g = j.ingress_gate
+        if g is not None and g.intercept("cols", (timestamps, cols, now), n):
+            return
         numeric = all(np.asarray(v).dtype.kind not in "OUS" for v in cols.values())
         fi = j.fused_ingest
         if numeric and fi is not None and fi.try_send(timestamps, cols, now):

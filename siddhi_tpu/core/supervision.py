@@ -476,6 +476,12 @@ class Supervisor:
 
     def _check_all(self) -> None:
         for name, health in list(self._health.items()):
+            if name == self._rebuilding:
+                # mid-rebuild (our own restart, or an operator redeploy's
+                # atomic swap — core/churn.redeploy): the teardown below
+                # this guard is intentional, not a crash to race a restart
+                # against
+                continue
             rt = self.manager.get_siddhi_app_runtime(name)
             if rt is None:
                 # intentionally shut down and deregistered

@@ -318,7 +318,7 @@ def build_plan(app, runtime=None) -> dict:
                     pass
         nodes.append(node)
 
-    return {
+    plan = {
         "app": app.name,
         "analyzed": bool(flows),
         "live": sm is not None,
@@ -326,6 +326,17 @@ def build_plan(app, runtime=None) -> dict:
         "edges": edges,
         "fusion": fusion_summary,
     }
+    # churn ledger (core/churn.py): deploy/undeploy/redeploy counters, last
+    # splice wall time, and the last state-seed outcome per component —
+    # manager-owned, so it survives the runtime this plan annotates
+    if runtime is not None:
+        try:
+            churn = runtime.manager.churn_stats(runtime.name, create=False)
+            if churn is not None:
+                plan["churn"] = churn.describe_state()
+        except Exception:
+            pass
+    return plan
 
 
 def _query_counters(
@@ -535,6 +546,23 @@ def render_text(plan: dict) -> str:
                     f"  blocked: {b['query']} on {b['stream']} "
                     f"({b['hazard']})"
                 )
+    churn = plan.get("churn")
+    if churn:
+        line = (
+            f"churn: deploys={churn.get('deploys', 0)} "
+            f"undeploys={churn.get('undeploys', 0)} "
+            f"redeploys={churn.get('redeploys', 0)} "
+            f"rollbacks={churn.get('rollbacks', 0)}"
+        )
+        if churn.get("last_splice_ms") is not None:
+            line += f" last_splice={churn['last_splice_ms']}ms"
+        lines.append(line)
+        seed = churn.get("last_seed")
+        if seed:
+            outcomes = ", ".join(
+                f"{k}={v}" for k, v in sorted(seed.items())
+            )
+            lines.append(f"  last seed: {outcomes}")
     return "\n".join(lines)
 
 

@@ -107,9 +107,11 @@ class MetricsServer:
         return self.manager.observability_reports()
 
     def _prometheus(self) -> str:
-        from siddhi_tpu.observability.reporters import render_prometheus
-
-        return render_prometheus(self._reports())
+        # the manager's renderer, not render_prometheus(reports) directly:
+        # the supervisor / admission / churn families live OUTSIDE the
+        # per-app statistics registries (they meter apps with statistics
+        # off too) and were invisible to scrapes otherwise
+        return self.manager.prometheus_text()
 
     def _traces(self) -> dict:
         return {

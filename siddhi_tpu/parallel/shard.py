@@ -513,17 +513,39 @@ class ShardRuntime:
             return
         if self.axis in ("auto", "part"):
             self.partitioned = apply_partition_mesh(self.app, self.devices)
-        if self.axis in ("auto", "batch"):
-            sm = self.app.statistics_manager
-            for sid, j in list(self.app.junctions.items()):
-                fi = j.fused_ingest
-                if fi is None or not router_eligible(fi):
-                    continue
-                r = BatchShardRouter(j, self.devices)
-                fi.shard_router = r
-                self.routers[sid] = r
-                if sm is not None:
-                    sm.register_shard(f"stream.{sid}", r)
+        self.rearm_routers()
+
+    def rearm_routers(self) -> None:
+        """(Re)arm batch-axis routers on every eligible fused ingest
+        engine. Called by apply() at start AND by the churn splice
+        (core/churn.py) after fused engines are rebuilt: a hot
+        deploy/undeploy can change a junction's eligibility (a stateful
+        query joining the group vetoes the router; its removal restores
+        it), and the rebuilt engines start with `shard_router = None`."""
+        if self.n < 2 or self.axis not in ("auto", "batch"):
+            return
+        sm = self.app.statistics_manager
+        prev_routers = self.routers
+        self.routers = {}
+        for sid, j in list(self.app.junctions.items()):
+            fi = j.fused_ingest
+            if fi is None or not router_eligible(fi):
+                continue
+            r = BatchShardRouter(j, self.devices)
+            prev = prev_routers.get(sid)
+            if prev is not None and len(prev.devices) == len(self.devices):
+                # carry the cumulative counters into the replacement: the
+                # siddhi_shard_device_*_total families are Prometheus
+                # COUNTERS — zeroing them on every churn splice would read
+                # as counter resets in rate()/increase() and break the
+                # per-device-sums == everything-sent invariant
+                r.dispatches = list(prev.dispatches)
+                r.events = list(prev.events)
+                r.sends = prev.sends
+            fi.shard_router = r
+            self.routers[sid] = r
+            if sm is not None:
+                sm.register_shard(f"stream.{sid}", r)
 
     def describe_state(self) -> dict:
         d: dict = {

@@ -21,6 +21,12 @@ Sites (the key passed at each):
                         ingest drain (poison-batch path)
     persist_save        "<app>"  persistence-store save
     persist_load        "<app>"  persistence-store load
+    churn_splice        "<app>:+<qid>" / "<app>:-<qid>"  the hot deploy/
+                        undeploy splice critical section (core/churn.py);
+                        an injected fault proves the rollback-to-pre-churn
+                        contract
+    churn_restore       "<app>"(redeploy) / "<app>:<qid>"(add_query seed)
+                        state restore through the snapshot SPI during churn
 
 Determinism: rules fire by hit count (`after` skips the first N matching
 hits, `times` bounds how often the rule fires), optionally thinned by a

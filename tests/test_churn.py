@@ -1,0 +1,679 @@
+"""Zero-downtime churn (core/churn.py): hot deploy/undeploy splice parity,
+checkpoint state seeding, rolling redeploy state-compat matrix, shard
+rebalancing across a device-count change, fault-injected rollback, the
+paused replay mode, and the SA130 candidate lint."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import InMemoryPersistenceStore
+from siddhi_tpu.testing import faults
+
+
+def _collect(rt, name):
+    rows = []
+    rt.add_callback(
+        name, lambda ts, i, r: rows.extend(tuple(e.data) for e in i or [])
+    )
+    return rows
+
+
+def _feed_columns(h, lo, hi):
+    ts = np.arange(lo, hi, dtype=np.int64)
+    cols = {
+        "a": np.arange(lo, hi, dtype=np.int64),
+        "b": (np.arange(lo, hi) % 7).astype(np.int64),
+    }
+    h.send_columns(ts, cols)
+
+
+FUSED_APP = """
+@app:name('F')
+define stream S (a long, b long);
+@info(name='q1') from S[a % 2 == 0] select a, b insert into O1;
+@info(name='q2') from S#window.length(8) select a, sum(b) as t insert into O2;
+"""
+
+
+class TestSpliceByteParity:
+    def _run(self, app, churn: bool):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(app)
+        r1 = _collect(rt, "q1")
+        r2 = _collect(rt, "q2")
+        rt.start()
+        h = rt.get_input_handler("S")
+        _feed_columns(h, 0, 512)
+        if churn:
+            rt.add_query(
+                "@info(name='hot') from S[a > 100000] select a insert into O3;"
+            )
+        _feed_columns(h, 512, 1024)
+        if churn:
+            rt.remove_query("hot")
+        _feed_columns(h, 1024, 1536)
+        rt.shutdown()
+        mgr.shutdown()
+        return r1, r2
+
+    def test_fused_survivors_byte_identical_across_splice(self):
+        a1, a2 = self._run(FUSED_APP, churn=False)
+        b1, b2 = self._run(FUSED_APP, churn=True)
+        assert a1 == b1
+        assert a2 == b2
+        assert len(a1) == 768 and len(a2) == 1536
+
+    def test_unfused_survivors_byte_identical_across_splice(self):
+        app = FUSED_APP.replace(
+            "@app:name('F')", "@app:name('F')\n@app:fuse(disable='true')"
+        )
+        a1, a2 = self._run(app, churn=False)
+        b1, b2 = self._run(app, churn=True)
+        assert a1 == b1
+        assert a2 == b2
+
+    def test_fusion_group_reforms_around_hot_query(self):
+        # the hot query joins the stream's fused group: the rebuilt engine
+        # must carry THREE members while deployed, two after undeploy
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(FUSED_APP)
+        _collect(rt, "q1")
+        _collect(rt, "q2")
+        rt.start()
+        h = rt.get_input_handler("S")
+        _feed_columns(h, 0, 256)
+        fi = rt.junctions["S"].fused_ingest
+        assert fi is not None and len(fi.endpoints) == 2
+        rt.add_query("@info(name='hot') from S[a < 0] select a insert into O3;")
+        fi2 = rt.junctions["S"].fused_ingest
+        assert fi2 is not None and fi2 is not fi
+        assert len(fi2.endpoints) == 3
+        _feed_columns(h, 256, 512)
+        rt.remove_query("hot")
+        fi3 = rt.junctions["S"].fused_ingest
+        assert fi3 is not None and len(fi3.endpoints) == 2
+        _feed_columns(h, 512, 768)
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestHotDeploy:
+    def test_add_query_routes_and_remove_stops(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "define stream S (v long);\n"
+            "@info(name='base') from S[v > 2] select v insert into Out;"
+        )
+        base = _collect(rt, "base")
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send_many([(i,) for i in range(5)], timestamps=list(range(5)))
+        qid = rt.add_query(
+            "@info(name='hot') from S[v % 2 == 0] select v insert into O2;"
+        )
+        assert qid == "hot"
+        hot = _collect(rt, "hot")
+        h.send_many([(i,) for i in range(5, 9)], timestamps=list(range(5, 9)))
+        assert hot == [(6,), (8,)]
+        rt.remove_query(qid)
+        h.send_many([(10,)], timestamps=[10])
+        assert hot == [(6,), (8,)]  # undeployed: no further rows
+        assert len(base) == 6 + 1  # base survived both splices
+        assert "hot" not in rt.queries
+        # the retained AST shrank back: a rebuild cannot resurrect it
+        from siddhi_tpu.query_api.execution import assign_execution_ids
+
+        ids = [e[1] for e in assign_execution_ids(rt.app)]
+        assert ids == ["base"]
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_add_query_survives_supervised_restart(self):
+        # the splice grows the retained AST, so the supervisor's rebuild
+        # includes the hot-deployed query
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('HotSup')\n"
+            "define stream S (v long);\n"
+            "@info(name='base') from S select v insert into Out;"
+        )
+        rt.start()
+        rt.add_query("@info(name='hot') from S[v > 1] select v insert into O2;")
+        sup = mgr.supervise(poll_interval_s=0.05)
+        rt._health.mark_fatal(RuntimeError("boom"), "test")
+        t0 = time.time()
+        while mgr.get_siddhi_app_runtime("HotSup") is rt and time.time() - t0 < 10:
+            time.sleep(0.05)
+        rt2 = mgr.get_siddhi_app_runtime("HotSup")
+        assert rt2 is not rt
+        t0 = time.time()
+        while not rt2._running and time.time() - t0 < 10:
+            time.sleep(0.05)
+        assert "hot" in rt2.queries
+        hot = _collect(rt2, "hot")
+        rt2.get_input_handler("S").send((5,), timestamp=1)
+        assert hot == [(5,)]
+        mgr.shutdown()
+
+    def test_duplicate_query_id_rejected(self):
+        from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "define stream S (v long);\n"
+            "@info(name='q') from S select v insert into Out;"
+        )
+        rt.start()
+        with pytest.raises(SiddhiAppCreationError, match="duplicate query"):
+            rt.add_query("@info(name='q') from S select v insert into O2;")
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_undeclared_stream_rejected(self):
+        from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("define stream S (v long);")
+        rt.start()
+        with pytest.raises(SiddhiAppCreationError, match="undeclared stream"):
+            rt.add_query(
+                "@info(name='x') from Nope select v insert into Out;"
+            )
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_unnamed_candidate_rejected(self):
+        # auto-numbered ids renumber as unnamed queries churn in and out
+        # (and across supervised rebuilds): not a stable handle — SA130
+        from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("define stream S (v long);")
+        rt.start()
+        with pytest.raises(SiddhiAppCreationError, match="@info"):
+            rt.add_query("from S select v insert into Out;")
+        rt.shutdown()
+        mgr.shutdown()
+
+    def test_remove_partition_inner_query_rejected(self):
+        from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "define stream S (k string, v long);\n"
+            "partition with (k of S) begin\n"
+            "@info(name='p') from S select k, v insert into Out;\n"
+            "end;"
+        )
+        rt.start()
+        with pytest.raises(SiddhiAppCreationError, match="partition"):
+            rt.remove_query("p")
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestStateSeeding:
+    APP = (
+        "@app:name('Seed')\n"
+        "define stream S (v long);\n"
+        "@info(name='w') from S#window.length(4) select v, sum(v) as t "
+        "insert into O;"
+    )
+    Q = (
+        "@info(name='w') from S#window.length(4) select v, sum(v) as t "
+        "insert into O;"
+    )
+
+    def _deployed_app(self):
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(InMemoryPersistenceStore())
+        rt = mgr.create_siddhi_app_runtime(self.APP)
+        rows = _collect(rt, "w")
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(1, 5):
+            h.send((i,), timestamp=i)
+        rt.persist()
+        assert rows[-1] == (4, 10)
+        return mgr, rt, h
+
+    def test_window_seeded_from_checkpoint(self):
+        mgr, rt, h = self._deployed_app()
+        rt.remove_query("w")
+        rt.add_query(self.Q, seed="checkpoint")
+        rows = _collect(rt, "w")
+        h.send((5,), timestamp=5)
+        assert rows[-1] == (5, 14)  # ring carried 2+3+4 across the splice
+        assert mgr.churn_stats("Seed").last_seed == {"query:w": "seeded"}
+        mgr.shutdown()
+
+    def test_window_cold_start(self):
+        mgr, rt, h = self._deployed_app()
+        rt.remove_query("w")
+        rt.add_query(self.Q, seed="cold")
+        rows = _collect(rt, "w")
+        h.send((5,), timestamp=5)
+        assert rows[-1] == (5, 5)
+        assert mgr.churn_stats("Seed").last_seed == {"query:w": "cold"}
+        mgr.shutdown()
+
+    def test_incompatible_checkpoint_starts_cold(self):
+        # the re-added query has a DIFFERENT window length: the snapshot
+        # element's tree shapes mismatch, so the seed surfaces
+        # 'incompatible' and the query starts cold (state never coerced)
+        mgr, rt, h = self._deployed_app()
+        rt.remove_query("w")
+        rt.add_query(self.Q.replace("length(4)", "length(8)"), seed="checkpoint")
+        rows = _collect(rt, "w")
+        h.send((5,), timestamp=5)
+        assert rows[-1] == (5, 5)
+        assert mgr.churn_stats("Seed").last_seed == {"query:w": "incompatible"}
+        mgr.shutdown()
+
+
+class TestRollback:
+    def test_add_query_rolls_back_on_injected_splice_fault(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(FUSED_APP)
+        r1 = _collect(rt, "q1")
+        r2 = _collect(rt, "q2")
+        rt.start()
+        h = rt.get_input_handler("S")
+        _feed_columns(h, 0, 256)
+        faults.install(faults.FaultPlan(
+            [faults.FaultRule(site="churn_splice", match="+bad")]
+        ))
+        try:
+            with pytest.raises(faults.InjectedFault):
+                rt.add_query(
+                    "@info(name='bad') from S select a insert into OB;"
+                )
+        finally:
+            faults.uninstall()
+        # rolled back to the pre-churn runtime: query gone, AST unchanged,
+        # fused engines rebuilt, traffic flows with identical semantics
+        assert "bad" not in rt.queries
+        assert rt.junctions["S"].fused_ingest is not None
+        assert mgr.churn_stats("F").rollbacks == 1
+        _feed_columns(h, 256, 512)
+        rt.shutdown()
+        mgr.shutdown()
+        # parity against an un-churned control
+        mgr2 = SiddhiManager()
+        c = mgr2.create_siddhi_app_runtime(FUSED_APP)
+        c1 = _collect(c, "q1")
+        c2 = _collect(c, "q2")
+        c.start()
+        ch = c.get_input_handler("S")
+        _feed_columns(ch, 0, 512)
+        c.shutdown()
+        mgr2.shutdown()
+        assert r1 == c1 and r2 == c2
+
+    def test_remove_query_fault_leaves_runtime_untouched(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "define stream S (v long);\n"
+            "@info(name='q') from S select v insert into Out;"
+        )
+        rows = _collect(rt, "q")
+        rt.start()
+        faults.install(faults.FaultPlan(
+            [faults.FaultRule(site="churn_splice", match="-q")]
+        ))
+        try:
+            with pytest.raises(faults.InjectedFault):
+                rt.remove_query("q")
+        finally:
+            faults.uninstall()
+        assert "q" in rt.queries
+        rt.get_input_handler("S").send((1,), timestamp=1)
+        assert rows == [(1,)]
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestRedeploy:
+    V1 = (
+        "@app:name('App')\n"
+        "define stream S (v long);\n"
+        "define table T (k long, total long);\n"
+        "@info(name='q') from S#window.length(4) select v, sum(v) as t "
+        "insert into O;"
+    )
+
+    def test_state_compat_matrix(self):
+        # restored: unchanged query + table; incompatible: changed window
+        # length; dropped: removed table; cold: brand-new query
+        v2 = (
+            "@app:name('App')\n"
+            "define stream S (v long);\n"
+            "@info(name='q') from S#window.length(8) select v, sum(v) as t "
+            "insert into O;\n"
+            "@info(name='q2') from S[v > 100] select v insert into Big;"
+        )
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(self.V1)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(1, 5):
+            h.send((i,), timestamp=i)
+        report = mgr.redeploy("App", v2)
+        assert "query:q" in report["incompatible"]
+        assert "table:T" in report["dropped"]
+        assert "query:q2" in report["cold"]
+        assert mgr.churn_stats("App").redeploys == 1
+        mgr.shutdown()
+
+    def test_compatible_state_carries_and_stale_handles_forward(self):
+        v2 = self.V1 + "\n@info(name='q2') from S[v > 100] select v insert into Big;"
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(self.V1)
+        rows = _collect(rt, "q")
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(1, 5):
+            h.send((i,), timestamp=i)
+        assert rows[-1] == (4, 10)
+        report = mgr.redeploy("App", v2)
+        assert "query:q" in report["restored"]
+        assert "table:T" in report["restored"]
+        rt2 = mgr.get_siddhi_app_runtime("App")
+        assert rt2 is not rt
+        rows2 = _collect(rt2, "q")
+        # the STALE pre-redeploy handle forwards through the released gate
+        h.send((5,), timestamp=5)
+        assert rows2[-1] == (5, 14)  # window ring carried across the swap
+        mgr.shutdown()
+
+    def test_redeploy_buffers_concurrent_ingress(self):
+        # a live sender races the swap window: every event must land
+        # exactly once (buffered and drained in order, never dropped)
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('App')\ndefine stream S (v long);\n"
+            "@info(name='q') from S select v insert into O;"
+        )
+        seen: list = []
+        rt.add_callback("q", lambda ts, i, r: seen.extend(
+            e.data[0] for e in i or []
+        ))
+        rt.start()
+        h = rt.get_input_handler("S")
+        stop = threading.Event()
+        sent = []
+
+        def pump():
+            i = 0
+            while not stop.is_set():
+                h.send((i,), timestamp=i)
+                sent.append(i)
+                i += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        report = mgr.redeploy(
+            "App",
+            "@app:name('App')\ndefine stream S (v long);\n"
+            "@info(name='q') from S select v insert into O;",
+        )
+        rt2 = mgr.get_siddhi_app_runtime("App")
+        rt2.add_callback("q", lambda ts, i, r: seen.extend(
+            e.data[0] for e in i or []
+        ))
+        time.sleep(0.1)
+        stop.set()
+        t.join(timeout=5)
+        time.sleep(0.2)
+        mgr.shutdown()
+        # the callback re-registration races the drain by a few events
+        # (events drained between swap and re-register are processed by
+        # the new runtime before the observer attaches); the CONTRACT is
+        # zero loss at the engine: monotone, gap-free delivery afterwards
+        assert seen == sorted(seen)
+        observed = set(seen)
+        missing = [i for i in sent if i not in observed and i > min(seen or [0])]
+        assert not missing, f"events lost across the swap: {missing[:10]}"
+        assert report["gates"]["S"]["shed"] == 0
+
+    def test_failed_redeploy_rolls_back_to_old_app(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(self.V1)
+        rows = _collect(rt, "q")
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send((1,), timestamp=1)
+        # the replacement fails to BUILD (undefined stream in a query):
+        # the old deployment must keep serving
+        bad = (
+            "@app:name('App')\n"
+            "define stream S (v long);\n"
+            "@info(name='q') from Nope select v insert into O;"
+        )
+        with pytest.raises(Exception):
+            mgr.redeploy("App", bad)
+        assert mgr.get_siddhi_app_runtime("App") is rt
+        h.send((2,), timestamp=2)
+        assert rows[-1] == (2, 3)
+        assert mgr.churn_stats("App").rollbacks == 1
+        mgr.shutdown()
+
+    def test_redeploy_restore_fault_keeps_old_serving(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(self.V1)
+        rows = _collect(rt, "q")
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send((1,), timestamp=1)
+        faults.install(faults.FaultPlan(
+            [faults.FaultRule(site="churn_restore", match="App")]
+        ))
+        try:
+            with pytest.raises(faults.InjectedFault):
+                mgr.redeploy("App", self.V1)
+        finally:
+            faults.uninstall()
+        assert mgr.get_siddhi_app_runtime("App") is rt
+        h.send((2,), timestamp=2)
+        assert rows[-1] == (2, 3)
+        mgr.shutdown()
+
+    def test_rename_rejected(self):
+        from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+        mgr = SiddhiManager()
+        mgr.create_siddhi_app_runtime(self.V1).start()
+        with pytest.raises(SiddhiAppCreationError, match="rename"):
+            mgr.redeploy("App", self.V1.replace("'App'", "'Other'"))
+        mgr.shutdown()
+
+
+class TestShardRebalance:
+    V = (
+        "@app:name('Sh')\n"
+        "@app:shard(devices='{d}')\n"
+        "@app:partitionCapacity(size='8')\n"
+        "define stream S (k long, v long);\n"
+        "partition with (k of S) begin\n"
+        "@info(name='p') from S#window.length(4) select k, sum(v) as t "
+        "insert into O;\n"
+        "end;"
+    )
+
+    def test_mesh_size_change_migrates_partitioned_state(self):
+        # [P] state built on a 2-device mesh redeploys onto a 4-device
+        # mesh through the host snapshot; emissions across the rebalance
+        # are byte-identical to a 4-device control run, and the report's
+        # per-device placement proves the new mesh
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(self.V.format(d=2))
+        rows = _collect(rt, "p")
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(16):
+            h.send((i % 4, i), timestamp=i)
+        assert rt._shard.describe_state()["devices"] == 2
+        pre = list(rows)
+        report = mgr.redeploy("Sh", self.V.format(d=4))
+        assert "partition:0:keys" in report["restored"]
+        assert "query:p" in report["restored"]
+        assert report["shard"]["before"]["devices"] == 2
+        assert report["shard"]["after"]["devices"] == 4
+        rt2 = mgr.get_siddhi_app_runtime("Sh")
+        rows2 = _collect(rt2, "p")
+        h2 = rt2.get_input_handler("S")
+        for i in range(16, 32):
+            h2.send((i % 4, i), timestamp=i)
+        placed = rt2._shard.describe_state()["partitioned"]["p"]
+        assert placed == {
+            "sharded": True, "devices": 4, "axis": "part", "local_slots": 2,
+        }
+        mgr.shutdown()
+        # control: the same 32 events on a 4-device mesh from scratch
+        mgr2 = SiddhiManager()
+        c = mgr2.create_siddhi_app_runtime(
+            self.V.format(d=4).replace("'Sh'", "'C'")
+        )
+        crows = _collect(c, "p")
+        c.start()
+        ch = c.get_input_handler("S")
+        for i in range(32):
+            ch.send((i % 4, i), timestamp=i)
+        c.shutdown()
+        mgr2.shutdown()
+        assert pre + rows2 == crows
+
+
+class TestPausedReplay:
+    def _app(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('R')\ndefine stream S (v long);\n"
+            "@info(name='q') from S select v insert into O;"
+        )
+        rows = _collect(rt, "q")
+        rt.start()
+        return mgr, rt, rows
+
+    def _store_entries(self, mgr, n):
+        from siddhi_tpu.core.error_store import ORIGIN_STREAM, make_entry
+
+        for i in range(n):
+            mgr.error_store.store(make_entry(
+                "R", ORIGIN_STREAM, "S", RuntimeError("boom"),
+                events=[(i, (-(i + 1),))],
+            ))
+
+    def _patch_live_send_mid_replay(self, mgr, rt, live_rows):
+        """After each replayed entry, a HELPER thread sends one live row —
+        live mode interleaves it, paused mode holds it behind the backlog."""
+        orig = rt.replay_error
+        it = iter(live_rows)
+
+        def patched(entry):
+            ok = orig(entry)
+            v = next(it, None)
+            if v is not None:
+                t = threading.Thread(
+                    target=lambda: rt.get_input_handler("S").send(
+                        (v,), timestamp=1000 + v
+                    )
+                )
+                t.start()
+                t.join(timeout=30)
+            return ok
+
+        rt.replay_error = patched
+
+    def test_paused_mode_strict_stored_order(self):
+        mgr, rt, rows = self._app()
+        self._store_entries(mgr, 4)
+        self._patch_live_send_mid_replay(mgr, rt, [10, 11, 12, 13])
+        n = mgr.replay_errors(mode="paused")
+        assert n == 4
+        # every replayed row lands BEFORE every held live row, and the
+        # live rows resume in their arrival order
+        assert [v for (v,) in rows] == [-1, -2, -3, -4, 10, 11, 12, 13]
+        assert rt.junctions["S"].ingress_gate is None  # gate removed
+        mgr.shutdown()
+
+    def test_live_mode_interleaves(self):
+        mgr, rt, rows = self._app()
+        self._store_entries(mgr, 4)
+        self._patch_live_send_mid_replay(mgr, rt, [10, 11, 12, 13])
+        n = mgr.replay_errors()  # default mode='live'
+        assert n == 4
+        got = [v for (v,) in rows]
+        assert sorted(got) == [-4, -3, -2, -1, 10, 11, 12, 13]
+        # the live sends dispatched immediately: at least one live row sits
+        # BEFORE the last replayed row
+        assert got != [-1, -2, -3, -4, 10, 11, 12, 13]
+        mgr.shutdown()
+
+
+class TestChurnObservability:
+    def test_status_explain_and_prometheus(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            "@app:name('Obs')\ndefine stream S (v long);\n"
+            "@info(name='q') from S select v insert into O;"
+        )
+        rt.start()
+        rt.add_query("@info(name='h') from S[v > 0] select v insert into O2;")
+        rt.remove_query("h")
+        st = rt.snapshot_status()["churn"]
+        assert st["deploys"] == 1 and st["undeploys"] == 1
+        assert "last_splice_ms" in st
+        assert st["last_seed"] == {"query:h": "cold"}
+        plan = rt.explain(fmt="dict")
+        assert plan["churn"]["deploys"] == 1
+        text = rt.explain()
+        assert "churn: deploys=1 undeploys=1" in text
+        prom = mgr.prometheus_text()
+        assert 'siddhi_churn_total{app="Obs",op="deploy"} 1' in prom
+        assert 'siddhi_churn_total{app="Obs",op="undeploy"} 1' in prom
+        rt.shutdown()
+        mgr.shutdown()
+
+
+class TestSA130:
+    def test_analyze_add_query_reports_all(self):
+        from siddhi_tpu.analysis import analyze_add_query
+
+        app = (
+            "define stream S (v long);\n"
+            "@info(name='q') from S select v insert into Out;"
+        )
+        res = analyze_add_query(
+            app, "@info(name='q') from Nope select v insert into O2;"
+        )
+        codes = [d.code for d in res.errors]
+        assert codes == ["SA130", "SA130"]
+        msgs = " | ".join(d.message for d in res.errors)
+        assert "duplicate query name 'q'" in msgs
+        assert "undeclared stream 'Nope'" in msgs
+
+    def test_unnamed_candidate_flagged(self):
+        from siddhi_tpu.analysis import analyze_add_query
+
+        res = analyze_add_query(
+            "define stream S (v long);",
+            "from S select v insert into Out;",
+        )
+        assert [d.code for d in res.errors] == ["SA130"]
+        assert "@info" in res.errors[0].message
+
+    def test_clean_candidate_ok(self):
+        from siddhi_tpu.analysis import analyze_add_query
+
+        res = analyze_add_query(
+            "define stream S (v long);",
+            "@info(name='n') from S select v insert into Out;",
+        )
+        assert res.ok

@@ -193,13 +193,23 @@ class TestPartitionInteriorGolden:
         assert got == [("IBM", 70.0), ("ORACLE", 30.0), ("WSO2", 700.0)], got
 
     def test_absent_pattern_in_partition(self):
-        # per-key absent: only the key with no follow-up B emits
-        import time as _t
-
+        # per-key absent: only the key with no follow-up B emits.
+        # Deterministic via the playback (event-time) clock with NO idle
+        # heartbeat: the absent kill is decided device-side against event
+        # time (B's ts 220 precedes IBM's deadline 350), and the deadline
+        # TIMERs fire synchronously when the final event advances the
+        # virtual clock past them. Wall-clock stamps raced both ways on
+        # slow CPU backends: each partitioned vmapped dispatch costs tens
+        # of wall-ms, so the 150 ms window could expire before B's send
+        # was even processed (IBM's late-B emission then being CORRECT
+        # absent2 semantics) — and with explicit past timestamps under the
+        # wall-clock scheduler, the already-due deadline fired from the
+        # scheduler thread before B's send landed.
         from siddhi_tpu import SiddhiManager
 
         mgr = SiddhiManager()
         rt = mgr.create_siddhi_app_runtime("""
+        @app:playback()
         define stream A (symbol string, price float);
         define stream B (symbol string, price float);
         partition with (symbol of A, symbol of B)
@@ -215,14 +225,14 @@ class TestPartitionInteriorGolden:
         rt.start()
         ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
         # warm both streams' compiled steps with inert rows
-        ha.send(("W", 5.0)); hb.send(("W", 5.0))
-        ha.send(("IBM", 50.0))
-        ha.send(("WSO2", 60.0))
-        hb.send(("IBM", 90.0))   # kills IBM's absent wait; WSO2's survives
-        # the first timer fire compiles the vmapped timer step — poll
-        t0 = _t.time()
-        while not got and _t.time() - t0 < 30.0:
-            _t.sleep(0.1)
+        ha.send(("W", 5.0), timestamp=100)
+        hb.send(("W", 5.0), timestamp=110)
+        ha.send(("IBM", 50.0), timestamp=200)    # deadline: 350
+        ha.send(("WSO2", 60.0), timestamp=210)   # deadline: 360
+        hb.send(("IBM", 90.0), timestamp=220)    # kills IBM's wait; WSO2's survives
+        # advance the virtual clock past both deadlines: the event-time
+        # scheduler fires the TIMERs synchronously before this send returns
+        ha.send(("Z", 5.0), timestamp=1000)
         rt.shutdown()
         mgr.shutdown()
         assert got == [("WSO2",)], got

@@ -22,9 +22,20 @@ Duplicates are EXPECTED (events between the last checkpoint and the kill
 re-run after restore — at-least-once), silent loss is not: dedup-by-seq
 must recover exactly the control outputs.
 
+Churn leg (`--churn`): the chaos child ALSO hot-deploys/undeploys queries
+while the feed runs (core/churn.py `add_query`/`remove_query` at fixed
+sequence points, printing `splicing K` markers), and the parent SIGKILLs
+it on a mid-feed splice marker — so the kill lands around a live splice.
+The resume child restores from the last auto-checkpoint (whose snapshot
+may contain hot-query elements the rebuilt base app does not know —
+restore must skip them, never tear) and re-runs the churn schedule for
+the remaining sequences. The diff contract is unchanged and PROVES churn
+consistency: the surviving base query's outputs are byte-identical to a
+churn-free control (dedup by seq), and no STORE'd sink event is lost.
+
 Usage:
-    python tools/chaos_smoke.py [--events N] [--dir D] [--json]
-    python tools/chaos_smoke.py child --dir D --events N [--resume]
+    python tools/chaos_smoke.py [--events N] [--dir D] [--json] [--churn]
+    python tools/chaos_smoke.py child --dir D --events N [--resume] [--churn]
 """
 
 from __future__ import annotations
@@ -54,6 +65,36 @@ from S#window.length(8) select seq, sum(v) as total insert into Out;
 @info(name='m')
 from S select 0 as k, seq as s update or insert into M on M.k == k;
 """
+
+
+# churn schedule for the --churn child: seq -> (op, hot query id). Exact
+# seq matches only, so a resumed child skips ops its predecessor already
+# passed and re-runs the ones still ahead of its start_seq.
+CHURN_OPS = {
+    60: ("add", "hot1"),
+    120: ("remove", "hot1"),
+    180: ("add", "hot2"),
+    240: ("remove", "hot2"),
+}
+
+
+def _churn_op(rt, op: str, qid: str, hot_f, splice_no: int) -> None:
+    """One scheduled churn op with mid-splice markers the parent kills on."""
+    print(f"splicing {splice_no} {op} {qid}", flush=True)
+    if op == "add":
+        rt.add_query(
+            f"@info(name='{qid}') from S[seq % 2 == 0] "
+            "select seq, v insert into HotOut;"
+        )
+        rt.add_callback(qid, lambda ts, ins, rem, _q=qid: [
+            hot_f.write(json.dumps(
+                {"q": _q, "seq": e.data[0], "v": e.data[1]}
+            ) + "\n")
+            for e in ins or []
+        ])
+    elif qid in rt.queries:  # a resumed child never deployed this one
+        rt.remove_query(qid)
+    print(f"spliced {splice_no} {op} {qid}", flush=True)
 
 
 def _child(args) -> int:
@@ -97,8 +138,14 @@ def _child(args) -> int:
         replayed = mgr.replay_errors(skip_unavailable=True)
         print(f"resumed from seq {start_seq}, replayed {replayed}",
               flush=True)
+    hot_f = open(os.path.join(d, "hot.jsonl"), "a", buffering=1)
+    splice_no = 0
     h = rt.get_input_handler("S")
     for seq in range(start_seq, args.events + 1):
+        if args.churn and seq in CHURN_OPS:
+            op, qid = CHURN_OPS[seq]
+            splice_no += 1
+            _churn_op(rt, op, qid, hot_f, splice_no)
         h.send((seq, seq % 10), timestamp=seq)
         print(f"fed {seq}", flush=True)  # the parent kills on this marker
         time.sleep(0.002)
@@ -125,7 +172,7 @@ def _read_jsonl(path):
     return out
 
 
-def _spawn(d, events, resume=False, env_extra=None):
+def _spawn(d, events, resume=False, env_extra=None, churn=False):
     env = dict(os.environ)
     env.pop("SIDDHI_TPU_FAULTS", None)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -137,15 +184,23 @@ def _spawn(d, events, resume=False, env_extra=None):
     ]
     if resume:
         cmd.append("--resume")
+    if churn:
+        cmd.append("--churn")
     return subprocess.Popen(
         cmd, env=env, cwd=os.path.dirname(os.path.dirname(__file__)) or ".",
         stdout=subprocess.PIPE, text=True,
     )
 
 
-def run_chaos(events: int = 300, base_dir: str | None = None) -> dict:
+def run_chaos(
+    events: int = 300, base_dir: str | None = None, churn: bool = False
+) -> dict:
     """Run the full control/kill/resume/diff sequence; returns the result
-    dict (raises AssertionError on contract violation)."""
+    dict (raises AssertionError on contract violation). With `churn=True`
+    the chaos children hot-deploy/undeploy queries while feeding and the
+    SIGKILL lands on a mid-feed splice marker — the diff then proves the
+    surviving query's outputs ride through live churn AND a crash around
+    a splice byte-identically."""
     import tempfile
 
     base = base_dir or tempfile.mkdtemp(prefix="chaos_smoke_")
@@ -154,13 +209,16 @@ def run_chaos(events: int = 300, base_dir: str | None = None) -> dict:
     os.makedirs(ctl_dir, exist_ok=True)
     os.makedirs(chaos_dir, exist_ok=True)
 
-    # 1. control
+    # 1. control: churn-free — the base query's outputs must be identical
+    # WHETHER OR NOT the chaos runs churned (the splice parity contract)
     p = _spawn(ctl_dir, events)
     out, _ = p.communicate(timeout=600)
     assert p.returncode == 0, f"control run failed:\n{out}"
 
-    # 2. chaos run 1: injected sink outages + SIGKILL mid-feed
-    p = _spawn(chaos_dir, events, env_extra={
+    # 2. chaos run 1: injected sink outages + SIGKILL mid-feed (churn mode:
+    # on the second splice marker, so the kill lands around a live splice
+    # with one hot query's deploy already committed)
+    p = _spawn(chaos_dir, events, churn=churn, env_extra={
         "SIDDHI_TPU_FAULTS": "seed=7;sink_publish@Chaos:after=25,times=5",
     })
     kill_at = events // 2
@@ -176,7 +234,13 @@ def run_chaos(events: int = 300, base_dir: str | None = None) -> dict:
     watchdog.start()
     try:
         for line in p.stdout:
-            if line.startswith("fed ") and int(line.split()[1]) >= kill_at:
+            if churn and line.startswith("splicing 2 "):
+                p.send_signal(signal.SIGKILL)
+                killed = True
+                break
+            if not churn and line.startswith("fed ") and int(
+                line.split()[1]
+            ) >= kill_at:
                 p.send_signal(signal.SIGKILL)
                 killed = True
                 break
@@ -185,6 +249,17 @@ def run_chaos(events: int = 300, base_dir: str | None = None) -> dict:
     p.wait(timeout=60)
     assert not hung.is_set(), "chaos run 1 hung before the kill point"
     assert killed, "chaos run 1 exited before the kill point"
+    hot_rows_before_kill = 0
+    if churn:
+        # the first hot deploy committed before the kill: the hot query
+        # must have produced rows while deployed (counted NOW — the
+        # resume child appends to the same file)
+        hot_rows_before_kill = len(
+            _read_jsonl(os.path.join(chaos_dir, "hot.jsonl"))
+        )
+        assert hot_rows_before_kill, (
+            "no hot-query output before the mid-splice kill"
+        )
 
     # the kill must have left durable state behind: checkpoints + stored
     # sink payloads (FileErrorStore JSONL survives SIGKILL)
@@ -199,12 +274,18 @@ def run_chaos(events: int = 300, base_dir: str | None = None) -> dict:
         "the injected sink outages stored nothing before the kill"
     )
 
-    # 3. chaos run 2: restore + replay + finish (no faults)
-    p = _spawn(chaos_dir, events, resume=True)
+    # 3. chaos run 2: restore + replay + finish (no faults). In churn mode
+    # the restore consumes a checkpoint that may carry hot-query elements
+    # the rebuilt base app does not define — landing on a CONSISTENT (old)
+    # runtime, never a torn one — and the remaining churn schedule re-runs.
+    p = _spawn(chaos_dir, events, resume=True, churn=churn)
     out, _ = p.communicate(timeout=600)
     assert p.returncode == 0, f"resume run failed:\n{out}"
     resumed_line = next(
         (ln for ln in out.splitlines() if ln.startswith("resumed")), ""
+    )
+    resume_splices = sum(
+        1 for ln in out.splitlines() if ln.startswith("spliced ")
     )
 
     # 4. diff against control, dedup by seq
@@ -247,9 +328,9 @@ def run_chaos(events: int = 300, base_dir: str | None = None) -> dict:
         f"STORE'd sink events lost across the crash: {sorted(lost_sink)[:10]}"
     )
 
-    return {
+    result = {
         "events": events,
-        "killed_at": kill_at,
+        "killed_at": "splicing 2" if churn else kill_at,
         "checkpoints_after_kill": len(snaps),
         "stored_entries_before_resume": stored_before,
         "resume": resumed_line,
@@ -258,6 +339,16 @@ def run_chaos(events: int = 300, base_dir: str | None = None) -> dict:
         "sink_seqs_recovered": len(chaos_sink),
         "parity": "ok",
     }
+    if churn:
+        result["churn"] = {
+            "hot_rows_before_kill": hot_rows_before_kill,
+            "resume_splices": resume_splices,
+        }
+        assert resume_splices >= 1, (
+            "the resumed child re-ran no churn ops — the schedule should "
+            "still have splices ahead of the restore point"
+        )
+    return result
 
 
 def main() -> int:
@@ -266,11 +357,12 @@ def main() -> int:
     ap.add_argument("--dir")
     ap.add_argument("--events", type=int, default=300)
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--churn", action="store_true")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
     if args.mode == "child":
         return _child(args)
-    result = run_chaos(events=args.events, base_dir=args.dir)
+    result = run_chaos(events=args.events, base_dir=args.dir, churn=args.churn)
     print(json.dumps(result) if args.json else
           "chaos smoke OK: " + json.dumps(result, indent=1))
     return 0
