@@ -487,7 +487,7 @@ def _leg_timebudget(batch=32768) -> dict:
             tstates = {}
             for ep in fi.endpoints:
                 tstates.update(ep.qr._collect_table_states())
-            ns, _t, _a, _p = fi._fused(
+            ns, _t, _a, _lin, _p = fi._fused(
                 tuple(states), tstates, w, counts, bases,
                 np.int64(1_700_000_000_000))
             for ep, st in zip(fi.endpoints, ns):

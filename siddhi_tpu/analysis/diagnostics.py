@@ -66,6 +66,8 @@ CODES: dict[str, str] = {
              "unknown axis / unknown option)",
     "SA130": "hot add_query candidate conflicts with the live app "
              "(missing @info name / duplicate query id / undeclared stream)",
+    "SA131": "invalid @app:lineage annotation (bad capacity / unknown mode "
+             "/ bad sample.every / unknown option)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

@@ -137,6 +137,23 @@ def _check_shard_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
         diags.append(Diagnostic("SA129", problem))
 
 
+def _check_lineage_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
+    """Validate `@app:lineage(capacity='N', mode='full|sample',
+    sample.every='K')` — event lineage & provenance. One SA131 per
+    malformed element, using the SAME rule set the runtime resolver raises
+    on (observability/lineage.py iter_lineage_annotation_problems), so the
+    two can never drift."""
+    ann = find_annotation(app.annotations, "app:lineage")
+    if ann is None:
+        return
+    from siddhi_tpu.observability.lineage import (
+        iter_lineage_annotation_problems,
+    )
+
+    for problem in iter_lineage_annotation_problems(ann):
+        diags.append(Diagnostic("SA131", problem))
+
+
 def _check_supervision_annotations(
     app: SiddhiApp, diags: list[Diagnostic]
 ) -> None:
@@ -284,6 +301,7 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
     _apply_selfmon_annotation(app, sym, diags)
     _check_fuse_annotation(app, diags)
     _check_shard_annotation(app, diags)
+    _check_lineage_annotation(app, diags)
     _check_supervision_annotations(app, diags)
 
     return sym

@@ -169,6 +169,16 @@ class AggregationRuntime:
             self.ts_expr = c
         else:
             self.ts_expr = None
+        # lineage recorder (observability/lineage.py AggregationLineage):
+        # per-bucket contributing seq ranges; None = one check per receive
+        self.lineage = None
+        from siddhi_tpu.query_api.expression import Variable as _Var
+
+        self._lin_ts_attr = (
+            definition.aggregate_attribute.attribute
+            if isinstance(definition.aggregate_attribute, _Var)
+            else None
+        )
 
         self.durations: list[Duration] = list(definition.time_period.durations)
 
@@ -675,7 +685,34 @@ class AggregationRuntime:
             out["durations"] = None  # mid-dispatch buffer churn: degrade
         return out
 
+    def arm_lineage(self, cfg) -> None:
+        """Enable per-bucket provenance (@app:lineage): contributing seq
+        ranges + counts per finest-duration time bucket. Host-side only —
+        aggregations always ride the per-batch dispatch path."""
+        from siddhi_tpu.observability.lineage import AggregationLineage
+
+        self.lineage = AggregationLineage(
+            cfg, self.agg_id, self.stream_id, self.durations[0]
+        )
+
     def receive(self, batch: EventBatch, now: int):
+        lin = self.lineage
+        if lin is not None:
+            try:
+                import numpy as _np
+
+                ts_col = (
+                    _np.asarray(batch.cols[self._lin_ts_attr]).astype("int64")
+                    if self._lin_ts_attr is not None
+                    else None
+                )
+                lin.observe_batch(batch, ts_col)
+            except Exception:  # provenance must never break dispatch
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "aggregation lineage observe failed", exc_info=True
+                )
         tstates = {t.table_id: t.state for t in self.tables.values()}
         new_state, aux, tstates = self._step_full(batch, now, tstates)
         self.state = new_state

@@ -141,6 +141,25 @@ class SiddhiAppRuntime:
         self._fuse_enabled = resolve_fuse_annotation(
             find_annotation(app.annotations, "app:fuse")
         )
+        # event lineage & provenance: @app:lineage(capacity='N',
+        # mode='full|sample') (observability/lineage.py; malformed options
+        # raise here — the runtime analog of the analyzer's SA131).
+        # Resolved BEFORE any junction/query construction: arenas arm in
+        # _junction() and recorders in _add_query*, all ahead of the first
+        # trace so the `__lin.*` lane structure is part of every program.
+        from siddhi_tpu.observability.lineage import (
+            LineageLedger,
+            resolve_lineage_annotation,
+        )
+
+        self._lineage_cfg = resolve_lineage_annotation(
+            find_annotation(app.annotations, "app:lineage")
+        )
+        self.lineage_ledger = (
+            LineageLedger(self, self._lineage_cfg)
+            if self._lineage_cfg is not None
+            else None
+        )
         # first-class sharded execution: @app:shard(devices='N', axis=...)
         # / SIDDHI_TPU_SHARD (parallel/shard.py; malformed options raise
         # here — the runtime analog of the analyzer's SA129). Resolved now,
@@ -464,6 +483,8 @@ class SiddhiAppRuntime:
             ar = AggregationRuntime(
                 ad, in_schema, self.interner, group_capacity=agg_groups
             )
+            if self._lineage_cfg is not None:
+                ar.arm_lineage(self._lineage_cfg)
             self.aggregations[aid] = ar
             for t in ar.tables.values():
                 self.tables[t.table_id] = t
@@ -637,6 +658,11 @@ class SiddhiAppRuntime:
             env_n = flight_env_size()
             if env_n:
                 j.enable_flight(env_n)
+            # @app:lineage arms a seq-stamping arena on EVERY junction —
+            # internal insert-into targets and fault streams included, so
+            # multi-hop resolution can walk any chain
+            if self._lineage_cfg is not None:
+                j.enable_lineage(self._lineage_cfg.capacity)
             self.junctions[stream_id] = j
         return j
 
@@ -697,6 +723,7 @@ class SiddhiAppRuntime:
                 and not _t.stream_callbacks
                 and _t.on_publish_stats is None
                 and _t.flight is None
+                and _t.lineage is None
             ):
                 return  # nobody downstream: skip the transform dispatch
             _t.publish_batch(rename(transform(out_batch)), now)
@@ -755,6 +782,24 @@ class SiddhiAppRuntime:
             "(@OnError action='LOG'): %s", tid, exc, exc_info=exc,
         )
 
+    def _wire_query_lineage(self, qr) -> None:
+        """Arm the query's provenance recorder when @app:lineage is on.
+        Runs at construction time — BEFORE anything can trace the jitted
+        step, so the `__lin.*` lane structure is part of every program
+        (hot-deployed queries ride the same path via _add_query*)."""
+        cfg = self._lineage_cfg
+        if cfg is None:
+            return
+        try:
+            qr.arm_lineage(cfg)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "lineage could not be armed for query '%s'",
+                getattr(qr, "query_id", "?"), exc_info=True,
+            )
+
     def _wire_query_stats(self, qr, qid: str):
         """Attach latency + device-budget trackers to a query runtime;
         returns the latency tracker (or None with statistics off)."""
@@ -810,6 +855,7 @@ class SiddhiAppRuntime:
             group_capacity=self.group_capacity,
             tables=self.tables,
         )
+        self._wire_query_lineage(qr)
         self.queries[qid] = qr
         self._wire_insert(qr)
 
@@ -903,6 +949,7 @@ class SiddhiAppRuntime:
             tables=self.tables,
             pattern_chunk=pattern_chunk or None,
         )
+        self._wire_query_lineage(qr)
         self.queries[qid] = qr
         self._wire_insert(qr)
         decode = self._decode
@@ -933,12 +980,14 @@ class SiddhiAppRuntime:
                 ),
                 name=f"query.{qid}",
             )
-            self._wire_fuse_candidate(sj, FuseEndpoint(
+            ep = FuseEndpoint(
                 qr,
                 impl_factory=lambda _qr=qr, _sid=sid: _qr._make_step(_sid),
                 init_state=lambda now, _qr=qr: _qr.init_state(now),
                 latency_tracker=lt,
-            ))
+            )
+            ep.lineage_tag = sid  # recorder shadows are per input stream
+            self._wire_fuse_candidate(sj, ep)
 
         if qr.needs_scheduler:
             def fire(t_ms: int, _qr=qr) -> None:
@@ -1021,6 +1070,7 @@ class SiddhiAppRuntime:
             tables=self.tables,
             findables={**self.tables, **self.named_windows, **agg_findables},
         )
+        self._wire_query_lineage(qr)
         self.queries[qid] = qr
         self._wire_insert(qr)
         decode = self._decode
@@ -1065,9 +1115,19 @@ class SiddhiAppRuntime:
                 def impl(st, tst, b, now):
                     st, tst, _o1, aux1 = _qr._step_impl(st, tst, b, now, "l")
                     st, tst, out, aux2 = _qr._step_impl(st, tst, b, now, "r")
-                    merged = dict(aux2)
+                    # lineage lanes must NOT be bool-merged across the two
+                    # halves: re-key them side-tagged (`__lin@l.` / `__lin@r.`)
+                    # so the recorder replays l then r, the per-batch order
+                    merged = {}
+                    for side_aux, tag in ((aux1, "l"), (aux2, "r")):
+                        for k, v in side_aux.items():
+                            if k.startswith("__lin."):
+                                merged[f"__lin@{tag}." + k[len("__lin."):]] = v
+                    for k, v in aux2.items():
+                        if not k.startswith("__lin"):
+                            merged[k] = v
                     for k, v in aux1.items():
-                        if k == "next_timer":
+                        if k == "next_timer" or k.startswith("__lin"):
                             continue
                         if k in merged:
                             merged[k] = (
@@ -1108,7 +1168,7 @@ class SiddhiAppRuntime:
                         ),
                         name=f"query.{qid}",
                     )
-                    self._wire_fuse_candidate(sj, FuseEndpoint(
+                    ep = FuseEndpoint(
                         qr,
                         impl_factory=lambda _qr=qr, _s=side: (
                             lambda st, tst, b, now: _qr._step_impl(
@@ -1117,7 +1177,9 @@ class SiddhiAppRuntime:
                         ),
                         init_state=lambda now, _qr=qr: _qr.init_state(),
                         latency_tracker=lt,
-                    ))
+                    )
+                    ep.lineage_tag = side  # recorder side shadows
+                    self._wire_fuse_candidate(sj, ep)
 
         for side, schema in qr.side_schemas.items():
             if qr.needs_scheduler[side]:
@@ -1482,6 +1544,31 @@ class SiddhiAppRuntime:
             for sid, j in list(self.junctions.items())
             if j.flight is not None
         }
+
+    # ---- lineage & provenance (observability/lineage.py) ------------------
+
+    def lineage(self, target: str, index: int | None = None,
+                depth: int = 6) -> dict:
+        """Explain output `index` of `target` back to the exact input
+        events (@app:lineage required). `target` is a query id (index = the
+        query's k-th recorded output row) or a stream id (index = the
+        junction's lineage seq id — its k-th valid CURRENT event); None
+        picks the latest. The chain walks insert-into hops backward and
+        decodes the contributing events from the per-stream arenas."""
+        if self.lineage_ledger is None:
+            raise SiddhiAppCreationError(
+                f"app '{self.name}' has no lineage — enable it with "
+                "@app:lineage(capacity='N')"
+            )
+        return self.lineage_ledger.resolve(target, index, depth)
+
+    def lineage_report(self, resolve_recent: int = 1) -> dict:
+        """The app's /lineage.json payload: per-stream arenas, per-query
+        fan-in + recorded provenance, per-aggregation buckets (empty dict
+        when @app:lineage is off)."""
+        if self.lineage_ledger is None:
+            return {}
+        return self.lineage_ledger.report(resolve_recent=resolve_recent)
 
     def dump_traces(self, path: str | None = None, indent: int = 1) -> str:
         """JSON dump of `traces()`; also written to `path` when given."""

@@ -98,6 +98,10 @@ class ErroneousEvent:
     # at capture time, as (timestamp_ms, data_tuple) pairs (None when the
     # junction has no recorder — see observability/flight.py)
     flight: Optional[list[tuple[int, tuple]]] = None
+    # lineage provenance: the failing batch's contributing seq-id range on
+    # its stream ({stream, seq_lo, seq_hi}; None when @app:lineage is off —
+    # see observability/lineage.py)
+    lineage: Optional[dict] = None
 
 
 class ErrorStore:
@@ -270,6 +274,7 @@ class FileErrorStore(ErrorStore):
             "payload": entry.payload,
             "sink_ref": entry.sink_ref,
             "flight": entry.flight,
+            "lineage": entry.lineage,
         }
         try:
             import json
@@ -468,8 +473,15 @@ class SqliteErrorStore(ErrorStore):
             " events TEXT,"
             " payload TEXT,"
             " sink_ref TEXT NOT NULL DEFAULT '',"
-            " flight TEXT)"
+            " flight TEXT,"
+            " lineage TEXT)"
         )
+        try:
+            # pre-lineage databases lack the new column; the ALTER raises
+            # once it exists, making re-opens idempotent
+            self._conn.execute("ALTER TABLE errors ADD COLUMN lineage TEXT")
+        except sqlite3.OperationalError:
+            pass
         self._conn.execute(
             "CREATE INDEX IF NOT EXISTS errors_app ON errors(app_name)"
         )
@@ -511,6 +523,8 @@ class SqliteErrorStore(ErrorStore):
                 entry.sink_ref,
                 json.dumps(entry.flight, default=str)
                 if entry.flight is not None else None,
+                json.dumps(entry.lineage, default=str)
+                if entry.lineage is not None else None,
             )
             if entry.id:
                 # honor a pre-set id like the other stores do (re-storing a
@@ -523,7 +537,8 @@ class SqliteErrorStore(ErrorStore):
                 self._conn.execute(
                     "INSERT OR REPLACE INTO errors (id, stored_at_ms,"
                     " app_name, origin, stream_id, error, events, payload,"
-                    " sink_ref, flight) VALUES (?,?,?,?,?,?,?,?,?,?)",
+                    " sink_ref, flight, lineage)"
+                    " VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                     (int(entry.id),) + cols,
                 )
                 if not replacing:
@@ -531,8 +546,8 @@ class SqliteErrorStore(ErrorStore):
             else:
                 cur = self._conn.execute(
                     "INSERT INTO errors (stored_at_ms, app_name, origin,"
-                    " stream_id, error, events, payload, sink_ref, flight)"
-                    " VALUES (?,?,?,?,?,?,?,?,?)",
+                    " stream_id, error, events, payload, sink_ref, flight,"
+                    " lineage) VALUES (?,?,?,?,?,?,?,?,?,?)",
                     cols,
                 )
                 entry.id = int(cur.lastrowid)
@@ -558,7 +573,7 @@ class SqliteErrorStore(ErrorStore):
         import json
 
         q = "SELECT id, stored_at_ms, app_name, origin, stream_id, error," \
-            " events, payload, sink_ref, flight FROM errors"
+            " events, payload, sink_ref, flight, lineage FROM errors"
         conds, args = [], []
         for col, v in (
             ("app_name", app_name), ("stream_id", stream_id), ("origin", origin),
@@ -575,7 +590,10 @@ class SqliteErrorStore(ErrorStore):
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()
         out = []
-        for (eid, at, app, origin_, sid, err, events, payload, ref, flight) in rows:
+        for (
+            eid, at, app, origin_, sid, err, events, payload, ref, flight,
+            lineage,
+        ) in rows:
             ev = json.loads(events) if events is not None else None
             if ev is not None:
                 ev = [(int(ts), tuple(row)) for ts, row in ev]
@@ -587,6 +605,7 @@ class SqliteErrorStore(ErrorStore):
                 stream_id=sid, error=err, events=ev,
                 payload=json.loads(payload) if payload is not None else None,
                 cause=None, sink_ref=ref, flight=fl,
+                lineage=json.loads(lineage) if lineage is not None else None,
             ))
         return out
 

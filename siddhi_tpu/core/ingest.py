@@ -177,6 +177,16 @@ class FusedJunctionIngest:
         # — micro-batches round-robin across devices, outputs merged back
         # in batch order. None = one attribute check per send.
         self.shard_router = None
+        # lineage (observability/lineage.py): True when any endpoint has a
+        # recorder armed — the chunk program then returns stacked `__lin.*`
+        # lanes consumed per micro-batch; False = one check per chunk
+        self._lin_any = any(
+            getattr(ep.qr, "lineage", None) is not None
+            for ep in self.endpoints
+        )
+        # sharded sends park observations here keyed by global batch index
+        # so the recorder replays them in original batch order
+        self._lin_pending = None
         ps = getattr(junction, "pipeline_stats", None)
         if ps is not None:
             ps.depth = self.pipeline_depth if self.pipeline_enabled else 0
@@ -330,6 +340,12 @@ class FusedJunctionIngest:
         )
         impls = [ep.impl_factory() for ep in self.endpoints]
         impls_want = [ep.qr.output_events for ep in self.endpoints]
+        # deliver lanes ship only the out-schema columns: a lineage-armed
+        # group-by step carries a __group_key__ col beside its outputs,
+        # which the host deliver layout must never see
+        out_names = [
+            frozenset(ep.qr.out_schema.attr_names) for ep in self.endpoints
+        ]
         share_of = dict(self._share_of)
         share_leader = dict(self._share_leader)
         has_share = bool(self.share_sets)
@@ -351,6 +367,7 @@ class FusedJunctionIngest:
                 new_states = []
                 new_shr = list(shr)
                 auxes = []
+                lins = []
                 outs = []
                 for ei, (impl, st) in enumerate(zip(impls, sts)):
                     g = share_of.get(ei)
@@ -372,8 +389,16 @@ class FusedJunctionIngest:
                             jnp.asarray(v).astype(bool).any()
                             for k, v in sorted(aux.items())
                             if k != "next_timer"
+                            and not k.startswith("__lin")
                         )
                     )
+                    # lineage lanes (observability/lineage.py) bypass the
+                    # boolean aux reduction: the scan STACKS them across
+                    # the K micro-batches for the host recorder
+                    lins.append({
+                        k: v for k, v in aux.items()
+                        if k.startswith("__lin")
+                    })
                     if deliver and ei in deliver_set:
                         # ship the raw lanes + a deliverable-row mask; the
                         # post-scan pack compacts ALL K iterations with one
@@ -401,15 +426,22 @@ class FusedJunctionIngest:
                         if want is _OEF.ALL:
                             lanes["kind"] = out.kind
                         lanes.update(
-                            {f"c.{n}": c for n, c in out.cols.items()}
+                            {
+                                f"c.{n}": c
+                                for n, c in out.cols.items()
+                                if n in out_names[ei]
+                            }
                         )
                         outs.append((lanes, dv))
                 return (
                     ((tuple(new_states), tuple(new_shr)), tst),
-                    (tuple(auxes), tuple(outs)),
+                    (tuple(auxes), tuple(lins), tuple(outs)),
                 )
 
-            ((states, shared), tstates), (aux_stack, out_stack) = lax.scan(
+            (
+                ((states, shared), tstates),
+                (aux_stack, lin_stack, out_stack),
+            ) = lax.scan(
                 body, ((states, shared0), tstates), (wire, counts, bases)
             )
             states_out = (states, shared) if has_share else states
@@ -417,7 +449,7 @@ class FusedJunctionIngest:
                 tuple(v.any() for v in a) for a in aux_stack
             )
             if not deliver:
-                return states_out, tstates, aux_red, ()
+                return states_out, tstates, aux_red, lin_stack, ()
             # pack each endpoint's K compacted segments into ONE contiguous
             # ROW-MAJOR byte buffer [R, row_bytes]: the host drains exactly
             # the filled row prefix with a single contiguous slice transfer
@@ -458,7 +490,7 @@ class FusedJunctionIngest:
                 packs.append(
                     {"buf": jnp.concatenate([hdr, data_buf], axis=0)}
                 )
-            return states_out, tstates, aux_red, tuple(packs)
+            return states_out, tstates, aux_red, lin_stack, tuple(packs)
 
         # donate the per-endpoint states (exclusively owned); tstates may
         # alias read-only findables shared with other runtimes — not donated
@@ -577,6 +609,13 @@ class FusedJunctionIngest:
             fl = self.junction.flight
             if ok and fl is not None:
                 fl.record_columns(ts_arr, cols, n)
+            la = self.junction.lineage
+            if ok and la is not None:
+                # lineage stamp: the fused commit is this send's one
+                # publish — same once-per-commit contract as the flight
+                # ring (a False return re-sends per batch, whose
+                # publish_batch stamps instead)
+                la.record_columns(ts_arr, cols, n)
             if not ok:
                 return False
             if self.residual:
@@ -681,7 +720,7 @@ class FusedJunctionIngest:
 
     def _dispatch_chunk(
         self, prog, wire, counts, bases, now, ds, tracked, tr, stream_span,
-        ps=None, wf=None, deliver=False,
+        ps=None, wf=None, deliver=False, lin_ks=None,
     ):
         """One donated-state dispatch under the app lock: collect states,
         run the program, write back, publish stats, surface aux flags.
@@ -725,7 +764,7 @@ class FusedJunctionIngest:
                 # chunk-program explosion takes
                 if _faults.ACTIVE is not None:
                     _faults.ACTIVE.check("device_dispatch", self.component)
-                new_all, tstates, aux_red, packs = prog(
+                new_all, tstates, aux_red, lin_stack, packs = prog(
                     arg0, tstates, wire,
                     counts, bases, np.int64(now),
                 )
@@ -737,6 +776,9 @@ class FusedJunctionIngest:
                         ds.step.record_ns(dt)
                         ds.h2d_bytes.add(int(wire.nbytes))
                         ds.h2d_chunks.add(1)
+                        # live roofline numerator/denominator pair: the
+                        # always-on wire bytes/event gauge rides these
+                        ds.h2d_events.add(int(counts.sum()))
                     if ps is not None:
                         ps.dispatch.record_ns(dt)
                     if wf is not None:
@@ -790,6 +832,12 @@ class FusedJunctionIngest:
             flags = dict(zip(self._aux_keys[i], aux_red[i]))
             if flags:
                 ep.qr._warn_aux(flags)
+        if self._lin_any:
+            # provenance readback (one d2h when lineage is on): feed each
+            # armed endpoint's recorder per micro-batch, in chunk order —
+            # or park with the global batch index when the shard router
+            # dispatches chunks round-robin (see _lin_begin_send)
+            self._lin_observe_chunk(lin_stack, counts, now, lin_ks)
         # completion: ONLY leaves that are never donated to a later dispatch
         # (aux flags, output packs, table states). The query states are
         # donated at the NEXT dispatch's submit — which deletes the array
@@ -799,6 +847,59 @@ class FusedJunctionIngest:
         # buffer instead of reusing it.
         leaves = jax.tree_util.tree_leaves((aux_red, packs, tstates))
         return packs, (leaves[0] if leaves else None)
+
+    # ---- lineage observation (observability/lineage.py) ------------------
+
+    def _lin_observe_chunk(self, lin_stack, counts, now, lin_ks=None) -> None:
+        """Feed each armed endpoint's recorder the chunk's stacked `__lin.*`
+        lanes, one micro-batch at a time. With `lin_ks` (the sharded
+        router's global batch indices for this chunk) observations are
+        parked for the in-order replay at _lin_end_send()."""
+        import numpy as _np
+
+        K = int(counts.shape[0])
+        for i, ep in enumerate(self.endpoints):
+            lin = getattr(ep.qr, "lineage", None)
+            stacks = lin_stack[i] if i < len(lin_stack) else None
+            if lin is None or not stacks:
+                continue
+            host = {k: _np.asarray(v) for k, v in stacks.items()}
+            tag = getattr(ep, "lineage_tag", None)
+            for k in range(K):
+                if int(counts[k]) == 0:
+                    continue  # padding iteration: no valid rows
+                lanes = {kk: v[k] for kk, v in host.items()}
+                if lin_ks is not None and self._lin_pending is not None:
+                    self._lin_pending.append(
+                        (int(lin_ks[k]), i, lin, lanes, now, tag)
+                    )
+                else:
+                    self._lin_observe_one(lin, lanes, now, tag)
+
+    @staticmethod
+    def _lin_observe_one(lin, lanes, now, tag) -> None:
+        try:
+            lin.observe(lanes, now, tag)
+        except Exception:  # provenance must never break dispatch
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "fused lineage observe failed", exc_info=True
+            )
+
+    def _lin_begin_send(self) -> None:
+        if self._lin_any:
+            self._lin_pending = []
+
+    def _lin_end_send(self) -> None:
+        pend, self._lin_pending = self._lin_pending, None
+        if pend:
+            # original batch order, then endpoint order — exactly the
+            # single-device chunk loop's observation order
+            for _k, _i, lin, lanes, now, tag in sorted(
+                pend, key=lambda x: (x[0], x[1])
+            ):
+                self._lin_observe_one(lin, lanes, now, tag)
 
     # ---- cross-query state sharing (plan share sets) ---------------------
 
@@ -1380,4 +1481,8 @@ class FusedJunctionIngest:
         closed = jax.eval_shape(
             lambda s, t, bb: impl(s, t, bb, np.int64(0))[3], st, tst, batch
         )
-        return sorted(k for k in closed.keys() if k != "next_timer")
+        return sorted(
+            k
+            for k in closed.keys()
+            if k != "next_timer" and not k.startswith("__lin")
+        )

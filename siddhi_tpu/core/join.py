@@ -73,6 +73,9 @@ class NoWindow(WindowStage):
         }
         return cols, jnp.zeros((1,), jnp.int64), jnp.zeros((1,), jnp.bool_)
 
+    def view_seq(self, state):
+        return jnp.full((1,), -1, jnp.int64)
+
 
 class TableSide:
     """A join side backed by a shared findable: a table (reference:
@@ -104,6 +107,9 @@ class TableSide:
 
     def probe_view(self, state_slice, tstates):
         return self.table.view(tstates[self.table.table_id])
+
+    def probe_seq(self, state_slice):
+        return None  # findables carry no admission order
 
 
 class JoinSide:
@@ -151,6 +157,10 @@ class JoinSide:
 
     def probe_view(self, state_slice, tstates):
         return self.window.view(state_slice)
+
+    def probe_seq(self, state_slice):
+        """Window admission seq per view slot (lineage), or None."""
+        return self.window.view_seq(state_slice)
 
     def filter_batch(self, batch: EventBatch, now) -> EventBatch:
         if not self.pre_filters:
@@ -231,6 +241,11 @@ class CompiledJoin:
             if cond.type is not AttrType.BOOL:
                 raise SiddhiAppCreationError("join 'on' must be a boolean expression")
             self.on = cond
+        # lineage (observability/lineage.py): when True the step emits
+        # `__lin.*` aux lanes — per matched output row the probe-row index
+        # and the partner ring's admission seq. Set by
+        # JoinQueryRuntime.arm_lineage before the first trace.
+        self.lineage = False
 
     def init_state(self):
         return {"l": self.left.init_state(), "r": self.right.init_state()}
@@ -245,8 +260,19 @@ class CompiledJoin:
         emits = self.emit_left if side == "l" else self.emit_right
         batch = arr.filter_batch(batch, now)
         aux: dict = {}
+        if self.lineage:
+            from siddhi_tpu.observability.lineage import LIN
+
+            # the arriving side's window admissions: its filter-passing
+            # CURRENT rows (table/named-window arrivals never re-buffer)
+            aux[LIN + "admit"] = (
+                batch.valid & (batch.kind == KIND_CURRENT)
+                if not arr.is_table
+                else jnp.zeros_like(batch.valid)
+            )
 
         vcols, vts, vmask = other.probe_view(state[other_key], tstates or {})
+        vseq = other.probe_seq(state[other_key]) if self.lineage else None
 
         # probe 1: arriving CURRENT rows against the other window
         # (reference: preJoinProcessor — probe happens BEFORE own-window insert)
@@ -274,7 +300,8 @@ class CompiledJoin:
             probes = []
 
         joined = self._assemble(
-            probes, arr, other, vcols, vts, vmask, now, side, aux, tstates
+            probes, arr, other, vcols, vts, vmask, now, side, aux, tstates,
+            vseq=vseq,
         )
 
         new_state = dict(state)
@@ -282,7 +309,8 @@ class CompiledJoin:
         return new_state, joined, aux
 
     def _assemble(
-        self, probes, arr, other, vcols, vts, vmask, now, side, aux, tstates=None
+        self, probes, arr, other, vcols, vts, vmask, now, side, aux,
+        tstates=None, vseq=None,
     ):
         """Evaluate the on-condition for each probe set, compact matched pairs
         (plus outer misses) into one fixed-capacity joined Flow."""
@@ -350,6 +378,28 @@ class CompiledJoin:
         def partner_col(name, t):
             base = vcols[name][pj]
             return jnp.where(is_null_partner, np.asarray(null_value(t), base.dtype), base)
+
+        if self.lineage:
+            from siddhi_tpu.observability.lineage import LIN
+
+            # per matched output row: the triggering probe-row index and
+            # the partner window's admission seq (-1 = null/unknown) —
+            # the host recorder turns these into the (left seq, right seq)
+            # provenance pair (observability/lineage.py JoinQueryLineage)
+            aux[LIN + "j_pi"] = jnp.where(valid_out, pi, np.int32(-1))
+            if vseq is not None:
+                aux[LIN + "j_pseq"] = jnp.where(
+                    valid_out & ~is_null_partner, vseq[pj], np.int64(-1)
+                )
+            else:
+                # no admission order on this partner (batch window, table,
+                # named window): -2 = "partner unknown" — the recorder
+                # flags the record approximate, distinct from -1 = "outer
+                # join, legitimately no partner"
+                aux[LIN + "j_pseq"] = jnp.where(
+                    valid_out & ~is_null_partner,
+                    np.int64(-2), np.int64(-1),
+                )
 
         arr_out = {n: c[pi] for n, c in row_cols.items()}
         other_out = {
@@ -493,12 +543,36 @@ class JoinQueryRuntime(BaseQueryRuntime):
                 )
         return d
 
+    def arm_lineage(self, cfg) -> None:
+        """Enable provenance recording (@app:lineage): the join step emits
+        `__lin.*` lanes — (probe row, partner ring seq) per matched output
+        row — feeding a JoinQueryLineage. Must run before the first trace;
+        emissions are untouched."""
+        from siddhi_tpu.observability.lineage import JoinQueryLineage
+
+        self.join.lineage = True
+        self.lineage = JoinQueryLineage(
+            cfg, self.query_id, self._published_kinds(),
+            left_stream=self.join.left.stream_id,
+            right_stream=self.join.right.stream_id,
+            batch_capacity=0,  # recorder sizes probes off the in-lane
+        )
+
     def _step_impl(self, state, tstates, batch: EventBatch, now, side: str):
         jstate, flow, aux = self.join.step(state["join"], batch, now, side, tstates)
         sel_state, out = self.selector.apply(state["sel"], flow)
         if self.table_op is not None:
             tstates = self.table_op(tstates, out, now, flow.aux)
         aux.update(flow.aux)
+        if self.lineage is not None:
+            from siddhi_tpu.core.event import KIND_CURRENT
+            from siddhi_tpu.observability.lineage import LIN
+
+            aux[LIN + "in"] = batch.valid & (batch.kind == KIND_CURRENT)
+            aux[LIN + "in_ts"] = batch.ts
+            aux[LIN + "out_valid"] = out.valid
+            aux[LIN + "out_kind"] = out.kind
+            aux[LIN + "out_ts"] = out.ts
         return {"join": jstate, "sel": sel_state}, tstates, out, aux
 
     def receive(self, batch: EventBatch, now: int, side: str):
@@ -522,5 +596,9 @@ class JoinQueryRuntime(BaseQueryRuntime):
                     _time.perf_counter_ns() - t0,
                 )
             self._writeback_table_states(tstates)
+            lin = self.lineage
+            if lin is not None:
+                # under the receive lock: recorder order == dispatch order
+                aux = self._lin_observe(lin, aux, now, tag=side)
         self._warn_aux(aux)
         return out, aux

@@ -401,6 +401,25 @@ class SiddhiManager:
                 out[name] = recs
         return out
 
+    def lineage_reports(self, resolve_recent: int = 1) -> dict:
+        """Every lineage-enabled app's provenance report: app -> per-stream
+        arenas, per-query fan-in + recent resolved chains (`/lineage.json`)."""
+        out = {}
+        for name, rt in list(self._runtimes.items()):
+            rep = rt.lineage_report(resolve_recent=resolve_recent)
+            if rep:
+                out[name] = rep
+        return out
+
+    def lineage_text(self) -> str:
+        """Human-readable lineage summary for every app (`/lineage`)."""
+        from siddhi_tpu.observability.lineage import render_lineage_text
+
+        reports = self.lineage_reports()
+        if not reports:
+            return "no lineage-enabled apps (add @app:lineage)\n"
+        return render_lineage_text(reports)
+
     def persist(self) -> None:
         for rt in self._runtimes.values():
             rt.persist()

@@ -95,7 +95,8 @@ class PatternQueryRuntime(BaseQueryRuntime):
             batch_mode=False,
             group_capacity=group_capacity,
         )
-        self.prog.set_capture_readers(frozenset(sel_scope.used_keys))
+        self._sel_used_keys = frozenset(sel_scope.used_keys)
+        self.prog.set_capture_readers(self._sel_used_keys)
         self._setup_output(query, query_id)
         self._attach_tables(tables, interner)
         self._scope = self.prog.scope
@@ -106,6 +107,25 @@ class PatternQueryRuntime(BaseQueryRuntime):
             for sid in self.prog.stream_ids
         }
         self._timer_step = jax.jit(self._make_step(None), donate_argnums=(0,))
+
+    def arm_lineage(self, cfg) -> None:
+        """Enable provenance recording (@app:lineage): force every ref's
+        captured-timestamp lane to materialize (the emission buffer then
+        carries, per match, exactly which input row filled each linearized
+        slot) and surface them as `__lin.*` lanes feeding a
+        PatternQueryLineage. Must run before anything traces the steps
+        (capture projection memoizes at first trace); emissions are
+        untouched."""
+        from siddhi_tpu.core.executor import TS_ATTR
+        from siddhi_tpu.observability.lineage import PatternQueryLineage
+
+        keys = set(self._sel_used_keys)
+        keys |= {(a.ref, None, TS_ATTR) for a in self.prog.refs}
+        self.prog.set_capture_readers(frozenset(keys))
+        self.lineage = PatternQueryLineage(
+            cfg, self.query_id, self._published_kinds(),
+            refs=[(a.ref, a.stream_id) for a in self.prog.refs],
+        )
 
     # ---- device program --------------------------------------------------
 
@@ -199,7 +219,8 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 )
                 # fast-path patterns have no waiting atoms -> no timers
                 return self._finish_step(
-                    state, tok, out, ovf, tstates, now, state["timer_ts"]
+                    state, tok, out, ovf, tstates, now, state["timer_ts"],
+                    in_batch=batch,
                 )
 
             return fast_step
@@ -252,12 +273,14 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 ),
             )
             return self._finish_step(
-                state, tok, out, ovf, tstates, now, timer_ts
+                state, tok, out, ovf, tstates, now, timer_ts, in_batch=batch
             )
 
         return step
 
-    def _finish_step(self, state, tok, out, ovf, tstates, now, timer_ts):
+    def _finish_step(
+        self, state, tok, out, ovf, tstates, now, timer_ts, in_batch=None
+    ):
         """Shared step tail: emission buffer -> selector -> table op -> aux."""
         prog = self.prog
         emit_batch = EventBatch(
@@ -279,6 +302,26 @@ class PatternQueryRuntime(BaseQueryRuntime):
         aux = dict(flow.aux)
         aux["pattern_overflow"] = ovf
         aux["next_timer"] = prog.next_timer(tok, after=timer_ts)
+        if self.lineage is not None:
+            # provenance lanes: the emission buffer's per-ref capture
+            # timestamps (arm_lineage forced every ts lane to materialize)
+            # — extra program outputs only, emissions untouched
+            from siddhi_tpu.core.event import KIND_CURRENT
+            from siddhi_tpu.observability.lineage import LIN
+
+            aux[LIN + "out_valid"] = out_batch.valid
+            aux[LIN + "out_kind"] = out_batch.kind
+            aux[LIN + "out_ts"] = out_batch.ts
+            for i, _a in enumerate(prog.refs):
+                aux[f"{LIN}p_n{i}"] = out[f"n{i}"]
+                tsr = out.get(f"ts{i}")
+                if tsr is not None:
+                    aux[f"{LIN}p_ts{i}"] = tsr
+            if in_batch is not None:
+                aux[LIN + "in"] = in_batch.valid & (
+                    in_batch.kind == KIND_CURRENT
+                )
+                aux[LIN + "in_ts"] = in_batch.ts
         return (
             {"tok": tok, "sel": sel_state, "timer_ts": timer_ts},
             tstates,
@@ -310,6 +353,10 @@ class PatternQueryRuntime(BaseQueryRuntime):
                     _time.perf_counter_ns() - t0,
                 )
             self._writeback_table_states(tstates)
+            lin = self.lineage
+            if lin is not None:
+                # under the receive lock: recorder order == dispatch order
+                aux = self._lin_observe(lin, aux, now, tag=stream_id)
         self._warn_aux(aux)
         return out, aux
 
@@ -322,6 +369,9 @@ class PatternQueryRuntime(BaseQueryRuntime):
                 self.state, tstates, schema_batch, jnp.asarray(t_ms, dtype=jnp.int64)
             )
             self._writeback_table_states(tstates)
+            lin = self.lineage
+            if lin is not None:
+                aux = self._lin_observe(lin, aux, t_ms, tag=None)
         self._warn_aux(aux)
         return out, aux
 

@@ -58,6 +58,11 @@ class CompiledSingleChain:
         self.schema = schema
         self.ref = stream.alias or stream.stream_id
         self.window = None
+        # lineage probe (observability/lineage.py): called on the post-
+        # filter/fn, pre-window flow during tracing to emit the admit mask
+        # (+ group key / window-time) as `__lin.*` aux lanes; None (no
+        # call) when @app:lineage is off
+        self.lineage_probe = None
         self.stages: list[tuple[str, object]] = []
         attrs = dict(schema.attr_types)
         for h in stream.handlers:
@@ -96,13 +101,19 @@ class CompiledSingleChain:
         return self.window.init_state() if self.window is not None else ()
 
     def apply(self, state, flow: Flow):
+        probe = self.lineage_probe
         for kind, stage in self.stages:
             if kind == "filter":
                 flow = self._filter(flow, [stage])
             elif kind == "fn":
                 flow = stage.apply(flow)
             else:  # window
+                if probe is not None:
+                    probe(flow)  # admit mask = post-filter, pre-window
+                    probe = None
                 state, flow = stage.apply(state, flow)
+        if probe is not None:
+            probe(flow)  # windowless chain: probe the final flow
         return state, flow
 
     @staticmethod
@@ -302,6 +313,10 @@ class BaseQueryRuntime:
         # (one check) when statistics are off
         self.compile_telemetry = None
         self.profiler = None
+        # lineage recorder (observability/lineage.py QueryLineage), armed by
+        # arm_lineage() when @app:lineage is on; None = one attribute check
+        # per receive (same contract as the trackers above)
+        self.lineage = None
         self.state = None
         self.tables = {}
         self.table_op = None
@@ -379,7 +394,49 @@ class BaseQueryRuntime:
         shared = getattr(self, "shared_ring", None)
         if shared is not None:
             d["shared_ring"] = dict(shared)
+        lin = getattr(self, "lineage", None)
+        if lin is not None:
+            d["lineage"] = lin.describe()
         return d
+
+    def _published_kinds(self):
+        """Event kinds this query's insert-into actually publishes (the
+        insert transform re-kinds them all CURRENT on the target) — maps a
+        downstream junction's lineage seq back to this query's records."""
+        from siddhi_tpu.core.event import KIND_CURRENT, KIND_EXPIRED
+        from siddhi_tpu.query_api.execution import OutputEventsFor
+
+        if self.output_events is OutputEventsFor.CURRENT:
+            return frozenset((KIND_CURRENT,))
+        if self.output_events is OutputEventsFor.EXPIRED:
+            return frozenset((KIND_EXPIRED,))
+        return frozenset((KIND_CURRENT, KIND_EXPIRED))
+
+    def _lin_observe(self, lin, aux: dict, now: int, tag=None) -> dict:
+        """Pull the step's `__lin.*` lanes to host, feed the recorder, and
+        return aux with the lanes stripped (callers downstream only ever
+        see the ordinary flag keys). Runs under the receive lock so
+        observation order matches dispatch order."""
+        import numpy as np
+
+        lanes = {}
+        rest = {}
+        for k, v in aux.items():
+            if k.startswith("__lin"):
+                lanes[k] = np.asarray(v)
+            else:
+                rest[k] = v
+        if lanes:
+            try:
+                lin.observe(lanes, now, tag)
+            except Exception:  # provenance must never break dispatch
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "lineage observe failed for query '%s'",
+                    self.query_id, exc_info=True,
+                )
+        return rest
 
     @staticmethod
     def _fresh(state):
@@ -398,7 +455,11 @@ class BaseQueryRuntime:
         thread is involved — on some tunneled PJRT backends any device->host
         read from a non-main thread permanently degrades every subsequent
         dispatch in the process."""
-        flags = {k: v for k, v in aux.items() if k != "next_timer"}
+        flags = {
+            k: v
+            for k, v in aux.items()
+            if k != "next_timer" and not k.startswith("__lin")
+        }
         if flags:
             _AUX_WORKER.submit(self, flags)
 
@@ -771,12 +832,66 @@ class QueryRuntime(BaseQueryRuntime):
                 )
         return d
 
+    def arm_lineage(self, cfg) -> None:
+        """Enable provenance recording for this query (@app:lineage): the
+        chain probe + `__lin.*` step lanes feed a SingleQueryLineage.
+        Must run before the first dispatch traces the step (lane structure
+        is part of the traced program). Emissions are untouched — lineage
+        on/off is byte-parity-safe."""
+        from siddhi_tpu.observability.lineage import LIN, SingleQueryLineage
+
+        sel = self.selector
+        grouped = sel.group is not None
+        if grouped:
+            # out rows carry their group key beside them (the rate-limiter
+            # mechanism); the key col is NOT part of the out schema, so
+            # downstream decode/publish/deliver are unaffected
+            sel.emit_group_key = True
+        win = self.chain.window
+        time_attr = getattr(win, "time_attr", None)
+
+        def probe(flow, _sel=sel, _grouped=grouped, _ta=time_attr):
+            b = flow.batch
+            flow.aux[LIN + "admit"] = b.valid & (b.kind == KIND_CURRENT)
+            if _grouped:
+                flow.aux[LIN + "key"] = _sel.group.key_of(flow.env())
+            if _ta is not None:
+                flow.aux[LIN + "wts"] = b.cols[_ta].astype(jnp.int64)
+
+        self.chain.lineage_probe = probe
+        self.lineage = SingleQueryLineage(
+            cfg, self.query_id, self._published_kinds(),
+            input_stream=self.in_schema.stream_id,
+            window=win,
+            grouped=grouped,
+            aggregated=bool(sel.aggregators),
+            order_limited=bool(
+                sel.order_by or sel.limit is not None
+                or sel.offset is not None
+            ),
+        )
+
     def _step_impl(self, state, tstates, batch: EventBatch, now):
         flow = Flow(batch=batch, ref=self.ref, now=now, tables=tstates)
         chain_state, flow = self.chain.apply(state["chain"], flow)
         sel_state, out = self.selector.apply(state["sel"], flow)
         if self.table_op is not None:
             tstates = self.table_op(tstates, out, now, flow.aux)
+        if self.lineage is not None:
+            # provenance lanes (observability/lineage.py): extra program
+            # OUTPUTS only — the emission lanes above are untouched
+            from siddhi_tpu.observability.lineage import LIN
+
+            aux = flow.aux
+            aux[LIN + "in"] = batch.valid & (batch.kind == KIND_CURRENT)
+            aux[LIN + "in_ts"] = batch.ts
+            aux[LIN + "w_valid"] = flow.batch.valid
+            aux[LIN + "w_kind"] = flow.batch.kind
+            aux[LIN + "w_ts"] = flow.batch.ts
+            aux[LIN + "out_valid"] = out.valid
+            aux[LIN + "out_kind"] = out.kind
+            if "__group_key__" in out.cols:
+                aux[LIN + "gkey"] = out.cols["__group_key__"]
         return {"chain": chain_state, "sel": sel_state}, tstates, out, flow.aux
 
     # ---- host side -------------------------------------------------------
@@ -811,5 +926,10 @@ class QueryRuntime(BaseQueryRuntime):
                     _time.perf_counter_ns() - t0,
                 )
             self._writeback_table_states(tstates)
+            lin = self.lineage
+            if lin is not None:
+                # observe under the receive lock: recorder order must
+                # match dispatch order (the lanes are stripped from aux)
+                aux = self._lin_observe(lin, aux, now)
         self._warn_aux(aux)
         return out, aux

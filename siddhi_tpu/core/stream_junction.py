@@ -75,6 +75,11 @@ class StreamJunction:
         # @flightRecorder(size='N') / SIDDHI_TPU_FLIGHT=N; None = one
         # attribute check on the hot path
         self.flight = None
+        # lineage arena (observability.lineage.LineageArena): stamps every
+        # valid CURRENT event with a monotonically increasing seq id and
+        # keeps the last N decodable, opt-in via @app:lineage; None = one
+        # attribute check on the hot path (same contract as flight)
+        self.lineage = None
         # user hook for subscriber failures (reference: the pluggable
         # Disruptor ExceptionHandler, SiddhiAppRuntime.java:664)
         self.exception_handler: Callable[[Exception], None] | None = None
@@ -108,6 +113,16 @@ class StreamJunction:
 
         self.flight = FlightRecorder(self.schema, self.interner, size)
 
+    def enable_lineage(self, size: int) -> None:
+        """Attach a lineage arena stamping + retaining the last `size`
+        CURRENT events. Idempotent for an unchanged size (the recorded
+        seq counter must survive re-arming)."""
+        if self.lineage is not None and self.lineage.size == int(size):
+            return
+        from siddhi_tpu.observability.lineage import LineageArena
+
+        self.lineage = LineageArena(self.schema, self.interner, size)
+
     def describe_state(self) -> dict:
         """Cheap live-state snapshot (no device reads): queue depth, wiring,
         async worker health, fused/pipeline engagement, flight ring."""
@@ -131,6 +146,8 @@ class StreamJunction:
             d["pipeline"] = fi.describe_state()
         if self.flight is not None:
             d["flight"] = self.flight.describe_state()
+        if self.lineage is not None:
+            d["lineage"] = self.lineage.describe_state()
         return d
 
     def subscribe(self, fn: Subscriber, name: str | None = None) -> None:
@@ -394,6 +411,13 @@ class StreamJunction:
             fl = self.flight
             if fl is not None:
                 fl.record_batch(batch)
+            la = self.lineage
+            seq_range = None
+            if la is not None:
+                # stamp the batch's valid CURRENT rows with seq ids; the
+                # range is read under this same lock by the @OnError STORE
+                # path (la.last_range) and attached to the publish span
+                seq_range = la.record_batch(batch)
             n_valid = -1
             if self.on_publish_stats is not None:
                 n_valid = int(np.asarray(batch.valid).sum())
@@ -404,6 +428,8 @@ class StreamJunction:
                 if tr is not None
                 else None
             )
+            if root is not None and seq_range is not None and seq_range[1]:
+                tr.annotate(root, "lineage_seq", list(seq_range))
             try:
                 guarded = (
                     self.exception_handler is not None or self.fault_policy is not None
@@ -618,6 +644,17 @@ class StreamJunction:
                 self.app_name, ORIGIN_STREAM, self.schema.stream_id, exc,
                 events=[(ts, tuple(d)) for ts, _k, d in events],
             )
+            if self.lineage is not None:
+                # contributing seq ids: the failing batch was stamped at
+                # the top of this publish (same junction lock) — last_range
+                # is exactly its rows
+                base, n = self.lineage.last_range
+                if n:
+                    entry.lineage = {
+                        "stream": self.schema.stream_id,
+                        "seq_lo": base,
+                        "seq_hi": base + n - 1,
+                    }
             if self.flight is not None:
                 # black-box dump: the last-N events through this junction
                 # BEFORE the failure, decoded host-side (the failing batch's

@@ -92,6 +92,13 @@ class WindowStage:
         frequent, cron, ...) carry parameters this tuple cannot see."""
         return None
 
+    def view_seq(self, state):
+        """Per-slot window admission seq ids, permuted like `view()` (the
+        SlidingWindow monotone `seq` lane; -1 = empty slot). None when this
+        window type tracks no admission order — join lineage then records
+        the partner as unresolved (observability/lineage.py)."""
+        return None
+
     def describe_state(self, state) -> dict:
         """Introspection snapshot of the live buffer: type, fill, capacity,
         oldest/newest stored timestamps. Pull-only (one host read per call);
@@ -427,14 +434,25 @@ class SlidingWindow(WindowStage):
             tables=flow.tables,
         )
 
-    def view(self, state):
+    @staticmethod
+    def _view_perm(state):
+        """THE ring-slot -> logical-insertion-order permutation, shared by
+        view() and view_seq(): join lineage pairs view_seq's seq lane with
+        view's cols/mask by position, so the two must never drift."""
         mask = state["seq"] >= 0
-        # ring slots -> logical insertion order via the monotone seq lane
-        perm = jnp.argsort(jnp.where(mask, state["seq"], jnp.iinfo(jnp.int64).max)).astype(
-            jnp.int32
-        )
+        perm = jnp.argsort(
+            jnp.where(mask, state["seq"], jnp.iinfo(jnp.int64).max)
+        ).astype(jnp.int32)
+        return mask, perm
+
+    def view(self, state):
+        mask, perm = self._view_perm(state)
         cols = {n: c[perm] for n, c in state["cols"].items()}
         return cols, state["ts"][perm], mask[perm]
+
+    def view_seq(self, state):
+        _mask, perm = self._view_perm(state)
+        return state["seq"][perm]
 
 
 def _place_ring(old, evicted, slots, vals):

@@ -351,6 +351,13 @@ def _query_counters(
         pl = shard_rt.partitioned.get(qid)
         if pl is not None:
             counters["shard"] = pl
+    # live lineage fan-in (observability/lineage.py): rendered even with
+    # statistics off — @app:lineage has its own gate
+    if runtime is not None:
+        qr = runtime.queries.get(qid)
+        lin = getattr(qr, "lineage", None) if qr is not None else None
+        if lin is not None:
+            counters["lineage"] = lin.fan_in()
     if sm is None:
         return counters
     lt = sm.latency.get(f"query.{qid}")
@@ -441,6 +448,13 @@ def _fmt_counters(c: Optional[dict]) -> str:
             )
         else:
             parts.append(f"shard[off: {s.get('reason')}]")
+    if "lineage" in c:
+        li = c["lineage"]
+        parts.append(
+            f"lineage[fan-in avg={li.get('avg_inputs_per_output')} "
+            f"max={li.get('max_inputs_per_output')} "
+            f"outputs={li.get('outputs')}]"
+        )
     if "compile" in c:
         comp = c["compile"]
         causes = ",".join(

@@ -9,6 +9,9 @@ Routes:
   /status.json    live engine state (junction queue depths, window fills,
                   NFA instance counts, pipeline occupancy, error store)
   /flight         flight-recorder rings per app/stream (JSON)
+  /lineage        event lineage & provenance summary, human-readable text
+  /lineage.json   per-stream seq arenas + per-query fan-in + recent
+                  resolved provenance chains (observability/lineage.py)
   /profile        continuous profiler: compile telemetry (count/cause/wall
                   per program), slowest-chunk waterfalls, p99.99s (JSON)
   /explain        EXPLAIN ANALYZE: the dataflow plan annotated with live
@@ -63,6 +66,14 @@ class MetricsServer:
                     elif path == "/flight":
                         body = json.dumps(
                             outer.manager.flight_records(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/lineage":
+                        body = outer.manager.lineage_text().encode()
+                        ctype = "text/plain; charset=utf-8"
+                    elif path == "/lineage.json":
+                        body = json.dumps(
+                            outer.manager.lineage_reports(), default=str
                         ).encode()
                         ctype = "application/json"
                     elif path == "/profile":

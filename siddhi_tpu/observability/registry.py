@@ -30,12 +30,16 @@ class JunctionDeviceStats:
     dispatch time, h2d wire traffic, and d2h truth-sync stalls (the engine's
     live version of what bench.py's `timebudget` leg reconstructs offline)."""
 
-    __slots__ = ("step", "h2d_bytes", "h2d_chunks", "sync_stall")
+    __slots__ = ("step", "h2d_bytes", "h2d_chunks", "h2d_events", "sync_stall")
 
     def __init__(self, registry: "StatisticsManager", component: str) -> None:
         self.step = registry.device_time_tracker(component, "fused_step")
         self.h2d_bytes = registry.device_counter(component, "h2d_bytes")
         self.h2d_chunks = registry.device_counter(component, "h2d_chunks")
+        # events shipped over the wire alongside h2d_bytes: the live
+        # roofline attribution (bytes/event) the compact-wire-encoding
+        # work targets (BENCH r04 `*_wire_B_per_ev`, but always-on)
+        self.h2d_events = registry.device_counter(component, "h2d_events")
         self.sync_stall = registry.device_time_tracker(component, "sync_stall")
 
 
@@ -202,6 +206,29 @@ class StatisticsManager:
         siddhi_shard_* Prometheus families."""
         self.shard[component] = router
 
+    def roofline(self) -> dict:
+        """Live per-stream wire roofline: bytes/event over the fused h2d
+        path plus the 1-minute h2d throughput in MB/s — the always-on
+        version of bench r04's roofline attribution, the signal the
+        compact-wire-encoding work targets. Keyed by component
+        (`stream.<id>`); empty until a fused send ships bytes."""
+        out: dict = {}
+        for key, t in list(self.device_counters.items()):
+            if getattr(t, "op", None) != "h2d_bytes" or t.count <= 0:
+                continue
+            comp = t.component
+            ev = self.device_counters.get(f"{comp}.h2d_events")
+            n_ev = ev.count if ev is not None else 0
+            entry = {
+                "h2d_bytes": t.count,
+                "h2d_events": n_ev,
+                "h2d_mb_s_1m": round(t.rate_1m / 1e6, 3),
+            }
+            if n_ev > 0:
+                entry["wire_bytes_per_event"] = round(t.count / n_ev, 3)
+            out[comp] = entry
+        return out
+
     # ---- reporting ---------------------------------------------------------
 
     def report(self) -> dict:
@@ -268,6 +295,7 @@ class StatisticsManager:
             "shard": {
                 n: r.describe_state() for n, r in list(self.shard.items())
             },
+            "roofline": self.roofline(),
             "traces_sampled": (
                 self.tracer.sampled_count if self.tracer is not None else 0
             ),
@@ -305,6 +333,7 @@ class StatisticsManager:
             "waterfalls": self.profiler.report(),
             "latency_high_ms": highs(list(self.latency.items())),
             "device_time_high_ms": highs(list(self.device_time.items())),
+            "roofline": self.roofline(),
         }
 
     def start_reporting(self) -> None:

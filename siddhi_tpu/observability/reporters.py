@@ -127,6 +127,18 @@ _FAMILIES = {
         "counter", "Host-to-device wire bytes shipped per junction"),
     "siddhi_h2d_chunks_total": (
         "counter", "Host-to-device transfer chunks per junction"),
+    "siddhi_h2d_events_total": (
+        "counter",
+        "Events shipped over the fused h2d wire per junction (the "
+        "roofline denominator beside siddhi_h2d_bytes_total)"),
+    "siddhi_wire_bytes_per_event": (
+        "gauge",
+        "Live wire bytes per event over the fused h2d path — the "
+        "roofline attribution the compact-wire-encoding work targets"),
+    "siddhi_h2d_mb_s": (
+        "gauge",
+        "1-minute EWMA host-to-device wire throughput in MB/s per "
+        "junction"),
     "siddhi_pipeline_occupancy": (
         "gauge",
         "Measured overlap ratio of the pipelined fused ingest (summed "
@@ -211,6 +223,17 @@ def render_prometheus(reports: list[dict]) -> str:
                     f"{fam}{_labels(app=app, component=ent['component'])}"
                     f" {ent['count']}"
                 )
+        for n, ent in rep.get("roofline", {}).items():
+            bpe = ent.get("wire_bytes_per_event")
+            if bpe is not None:
+                body["siddhi_wire_bytes_per_event"].append(
+                    f"siddhi_wire_bytes_per_event{_labels(app=app, component=n)}"
+                    f" {bpe}"
+                )
+            body["siddhi_h2d_mb_s"].append(
+                f"siddhi_h2d_mb_s{_labels(app=app, component=n)}"
+                f" {ent.get('h2d_mb_s_1m', 0)}"
+            )
         for n, ent in rep.get("shard", {}).items():
             occ = ent.get("occupancy", [])
             for d, v in enumerate(ent.get("per_device_dispatches", [])):

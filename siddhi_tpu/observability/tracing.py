@@ -38,19 +38,22 @@ class _Trace:
         self.open: list[list] = []
 
     def to_dict(self) -> dict:
+        spans = []
+        for s in self.spans:
+            d = {
+                "component": s[0],
+                "depth": s[1],
+                "events": s[2],
+                "start_us": round((s[3] - self.t0_ns) / 1e3, 1),
+                "duration_us": round((s[4] - s[3]) / 1e3, 1),
+            }
+            if len(s) > 5 and s[5]:
+                d.update(s[5])  # annotations (e.g. lineage_seq)
+            spans.append(d)
         return {
             "trace_id": self.trace_id,
             "wall_ms": self.wall_ms,
-            "spans": [
-                {
-                    "component": s[0],
-                    "depth": s[1],
-                    "events": s[2],
-                    "start_us": round((s[3] - self.t0_ns) / 1e3, 1),
-                    "duration_us": round((s[4] - s[3]) / 1e3, 1),
-                }
-                for s in self.spans
-            ],
+            "spans": spans,
         }
 
 
@@ -99,6 +102,15 @@ class Tracer:
         cur.spans.append(span)
         cur.open.append(span)
         return span
+
+    def annotate(self, token, key: str, value) -> None:
+        """Attach a key/value annotation to an open span (no-op on a
+        skipped trace) — e.g. the publish span's lineage seq range."""
+        if token is _SKIP or not isinstance(token, list):
+            return
+        if len(token) == 5:
+            token.append({})
+        token[5][key] = value
 
     def end_span(self, token) -> None:
         tls = self._tls

@@ -283,7 +283,7 @@ class BatchShardRouter:
                         )
                         bases[j] = base
                         wire[j, :] = buf
-                    chunks.append((wire, counts, bases, len(part)))
+                    chunks.append((wire, counts, bases, len(part), part))
                 staged.append(chunks)
         except WireNarrowMisfit:
             return None
@@ -294,26 +294,38 @@ class BatchShardRouter:
 
         results: list[list] = [[] for _ in range(D)]
         rounds = max(len(c) for c in staged)
-        for r in range(rounds):
-            for d in range(D):
-                if r >= len(staged[d]):
-                    continue
-                wire, counts, bases, nb = staged[d][r]
-                dev_wire = jax.device_put(wire, self.devices[d])
-                packs, completion = fi._dispatch_chunk(
-                    prog, dev_wire, counts, bases, now, ds, tracked, tr,
-                    stream_span, deliver=deliver,
-                )
-                if packs is None and completion is None:
-                    # guarded dispatch failure: the junction's policy owned
-                    # it; this chunk's batches deliver nothing (the exact
-                    # per-batch-path semantics of a dropped failing batch)
-                    results[d].append((None, counts, nb))
-                    continue
-                with self._lock:
-                    self.dispatches[d] += 1
-                    self.events[d] += int(counts.sum())
-                results[d].append((packs, counts, nb))
+        # lineage: chunks dispatch round-robin (NOT global batch order), so
+        # observations park keyed by global batch index and replay in order
+        # at _lin_end_send (observability/lineage.py)
+        fi._lin_begin_send()
+        try:
+            for r in range(rounds):
+                for d in range(D):
+                    if r >= len(staged[d]):
+                        continue
+                    wire, counts, bases, nb, part = staged[d][r]
+                    dev_wire = jax.device_put(wire, self.devices[d])
+                    packs, completion = fi._dispatch_chunk(
+                        prog, dev_wire, counts, bases, now, ds, tracked, tr,
+                        stream_span, deliver=deliver, lin_ks=part,
+                    )
+                    if packs is None and completion is None:
+                        # guarded dispatch failure: the junction's policy
+                        # owned it; this chunk's batches deliver nothing
+                        # (the exact per-batch-path semantics of a dropped
+                        # failing batch)
+                        results[d].append((None, counts, nb))
+                        continue
+                    with self._lock:
+                        self.dispatches[d] += 1
+                        self.events[d] += int(counts.sum())
+                    results[d].append((packs, counts, nb))
+        finally:
+            # even when an unguarded dispatch failure propagates to the
+            # sender, the already-dispatched chunks' parked observations
+            # must replay — dropping them would desync every recorder's
+            # seq accounting for all later sends
+            fi._lin_end_send()
         with self._lock:
             self.sends += 1
         if deliver:

@@ -62,7 +62,7 @@ def run(T: int, C: int, bsz=32768, reps=3):
         tstates = {}
         for ep in fi.endpoints:
             tstates.update(ep.qr._collect_table_states())
-        ns, _t, _a, _p = fi._fused(tuple(states), tstates, w, counts, bases, np.int64(1_700_000_000_000))
+        ns, _t, _a, _lin, _p = fi._fused(tuple(states), tstates, w, counts, bases, np.int64(1_700_000_000_000))
         for ep, st in zip(fi.endpoints, ns):
             ep.qr.state = st
         return ns

@@ -7,10 +7,12 @@ well-formed: every sample line parses, every family is typed, and the
 acceptance families (throughput, latency quantiles, buffered depth, device
 budget) are present. Also scrapes `/status.json` (junction queue depth,
 window fill, pipeline occupancy must be live), `/flight` (the flight ring
-must hold the tail of the driven traffic), `/profile` (≥1 compile event
-with a cause and wall time after ingest, plus chunk waterfalls), and
-`/explain` + `/explain.json` (a non-empty live-annotated plan). Exit 0 =
-pass.
+must hold the tail of the driven traffic), `/lineage.json` (+ `/lineage`:
+a resolvable provenance chain from a known window emission back to decoded
+input events, and live roofline gauges — wire bytes/event + h2d MB/s — in
+the exposition and `/profile`), `/profile` (≥1 compile event with a cause
+and wall time after ingest, plus chunk waterfalls), and `/explain` +
+`/explain.json` (a non-empty live-annotated plan). Exit 0 = pass.
 
 With SMOKE_JSON_OUT=<path> the scraped payloads (profile, explain plan,
 status) are written there as one JSON blob — tier1.yml uploads it as a
@@ -79,6 +81,7 @@ def _run(blob: dict) -> int:
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime("""
     @app:statistics(reporter='prometheus', port='0', trace.sample='1.0')
+    @app:lineage(capacity='512')
     @flightRecorder(size='16')
     define stream S (symbol string, price float);
     @info(name='q')
@@ -164,6 +167,35 @@ def _run(blob: dict) -> int:
     assert profile[0]["waterfalls"]["chunks"] >= 1, profile[0]["waterfalls"]
     assert profile[0]["waterfalls"]["slowest"], "no slowest-chunk ring"
 
+    # live roofline gauges: the fused columnar send above shipped wire
+    # bytes, so /metrics and /profile must carry bytes/event + MB/s
+    assert "siddhi_wire_bytes_per_event" in text, "no roofline gauge"
+    assert "siddhi_h2d_mb_s" in text, "no h2d MB/s gauge"
+    roof = profile[0].get("roofline", {})
+    assert roof.get("stream.S", {}).get("wire_bytes_per_event", 0) > 0, (
+        f"/profile roofline must be live: {roof}"
+    )
+
+    # event lineage & provenance: /lineage.json must resolve a known
+    # match back to its exact contributing input events
+    lineage = json.loads(scrape(f"http://127.0.0.1:{port}/lineage.json"))
+    blob["lineage"] = lineage
+    lrep = lineage["SiddhiApp"]
+    assert lrep["streams"]["S"]["next_seq"] > 0, lrep["streams"]
+    qlin = lrep["queries"]["q"]
+    assert qlin["outputs"] > 0 and qlin["avg_inputs_per_output"] > 0, qlin
+    chains = lrep.get("recent", {}).get("q")
+    assert chains, f"/lineage.json must carry a resolved chain: {lrep}"
+    chain = chains[-1]
+    assert chain["inputs"] and not chain["approx"], chain
+    inp = chain["inputs"][0]
+    assert inp["stream"] == "S" and inp["n"] > 0, inp
+    assert any(e.get("event") is not None for e in inp.get("events", ())), (
+        f"chain must resolve to decoded input events: {inp}"
+    )
+    lineage_text = scrape(f"http://127.0.0.1:{port}/lineage")
+    assert "query q" in lineage_text and "fan-in" in lineage_text
+
     # EXPLAIN ANALYZE: a non-empty live plan for the running app
     explain_text = scrape(f"http://127.0.0.1:{port}/explain")
     assert "EXPLAIN ANALYZE" in explain_text and "query q" in explain_text
@@ -178,7 +210,7 @@ def _run(blob: dict) -> int:
     mgr.shutdown()
     print(
         f"metrics smoke OK: {samples} samples, {len(typed)} families, "
-        f"status + flight + profile + explain live"
+        f"status + flight + lineage + roofline + profile + explain live"
     )
     return 0
 

@@ -66,7 +66,7 @@ def profile_leg(name: str, batch=32768, reps=4):
         tstates = {}
         for ep in fi.endpoints:
             tstates.update(ep.qr._collect_table_states())
-        ns, tst, _aux, _packs = fi._fused(tuple(states), tstates, w, counts, bases, np.int64(1_700_000_000_000))
+        ns, tst, _aux, _lin, _packs = fi._fused(tuple(states), tstates, w, counts, bases, np.int64(1_700_000_000_000))
         for ep, st in zip(fi.endpoints, ns):
             ep.qr.state = st
         return ns
