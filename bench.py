@@ -513,6 +513,16 @@ def _leg_timebudget(batch=32768) -> dict:
         t_h2d = max(t_call - t_dev, 0.0)
         walls = {"encode": t_encode, "h2d": t_h2d, "device": t_dev}
         out[f"{name}_wire_B_per_ev"] = round(wire.nbytes / ev, 1)
+        # logical = what the FULL-WIDTH packed wire would ship for the same
+        # events (core/wire.py); the ratio is the leg's wire reduction —
+        # the acceptance signal of the compact-wire-encoding work
+        from siddhi_tpu.core.wire import logical_row_bytes
+
+        logical = logical_row_bytes(rt.junctions[stream].schema.attrs)
+        out[f"{name}_logical_B_per_ev"] = logical
+        out[f"{name}_wire_reduction"] = round(
+            logical / max(wire.nbytes / ev, 0.1), 2
+        )
         out[f"{name}_encode_mev_s"] = round(ev / t_encode / 1e6, 1)
         out[f"{name}_h2d_eff_ms"] = round(t_h2d * 1e3, 1)
         out[f"{name}_device_mev_s"] = round(ev / t_dev / 1e6, 2)
@@ -538,8 +548,16 @@ def _leg_timebudget(batch=32768) -> dict:
         cols2 = {k: v for k, v in data2.items() if k not in ("ts", "names")}
         h = rt.get_input_handler(stream)
         ab = {}
-        for mode, pipe_on in (("serial", False), ("pipe", True)):
+        # 'raw' runs LAST: force_full_width discards the encoded programs
+        # permanently (the same state a runtime misfit fallback lands in),
+        # so enc (= the pipelined encoded send) vs raw is the engine-path
+        # A/B of the wire encoding itself
+        for mode, pipe_on in (
+            ("serial", False), ("pipe", True), ("raw", True),
+        ):
             fi.pipeline_enabled = pipe_on
+            if mode == "raw":
+                fi.force_full_width()
             h.send_columns(data2["ts"], cols2)  # warm this mode's path
             _truth_sync(rt)
             t0 = time.perf_counter()
@@ -549,6 +567,9 @@ def _leg_timebudget(batch=32768) -> dict:
         ev2 = bsz * K * 4
         out[f"{name}_serial_mev_s"] = round(ev2 / ab["serial"] / 1e6, 2)
         out[f"{name}_pipe_mev_s"] = round(ev2 / ab["pipe"] / 1e6, 2)
+        out[f"{name}_enc_mev_s"] = out[f"{name}_pipe_mev_s"]
+        out[f"{name}_raw_mev_s"] = round(ev2 / ab["raw"] / 1e6, 2)
+        out[f"{name}_raw_B_per_ev"] = round(fi._wire_bytes / bsz, 1)
         out[f"{name}_overlap_meas"] = round(ab["serial"] / ab["pipe"], 2)
         out[f"{name}_overlap_pred"] = round(
             (t_encode + t_h2d + t_dev) / max(walls.values()), 2)
@@ -755,6 +776,178 @@ def _leg_shard(n_shard: int, batch=4096, events=1_000_000) -> dict:
         math.exp(sum(math.log(max(s, 1e-9)) for s in scalings) / len(scalings)),
         3,
     ) if scalings else 0.0
+    return out
+
+
+# compact-wire-encoding workloads (`--leg wire`, core/wire.py): one
+# dictionary-heavy stream (low-cardinality interned symbols + a declared
+# qty range) and one delta-timestamp stream (monotone LONG seq). Each runs
+# the SAME columnar feed with SIDDHI_TPU_WIRE=1 vs =0 (full width) and
+# must deliver identical rows; the leg reports both sides' bytes/event,
+# throughput, and the encoded-over-raw reduction, plus a forced MID-STREAM
+# fallback case (cardinality overflow after the encoded steady state).
+WIRE_WORKLOADS = {
+    "wire_dict": (
+        """
+        @app:wire(dict.Ticks.sym='64', range.Ticks.qty='0..30000')
+        define stream Ticks (sym string, price float, qty long);
+        @info(name='q') from Ticks[qty > 10] select sym, qty insert into Out;
+        """,
+        "Ticks",
+    ),
+    "wire_delta": (
+        """
+        @app:wire(delta.Meters.seq='int16')
+        define stream Meters (seq long, v float);
+        @info(name='q') from Meters[v >= 0] select seq, v insert into Out;
+        """,
+        "Meters",
+    ),
+}
+
+
+def _leg_wire(batch=4096, events=400_000) -> dict:
+    """Wire-encoding A/B (`--leg wire`): per workload, the same feed runs
+    encoded (SIDDHI_TPU_WIRE=1: the @app:wire static spec engages) and raw
+    (=0: full-width wire), with exact delivered-row counts + integer
+    checksums on both sides, per-side wire bytes/event, and the byte
+    reduction. Ends with the runtime-guard case: a batch violating the
+    declared dictionary cardinality arrives AFTER the encoded steady
+    state, the engine falls back full-width mid-stream, and the delivered
+    rows must still match the raw run exactly."""
+    from siddhi_tpu import SiddhiManager
+
+    out: dict = {"wire_batch": batch}
+    rng = np.random.default_rng(11)
+    n = max(batch * 16, min(events, 1_000_000))
+    feeds = {
+        "wire_dict": (
+            np.arange(n, dtype=np.int64) + 1_700_000_000_000,
+            {
+                "sym": rng.integers(1, 33, n).astype(np.int32),
+                "price": rng.uniform(0, 100, n).astype(np.float32),
+                "qty": rng.integers(0, 1000, n).astype(np.int64),
+            },
+        ),
+        "wire_delta": (
+            np.arange(n, dtype=np.int64) + 1_700_000_000_000,
+            {
+                "seq": np.arange(n, dtype=np.int64) + 10**12,
+                "v": rng.uniform(0, 10, n).astype(np.float32),
+            },
+        ),
+    }
+
+    def run(name, ql, stream, env_val, feed, cb_col):
+        saved = os.environ.get("SIDDHI_TPU_WIRE")
+        os.environ["SIDDHI_TPU_WIRE"] = env_val
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(
+                f"@app:batch(size='{batch}')\n" + ql
+            )
+        finally:
+            if saved is None:
+                os.environ.pop("SIDDHI_TPU_WIRE", None)
+            else:
+                os.environ["SIDDHI_TPU_WIRE"] = saved
+        for i in range(1, 400):
+            mgr.interner.intern(f"SYM{i}")
+        sink = [0, 0]  # rows, integer checksum
+
+        def cb(ts, ins, removed, _s=sink):
+            for e in ins or ():
+                _s[0] += 1
+                _s[1] += int(e.data[cb_col])
+        rt.add_callback("q", cb)
+        rt.start()
+        h = rt.get_input_handler(stream)
+        ts_arr, cols = feed
+        warm = batch * 4
+        h.send_columns(
+            ts_arr[:warm], {k: v[:warm] for k, v in cols.items()}
+        )
+        _truth_sync(rt)
+        sink[0] = sink[1] = 0
+        t0 = time.perf_counter()
+        h.send_columns(ts_arr, cols)
+        _truth_sync(rt)
+        dt = time.perf_counter() - t0
+        fi = rt.junctions[stream].fused_ingest
+        res = {
+            "mev_s": round(len(ts_arr) / dt / 1e6, 3),
+            "rows": sink[0],
+            "checksum": sink[1],
+            "B_per_ev": round(fi._wire_bytes / batch, 2) if fi else None,
+        }
+        rt.shutdown()
+        mgr.shutdown()
+        return res
+
+    for name, (ql, stream) in WIRE_WORKLOADS.items():
+        cb_col = 1 if name == "wire_dict" else 0
+        enc = run(name, ql, stream, "1", feeds[name], cb_col)
+        raw = run(name, ql, stream, "0", feeds[name], cb_col)
+        out[f"{name}_enc_mev_s"] = enc["mev_s"]
+        out[f"{name}_raw_mev_s"] = raw["mev_s"]
+        out[f"{name}_enc_B_per_ev"] = enc["B_per_ev"]
+        out[f"{name}_raw_B_per_ev"] = raw["B_per_ev"]
+        if enc["B_per_ev"] and raw["B_per_ev"]:
+            out[f"{name}_reduction"] = round(
+                raw["B_per_ev"] / enc["B_per_ev"], 2
+            )
+        out[f"{name}_rows_match"] = enc["rows"] == raw["rows"]
+        out[f"{name}_checksum_match"] = enc["checksum"] == raw["checksum"]
+        out[f"{name}_rows"] = enc["rows"]
+
+    # forced mid-stream fallback: after the dict-encoded steady state, a
+    # burst with 300 distinct symbols (> the declared 64) arrives — the
+    # runtime guard rebuilds full-width and NOTHING may be lost or differ
+    ql, stream = WIRE_WORKLOADS["wire_dict"]
+    ts_arr, cols = feeds["wire_dict"]
+    nb = batch * 8
+    burst = {
+        "sym": (np.arange(nb, dtype=np.int32) % 300) + 1,
+        "price": np.full(nb, 50.0, np.float32),
+        "qty": np.full(nb, 500, np.int64),
+    }
+    sides = {}
+    for env_val in ("1", "0"):
+        saved = os.environ.get("SIDDHI_TPU_WIRE")
+        os.environ["SIDDHI_TPU_WIRE"] = env_val
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(
+                f"@app:batch(size='{batch}')\n" + ql
+            )
+        finally:
+            if saved is None:
+                os.environ.pop("SIDDHI_TPU_WIRE", None)
+            else:
+                os.environ["SIDDHI_TPU_WIRE"] = saved
+        for i in range(1, 400):
+            mgr.interner.intern(f"SYM{i}")
+        rows = []
+        rt.add_callback(
+            "q", lambda t, ins, rem, _r=rows: _r.extend(
+                tuple(e.data) for e in (ins or ())
+            )
+        )
+        rt.start()
+        h = rt.get_input_handler(stream)
+        steady = batch * 8
+        h.send_columns(
+            ts_arr[:steady], {k: v[:steady] for k, v in cols.items()}
+        )
+        h.send_columns(ts_arr[steady : steady + nb], burst)
+        _truth_sync(rt)
+        fi = rt.junctions[stream].fused_ingest
+        sides[env_val] = (rows, fi._narrow if fi else None)
+        rt.shutdown()
+        mgr.shutdown()
+    out["wire_fallback_rows_match"] = sides["1"][0] == sides["0"][0]
+    out["wire_fallback_rows"] = len(sides["1"][0])
+    out["wire_fallback_full_width"] = sides["1"][1] == {}
     return out
 
 
@@ -1000,6 +1193,11 @@ def _run_leg(name: str, args) -> dict:
         return _leg_verify()
     if name == "verify":
         return _verify_tpu_vs_cpu(args)
+    if name == "wire":
+        # keep this leg's own default batch (a 4096 chunk shape shows the
+        # dict/delta amortization honestly) unless --batch was passed
+        batch = args.batch if getattr(args, "batch_explicit", True) else 4096
+        return _leg_wire(batch=batch, events=min(args.events, 1_000_000))
     if name == "shard":
         if not args.shard:
             return {"shard_error": "pass --shard N (e.g. --shard 8 under "
@@ -1007,7 +1205,7 @@ def _run_leg(name: str, args) -> dict:
         # honor --batch like every other leg, but keep this leg's own
         # default: at the driver-wide 32768 a 200k-event feed is fewer
         # micro-batches than devices and the router can't even engage
-        batch = args.batch if args.batch != 32768 else 4096
+        batch = args.batch if getattr(args, "batch_explicit", True) else 4096
         return _leg_shard(
             args.shard, batch=batch, events=min(args.events, 1_000_000)
         )
@@ -1020,7 +1218,11 @@ def main():
     # halves each headline leg's wall without moving the number — part of
     # fitting the full suite back under the harness budget (ROADMAP item)
     ap.add_argument("--events", type=int, default=1_000_000)
-    ap.add_argument("--batch", type=int, default=32768)
+    # default=None so an EXPLICIT `--batch 32768` is distinguishable from
+    # "unset": the shard/wire legs keep their own smaller defaults only
+    # when the caller didn't pick a batch
+    ap.add_argument("--batch", type=int, default=None,
+                    help="micro-batch size (default 32768)")
     ap.add_argument(
         "--shard", type=int, default=0,
         help="device count for the sharded-execution leg (`--leg shard`); "
@@ -1041,6 +1243,9 @@ def main():
     )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
+    args.batch_explicit = args.batch is not None
+    if args.batch is None:
+        args.batch = 32768
 
     # SIDDHI_TPU_BENCH_BUDGET=<seconds>: one knob for constrained harnesses —
     # trims the overall deadline AND the per-leg subprocess caps (no single
@@ -1145,7 +1350,8 @@ def main():
     t_start = time.monotonic()
     legs = list(WORKLOADS) + [
         "filter_window_avg_delivered", "pattern_2state_delivered",
-        "tumbling_groupby_delivered", "p99", "tables", "timebudget", "verify",
+        "tumbling_groupby_delivered", "p99", "tables", "wire", "timebudget",
+        "verify",
     ]
     if args.shard:
         legs.append("shard")
@@ -1169,7 +1375,11 @@ def main():
                 # keep ~30 s of slack so the driver itself always finishes
                 leg_timeout = min(leg_timeout, remaining - 30)
             cmd = [sys.executable, os.path.abspath(__file__), "--leg", leg,
-                   "--events", str(args.events), "--batch", str(args.batch)]
+                   "--events", str(args.events)]
+            if args.batch_explicit:
+                # forward --batch only when the caller chose one, so leg
+                # subprocesses keep their own defaults otherwise
+                cmd += ["--batch", str(args.batch)]
             if args.shard:
                 cmd += ["--shard", str(args.shard)]
             env = dict(os.environ)

@@ -872,6 +872,13 @@ def check_costs(
             severity=WARNING,
         ))
 
+    # SA133: h2d-dominant wide column — a LONG column with no @app:wire
+    # encoding hint that alone accounts for >= half the stream's estimated
+    # wire bytes/event on a consumed (h2d-riding) stream. Actionable: a
+    # declared range/delta hint narrows it statically (core/wire.py), or
+    # interned strings ride as int32 ids.
+    _check_wire_dominance(app, sym, model, diags)
+
     # SA122: @app:batch != 64 downstream of a query insert (re-published
     # slices arrive <= 64 rows: a second shape signature per program)
     if model.batch_size != 64:
@@ -889,6 +896,46 @@ def check_costs(
                     None, None, severity=WARNING, query=qid,
                 ))
     return model
+
+
+def _check_wire_dominance(
+    app: SiddhiApp, sym, model: AppCostModel, diags: list
+) -> None:
+    """SA133 (see check_costs). Skipped when the app opts out via
+    `@app:wire(disable='true')` — the user already declined the wire
+    layer, so the hint would be noise. Specs come from the SAME shared
+    preamble the FusionPlan wire section uses (core/wire.py
+    app_wire_specs), at the model's real batch size."""
+    from siddhi_tpu.core.wire import app_wire_specs, estimate_wire_bytes
+
+    disabled, specs = app_wire_specs(
+        app, sym.streams, sorted(model.streams), model.batch_size
+    )
+    if disabled:
+        return
+    for sid, (attrs, spec) in specs.items():
+        enc = spec.encodings if spec is not None else {}
+        total = max(
+            estimate_wire_bytes(attrs, spec, capacity=model.batch_size), 1
+        )
+        d = app.stream_definitions.get(sid)
+        for name, t in attrs:
+            if t is not AttrType.LONG or name in enc:
+                continue
+            # STRICTLY dominant: the one wide lane outweighs everything
+            # else on the wire combined (a 50/50 split stays quiet — the
+            # false-positive net is the whole test corpus)
+            if 8.0 / total <= 0.5:
+                continue
+            diags.append(Diagnostic(
+                "SA133",
+                f"stream '{sid}': LONG column '{name}' rides the h2d wire "
+                f"full-width and dominates it (8 of ~{total} B/event) — "
+                f"declare @app:wire(range.{sid}.{name}='lo..hi') or "
+                f"delta.{sid}.{name}='int16', or use interned strings",
+                getattr(d, "line", None), getattr(d, "col", None),
+                severity=WARNING,
+            ))
 
 
 def _check_unbounded_every(

@@ -68,6 +68,13 @@ CODES: dict[str, str] = {
              "(missing @info name / duplicate query id / undeclared stream)",
     "SA131": "invalid @app:lineage annotation (bad capacity / unknown mode "
              "/ bad sample.every / unknown option)",
+    "SA132": "invalid @app:wire annotation (unknown option / bad range "
+             "'lo..hi' / bad dict capacity / bad delta dtype / unknown "
+             "stream or column / encoder-type mismatch)",
+    "SA133": "h2d-dominant wide column: a declared column's type forces a "
+             "wide wire encoding that dominates the stream's h2d "
+             "bytes/event — declare an int/long range (or dict/delta) via "
+             "@app:wire, or use interned strings (warning)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

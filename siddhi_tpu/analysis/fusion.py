@@ -59,7 +59,10 @@ from siddhi_tpu.analysis.cost import (
 )
 from siddhi_tpu.analysis.diagnostics import WARNING, Diagnostic
 
-PLAN_VERSION = 1
+# v2: per-stream `wire` section — the versioned WireSpec (core/wire.py)
+# naming each consumed stream's analyzer-chosen per-column wire encodings
+# plus the predicted logical-vs-encoded bytes/event
+PLAN_VERSION = 2
 
 # hazard ids, stable (documented in the README; SA124 messages name them)
 H_ASYNC = "async-ingress"
@@ -95,6 +98,10 @@ class FusionPlan:
     groups: list = dataclasses.field(default_factory=list)
     blockers: list = dataclasses.field(default_factory=list)
     shared_state: list = dataclasses.field(default_factory=list)
+    # sid -> versioned WireSpec summary (core/wire.py): the static
+    # per-column encoding choice for every consumed stream, with the
+    # predicted logical-vs-encoded bytes/event
+    wire: dict = dataclasses.field(default_factory=dict)
     costs: Optional[AppCostModel] = None
 
     def to_dict(self) -> dict:
@@ -108,6 +115,7 @@ class FusionPlan:
             "groups": list(self.groups),
             "blockers": list(self.blockers),
             "shared_state": list(self.shared_state),
+            "wire": dict(self.wire),
             "costs": self.costs.to_dict() if self.costs is not None else None,
         }
 
@@ -277,7 +285,47 @@ def build_fusion_plan(
             })
 
     _collect_shared_state(app, sym, model, consumers, plan)
+    _collect_wire_specs(app, sym, model, plan)
     return plan
+
+
+def _collect_wire_specs(
+    app: SiddhiApp, sym, model: AppCostModel, plan: FusionPlan
+) -> None:
+    """Per consumed stream: the static WireSpec (core/wire.py — the same
+    builder the runtime's fused ingest consumes, so the plan and the
+    engine can never choose different encoders) plus the predicted
+    logical-vs-encoded bytes/event. Sampling can only shrink the wire
+    further at runtime (narrow tsd, un-hinted int columns)."""
+    from siddhi_tpu.core.wire import (
+        WIRE_SPEC_VERSION,
+        app_wire_specs,
+        encoding_label,
+        estimate_wire_bytes,
+        logical_row_bytes,
+    )
+
+    disabled, specs = app_wire_specs(
+        app, sym.streams, sorted(model.streams), model.batch_size
+    )
+    for sid, (attrs, spec) in specs.items():
+        entry = {
+            "version": WIRE_SPEC_VERSION,
+            "source": "static",
+            "encodings": {
+                lane: encoding_label(e)
+                for lane, e in sorted(
+                    (spec.encodings if spec is not None else {}).items()
+                )
+            },
+            "logical_B_per_ev": logical_row_bytes(attrs),
+            "encoded_B_per_ev_est": estimate_wire_bytes(
+                attrs, spec, capacity=model.batch_size
+            ),
+        }
+        if disabled:
+            entry["disabled"] = True
+        plan.wire[sid] = entry
 
 
 def _collect_shared_state(
@@ -395,6 +443,20 @@ def render_plan_text(plan: FusionPlan) -> str:
         for b in plan.blockers:
             lines.append(
                 f"  {b['query']} on {b['stream']}: {b['hazard']} — {b['why']}"
+            )
+    encoded_streams = {
+        sid: w for sid, w in plan.wire.items() if w.get("encodings")
+    }
+    if encoded_streams:
+        lines.append("wire encodings:")
+        for sid, w in sorted(encoded_streams.items()):
+            encs = ", ".join(
+                f"{lane}={label}" for lane, label in w["encodings"].items()
+            )
+            lines.append(
+                f"  stream {sid}: {encs}  "
+                f"({w['logical_B_per_ev']} -> ~{w['encoded_B_per_ev_est']} "
+                f"B/ev{', DISABLED' if w.get('disabled') else ''})"
             )
     if plan.costs is not None:
         lines.append("per-query cost:")

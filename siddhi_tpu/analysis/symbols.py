@@ -154,6 +154,25 @@ def _check_lineage_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
         diags.append(Diagnostic("SA131", problem))
 
 
+def _check_wire_annotation(
+    app: SiddhiApp, sym: SymbolTable, diags: list[Diagnostic]
+) -> None:
+    """Validate `@app:wire(disable='true|false',
+    range/dict/delta.<stream>.<col>='...')` — the compact wire-encoding
+    layer's config. One SA132 per malformed element, using the SAME rule
+    set the runtime resolver raises on (core/wire.py
+    iter_wire_annotation_problems); the analyzer additionally passes the
+    symbol table so hint targets are checked for existence and
+    encoder/type compatibility."""
+    ann = find_annotation(app.annotations, "app:wire")
+    if ann is None:
+        return
+    from siddhi_tpu.core.wire import iter_wire_annotation_problems
+
+    for problem in iter_wire_annotation_problems(ann, streams=sym.streams):
+        diags.append(Diagnostic("SA132", problem))
+
+
 def _check_supervision_annotations(
     app: SiddhiApp, diags: list[Diagnostic]
 ) -> None:
@@ -302,6 +321,7 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
     _check_fuse_annotation(app, diags)
     _check_shard_annotation(app, diags)
     _check_lineage_annotation(app, diags)
+    _check_wire_annotation(app, sym, diags)
     _check_supervision_annotations(app, diags)
 
     return sym

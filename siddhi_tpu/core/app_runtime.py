@@ -141,6 +141,16 @@ class SiddhiAppRuntime:
         self._fuse_enabled = resolve_fuse_annotation(
             find_annotation(app.annotations, "app:fuse")
         )
+        # compact wire encodings: @app:wire(disable='true',
+        # range/dict/delta.<stream>.<col>=...) / SIDDHI_TPU_WIRE=1|0
+        # (core/wire.py; malformed options raise here — the runtime analog
+        # of the analyzer's SA132). The per-stream WireSpecs are built when
+        # the fused engines form (_build_fused_ingest).
+        from siddhi_tpu.core.wire import resolve_wire_annotation
+
+        self._wire_enabled, self._wire_hints = resolve_wire_annotation(
+            find_annotation(app.annotations, "app:wire")
+        )
         # event lineage & provenance: @app:lineage(capacity='N',
         # mode='full|sample') (observability/lineage.py; malformed options
         # raise here — the runtime analog of the analyzer's SA131).
@@ -717,7 +727,9 @@ class SiddhiAppRuntime:
         transform = _make_insert_transform(out.output_events)
         rename = _make_rename(inferred, existing)
 
-        def publish(out_batch: EventBatch, now: int, _t=target_junction) -> None:
+        def publish(
+            out_batch: EventBatch, now: int, _t=target_junction, _qr=qr
+        ) -> None:
             if (
                 not _t.subscribers
                 and not _t.stream_callbacks
@@ -726,6 +738,19 @@ class SiddhiAppRuntime:
                 and _t.lineage is None
             ):
                 return  # nobody downstream: skip the transform dispatch
+            lin = getattr(_qr, "lineage", None)
+            if lin is not None and _t.lineage is not None:
+                # per-publish producer capture (observability/lineage.py):
+                # the arena notes WHICH recorded query stamped this seq
+                # range, so multi-producer streams resolve each record to
+                # its actual producer instead of listing candidates
+                from siddhi_tpu.observability.lineage import (
+                    publisher_context,
+                )
+
+                with publisher_context(_qr.query_id, lin):
+                    _t.publish_batch(rename(transform(out_batch)), now)
+                return
             _t.publish_batch(rename(transform(out_batch)), now)
 
         qr.publish_fn = publish
@@ -1688,10 +1713,24 @@ class SiddhiAppRuntime:
                 "fusion planning failed for app '%s'; falling back to "
                 "per-junction fusion only", self.name, exc_info=True,
             )
+        from siddhi_tpu.core.wire import build_wire_spec
+
         for j in list(self.junctions.values()):
             sid = j.schema.stream_id
             pipe_on, pipe_depth = self._pipeline_conf.get(
                 sid, resolve_pipeline_annotation(None)
+            )
+            # analyzer-chosen per-column wire encodings (core/wire.py):
+            # the static spec from declared types + @app:wire hints; None
+            # when nothing is statically encodable (the sampled narrow
+            # wire stands alone) or wire encoding is disabled
+            spec = (
+                build_wire_spec(
+                    sid, j.schema.attrs, self._wire_hints,
+                    capacity=j.batch_size,
+                )
+                if self._wire_enabled
+                else None
             )
             cfg = fusion_configs.get(sid)
             if cfg is not None:
@@ -1701,11 +1740,13 @@ class SiddhiAppRuntime:
                     component=cfg["component"], residual=cfg["residual"],
                     share_sets=cfg["share_sets"],
                     plan_group=cfg["plan_group"],
+                    wire_spec=spec, wire_enabled=self._wire_enabled,
                 )
             elif j.fuse_candidates and len(j.fuse_candidates) == len(j.subscribers):
                 j.fused_ingest = FusedJunctionIngest(
                     self, j, j.fuse_candidates, chunk_batches=chunk,
                     pipeline_enabled=pipe_on, pipeline_depth=pipe_depth,
+                    wire_spec=spec, wire_enabled=self._wire_enabled,
                 )
         if self._shard is not None:
             self._shard.rearm_routers()

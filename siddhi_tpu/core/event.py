@@ -46,6 +46,9 @@ def _bitcast_split(buf, offset: int, cap: int, dt: np.dtype):
     special case lives in exactly one place."""
     seg = jax.lax.slice(buf, (offset,), (offset + cap * dt.itemsize,))
     w = dt.itemsize
+    if np.dtype(dt) == np.bool_:
+        # bitcast refuses bool targets; the encode side wrote 0/1 bytes
+        return seg.astype(jnp.bool_)
     if w == 1:
         return jax.lax.bitcast_convert_type(seg, jnp.dtype(dt))
     return jax.lax.bitcast_convert_type(
@@ -346,103 +349,29 @@ class StreamSchema:
           verifies every value fits and raises WireNarrowMisfit otherwise,
           decode upcasts back to the physical dtype.
 
+        - `narrow` entries may also be the richer encoding tuples of
+          core/wire.py — ("dict", code_dtype, card) per-chunk dictionaries,
+          ("delta", dtype) base+diff columns, ("bitpack",) 1-bit bools —
+          chosen statically by the analysis package (`@app:wire` hints,
+          WireSpec) rather than sampled; every one is guarded by the same
+          WireNarrowMisfit -> full-width-rebuild fallback.
+
         encode(ts, cols, n) -> (buf uint8[total], base int64)
         decode(buf, n, base) -> EventBatch
         """
+        from siddhi_tpu.core.wire import build_codec
+
         narrow = narrow or {}
-        key = (capacity, keep, tuple(sorted(narrow.items())))
+        key = (
+            capacity,
+            keep,
+            tuple(sorted((k, str(v)) for k, v in narrow.items())),
+        )
         cache = self.__dict__.setdefault("_wire_codecs", {})
         cached = cache.get(key)
         if cached is not None:
             return cached
-        import jax
-
-        cap = int(capacity)
-        kept = [
-            (name, t) for name, t in self.attrs
-            if keep is None or name in keep
-        ]
-        dropped = [
-            (name, t) for name, t in self.attrs
-            if not (keep is None or name in keep)
-        ]
-        # (lane, wire dtype, decoded dtype)
-        sections: list[tuple[str, np.dtype, np.dtype]] = [(
-            "__tsd__",
-            np.dtype(narrow.get("__tsd__", np.int32)),
-            np.dtype(np.int32),
-        )]
-        for name, t in kept:
-            wide = np.dtype(PHYSICAL_DTYPE[t])
-            sections.append((name, np.dtype(narrow.get(name, wide)), wide))
-        offsets = []
-        off = 0
-        for _name, dt, _w in sections:
-            offsets.append(off)
-            off += cap * dt.itemsize
-        total = off
-
-        tsd_diff = sections[0][1].itemsize < 4  # narrow tsd = diff-coded
-
-        def encode(timestamps: np.ndarray, cols: dict, n: int):
-            base = np.int64(timestamps[0]) if n > 0 else np.int64(0)
-            buf = np.zeros((total,), dtype=np.uint8)
-            for (name, dt, wide), o in zip(sections, offsets):
-                dst = buf[o : o + cap * dt.itemsize].view(dt)
-                if name == "__tsd__":
-                    ts64 = timestamps[:n].astype(np.int64, copy=False)
-                    if n > 0 and (
-                        int(ts64.max()) - int(base) >= (1 << 31)
-                        or int(ts64.min()) - int(base) < -(1 << 31)
-                    ):
-                        raise ValueError(
-                            "wire_codec: timestamp span exceeds int32 deltas "
-                            "(>~24.8 days per batch); use packed_codec"
-                        )
-                    src = (
-                        np.diff(ts64, prepend=base) if tsd_diff
-                        else ts64 - base
-                    )
-                else:
-                    src = cols[name][:n]
-                if dt.itemsize < wide.itemsize and n > 0:
-                    info = np.iinfo(dt)
-                    if (
-                        int(src.min(initial=0)) < info.min
-                        or int(src.max(initial=0)) > info.max
-                    ):
-                        raise WireNarrowMisfit(name)
-                dst[:n] = src.astype(dt, copy=False)
-            return buf, base
-
-        def decode(buf, n, base):
-            cols_out = {}
-            ts = None
-            for (name, dt, wide), o in zip(sections, offsets):
-                arr = _bitcast_split(buf, o, cap, dt)
-                if name == "__tsd__":
-                    if tsd_diff:
-                        arr = jnp.cumsum(arr.astype(jnp.int32))
-                    ts = base + arr.astype(jnp.int64)
-                else:
-                    cols_out[name] = arr.astype(jnp.dtype(wide))
-            for name, t in dropped:
-                nv = null_value(t)
-                cols_out[name] = jnp.full(
-                    (cap,),
-                    np.asarray(0 if nv is None else nv, PHYSICAL_DTYPE[t]),
-                    dtype=PHYSICAL_DTYPE[t],
-                )
-            cols_out = {n2: cols_out[n2] for n2, _ in self.attrs}
-            valid = jnp.arange(cap, dtype=jnp.int32) < n
-            return EventBatch(
-                ts=ts,
-                kind=jnp.zeros((cap,), jnp.int8),
-                valid=valid,
-                cols=cols_out,
-            )
-
-        codec = (encode, decode, total)
+        codec = build_codec(self, capacity, keep, narrow)
         cache[key] = codec
         return codec
 

@@ -100,6 +100,8 @@ class FusedJunctionIngest:
         residual=None,
         share_sets=None,
         plan_group=None,
+        wire_spec=None,
+        wire_enabled: bool = True,
     ):
         self.app = app
         self.junction = junction
@@ -154,9 +156,14 @@ class FusedJunctionIngest:
         self._fused = None
         self._fused_deliver = None
         self._disabled = False
-        # narrow wire dtypes: None = not chosen yet (sampled from the first
-        # engaged send); {} = full width (permanent after any misfit)
+        # wire encodings (core/wire.py): None = not chosen yet (decided at
+        # the first engaged send: the static WireSpec's analyzer-chosen
+        # encoders overlaid on the sampled narrow dtypes when enabled; {}
+        # when wire encoding is off OR permanently after any misfit =
+        # full-width wire)
         self._narrow = None
+        self.wire_spec = wire_spec
+        self.wire_enabled = bool(wire_enabled)
         self._lock = threading.Lock()
         # double-buffered chunk pipeline (core/pipeline.py): built lazily on
         # the first engaged send; senders serialize on _send_lock so the
@@ -212,7 +219,28 @@ class FusedJunctionIngest:
         pl = self.pipeline
         if pl is not None:
             d.update(pl.describe_state())
+        if self._narrow is not None:
+            # per-column wire-encoding choices + encoded-vs-logical
+            # bytes/event (core/wire.py), surfaced in /status.json,
+            # explain(), and /profile
+            from siddhi_tpu.core.wire import wire_report
+
+            d["wire"] = wire_report(
+                self.junction.schema, getattr(self, "_keep", None),
+                self._narrow, self.wire_spec,
+                capacity=self.junction.batch_size,
+            )
         return d
+
+    def force_full_width(self) -> None:
+        """Pin the wire full-width permanently, discarding any chosen
+        encodings (bench's enc-vs-raw A/B and tests; the same state a
+        runtime misfit fallback lands in). The next send rebuilds the
+        programs against the wide codec; call between sends only."""
+        with self._lock:
+            self._narrow = {}
+            self._fused = None
+            self._fused_deliver = None
 
     def group_report(self) -> Optional[dict]:
         """Achieved-vs-predicted dispatch reduction for a plan-driven fused
@@ -268,8 +296,12 @@ class FusedJunctionIngest:
         the program exactly — the one place the staging handshake lives."""
         with self._lock:
             if self._narrow is None:
-                self._narrow = self.junction.schema.propose_narrow(
-                    ts_sample, cols_sample, self._compute_keep()
+                from siddhi_tpu.core.wire import choose_encodings
+
+                self._narrow = choose_encodings(
+                    self.junction.schema, self._compute_keep(),
+                    self.wire_spec, self.wire_enabled,
+                    ts_sample, cols_sample,
                 )
             if self._fused is None:
                 self._build()
@@ -338,6 +370,13 @@ class FusedJunctionIngest:
         _encode, decode, self._wire_bytes = schema.wire_codec(
             B, self._keep, self._narrow or {}
         )
+        # roofline numerators: encoded bytes ship over the link; logical
+        # bytes are what the full-width packed wire would have carried
+        # (int64 ts + every column at physical width) — the live
+        # logical-vs-encoded gauges divide both by h2d_events
+        from siddhi_tpu.core.wire import logical_row_bytes
+
+        self._logical_row_bytes = logical_row_bytes(schema.attrs)
         impls = [ep.impl_factory() for ep in self.endpoints]
         impls_want = [ep.qr.output_events for ep in self.endpoints]
         # deliver lanes ship only the out-schema columns: a lineage-armed
@@ -570,13 +609,19 @@ class FusedJunctionIngest:
             if (self._fused_deliver if deliver else self._fused) is None:
                 try:
                     if self._narrow is None:
-                        # sample the first micro-batch of the first engaged
-                        # send: smallest int dtypes with 4x headroom; any
-                        # later misfit rebuilds full-width (once)
-                        self._narrow = self.junction.schema.propose_narrow(
+                        # wire-encoding decision at first engagement
+                        # (core/wire.py): the static WireSpec's
+                        # analyzer-chosen encoders (dict/delta/range-narrow/
+                        # bitpack) overlaid on dtypes sampled from the first
+                        # micro-batch; {} (full width) when disabled. Any
+                        # later misfit rebuilds full-width (once).
+                        from siddhi_tpu.core.wire import choose_encodings
+
+                        self._narrow = choose_encodings(
+                            self.junction.schema, self._compute_keep(),
+                            self.wire_spec, self.wire_enabled,
                             ts_arr[:B],
                             {k: np.asarray(v)[:B] for k, v in cols.items()},
-                            self._compute_keep(),
                         )
                     self._build(deliver_set=dset if deliver else None)
                 except Exception:
@@ -778,7 +823,14 @@ class FusedJunctionIngest:
                         ds.h2d_chunks.add(1)
                         # live roofline numerator/denominator pair: the
                         # always-on wire bytes/event gauge rides these
-                        ds.h2d_events.add(int(counts.sum()))
+                        n_ev = int(counts.sum())
+                        ds.h2d_events.add(n_ev)
+                        # logical-vs-encoded split (core/wire.py): what the
+                        # full-width wire would have shipped for the same
+                        # events, so the encoded gauge has a denominator
+                        ds.h2d_logical.add(
+                            n_ev * self._logical_row_bytes
+                        )
                     if ps is not None:
                         ps.dispatch.record_ns(dt)
                     if wf is not None:

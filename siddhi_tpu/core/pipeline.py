@@ -134,14 +134,25 @@ class _WireSlot:
       TRANSFER completed;
     * aliased (or unknown): retire() swaps `ref` for a completion array of
       the dispatch that READ the wire — only the program finishing frees
-      the buffer for overwrite."""
+      the buffer for overwrite.
 
-    __slots__ = ("buf", "ref", "aliased")
+    Copied shipments additionally form a device-side STAGING RING: the
+    slot keeps `dev` (the device wire) and `dev_gate` (a completion array
+    of the consuming dispatch), and the next acquire() of the slot
+    explicitly deletes the retired device buffer once the dispatch that
+    read it finished — steady-state ingest then cycles `depth` device
+    staging buffers through the allocator deterministically instead of
+    letting GC lag grow device memory (the h2d-wall work's
+    "persistent donated device-side staging rings")."""
+
+    __slots__ = ("buf", "ref", "aliased", "dev", "dev_gate")
 
     def __init__(self, shape):
         self.buf = np.zeros(shape, dtype=np.uint8)
         self.ref = None
         self.aliased = True
+        self.dev = None
+        self.dev_gate = None
 
 
 class IngestPipeline:
@@ -194,6 +205,23 @@ class IngestPipeline:
                 # cannot race this wait)
                 pass
             slot.ref = None
+        if slot.dev is not None:
+            # staging ring: free the previous cycle's device wire once the
+            # dispatch that READ it completed (dev_gate) — but only when
+            # that completion is ALREADY ready (steady state): a blocking
+            # wait here would re-serialize the encode-under-dispatch
+            # overlap the pipeline exists for. Not-yet-ready (or gateless:
+            # failed submit / donated-only outputs) buffers are abandoned
+            # to GC — deleting under a possibly-running program would be a
+            # device UAF.
+            gate, slot.dev_gate = slot.dev_gate, None
+            dev, slot.dev = slot.dev, None
+            if gate is not None:
+                try:
+                    if gate.is_ready():
+                        dev.delete()
+                except Exception:
+                    pass
         return slot
 
     def ship(self, slot: _WireSlot):
@@ -228,6 +256,12 @@ class IngestPipeline:
         still-running program can never see the next chunk's bytes. No-op
         for copied shipments: ship()'s transfer gate suffices."""
         if not slot.aliased:
+            # copied shipment: the host buffer only needs the transfer
+            # gate (ship() set it), but the DEVICE wire joins the staging
+            # ring — record the consuming dispatch's completion so the
+            # next cycle can free it deterministically (see acquire())
+            slot.dev = slot.ref
+            slot.dev_gate = completion
             return
         if completion is not None:
             slot.ref = completion

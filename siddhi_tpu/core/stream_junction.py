@@ -418,6 +418,25 @@ class StreamJunction:
                 # range is read under this same lock by the @OnError STORE
                 # path (la.last_range) and attached to the publish span
                 seq_range = la.record_batch(batch)
+                if seq_range[1]:
+                    from siddhi_tpu.observability.lineage import (
+                        current_publisher,
+                    )
+
+                    pub = current_publisher()
+                    if pub is not None:
+                        # per-publish producer capture: this stamp came
+                        # from a recorded query's insert — note which, so
+                        # multi-producer streams resolve seq -> producer.
+                        # pub_base: the recorder counted this batch's
+                        # published records in observe() (receive runs
+                        # before the publish), so the range starts
+                        # n records back from its pub_count.
+                        qid, rec = pub
+                        la.note_producer(
+                            seq_range[0], seq_range[1], qid,
+                            max(rec.pub_count - seq_range[1], 0),
+                        )
             n_valid = -1
             if self.on_publish_stats is not None:
                 n_valid = int(np.asarray(batch.valid).sum())

@@ -457,8 +457,13 @@ class SlidingWindow(WindowStage):
 
 def _place_ring(old, evicted, slots, vals):
     # set_at: 64-bit lanes (ts/wts/seq/long cols) ride the int32-pair scatter
-    # (a raw 64-bit scatter-set serializes on TPU, ops/scatter.py)
-    return _set_at(jnp.where(evicted, 0, old), slots, vals)
+    # (a raw 64-bit scatter-set serializes on TPU, ops/scatter.py).
+    # Zero typed to the lane dtype: a weak `0` literal promotes BOOL lanes
+    # to int64, which breaks the fused scan carry (bool cols reach the
+    # fused path since the bit-packed wire, core/wire.py)
+    return _set_at(
+        jnp.where(evicted, jnp.zeros((), old.dtype), old), slots, vals
+    )
 
 
 # ---------------------------------------------------------------------------

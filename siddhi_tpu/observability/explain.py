@@ -235,6 +235,17 @@ def build_plan(app, runtime=None) -> dict:
                         sr = getattr(fi, "shard_router", None)
                         if sr is not None:
                             counters["shard"] = sr.describe_state()
+                        # compact wire encodings (core/wire.py): per-column
+                        # encoder choices + encoded-vs-logical bytes/event,
+                        # once the first engaged send chose them
+                        if fi._narrow is not None:
+                            from siddhi_tpu.core.wire import wire_report
+
+                            counters["wire"] = wire_report(
+                                j.schema, getattr(fi, "_keep", None),
+                                fi._narrow, fi.wire_spec,
+                                capacity=j.batch_size,
+                            )
                 except Exception:
                     pass
         if ct is not None:
@@ -448,6 +459,16 @@ def _fmt_counters(c: Optional[dict]) -> str:
             )
         else:
             parts.append(f"shard[off: {s.get('reason')}]")
+    if "wire" in c:
+        w = c["wire"]
+        encs = " ".join(
+            f"{lane}:{label}" for lane, label in w.get("lanes", {}).items()
+        )
+        parts.append(
+            f"wire[{w.get('source')}] {encs} "
+            f"{w.get('encoded_B_per_ev')}B/ev (logical "
+            f"{w.get('logical_B_per_ev')}B/ev)"
+        )
     if "lineage" in c:
         li = c["lineage"]
         parts.append(

@@ -87,6 +87,37 @@ LIN_SIDE = "__lin@"
 DEFAULT_CAPACITY = 1024
 _MAX_CAPACITY = 1 << 20
 _MODES = ("full", "sample")
+
+# thread-local "current publisher" set around a lineage-recorded query's
+# insert-target publish (app_runtime._wire_insert): the arena stamping
+# inside StreamJunction._publish_batch reads it to attribute the seq range
+# to its actual producer (multi-producer resolution)
+_PUB_TLS = threading.local()
+
+
+class publisher_context:
+    """Context manager marking (qid, recorder) as the publisher of every
+    arena stamp inside the block. Re-entrant per thread (insert-into
+    chains nest): the previous publisher is restored on exit."""
+
+    __slots__ = ("_pub", "_prev")
+
+    def __init__(self, qid: str, recorder):
+        self._pub = (qid, recorder)
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_PUB_TLS, "pub", None)
+        _PUB_TLS.pub = self._pub
+        return self
+
+    def __exit__(self, *exc):
+        _PUB_TLS.pub = self._prev
+        return False
+
+
+def current_publisher() -> Optional[tuple]:
+    return getattr(_PUB_TLS, "pub", None)
 DEFAULT_SAMPLE_EVERY = 16
 
 # resolution expands at most this many individual seqs per input-stream
@@ -186,11 +217,36 @@ class LineageArena(FlightRecorder):
     def __init__(self, schema, interner, size: int):
         super().__init__(schema, interner, size)
         self.last_range: tuple[int, int] = (0, 0)
+        # per-publish producer capture: (base_seq, n, qid, pub_base)
+        # appended when a lineage-recorded query's publish stamped the
+        # range (see publisher_context / StreamJunction._publish_batch) —
+        # multi-producer streams then resolve seq s to the producer whose
+        # publish covered it, instead of just listing candidates
+        self.pub_log: deque = deque(maxlen=max(int(size), 64))
 
     @property
     def next_seq(self) -> int:
         with self._lock:
             return self._count
+
+    def note_producer(
+        self, base: int, n: int, qid: str, pub_base: int
+    ) -> None:
+        with self._lock:
+            self.pub_log.append((int(base), int(n), qid, int(pub_base)))
+
+    def producer_for_seq(self, seq: int) -> Optional[tuple]:
+        """(qid, producer pub_index) of the recorded publish covering
+        `seq`, or None (unlogged: an external input handler, a fused
+        commit, or an evicted log entry)."""
+        s = int(seq)
+        with self._lock:
+            for base, n, qid, pub_base in reversed(self.pub_log):
+                if base <= s < base + n:
+                    return qid, pub_base + (s - base)
+                if base + n <= s:
+                    break  # log is base-ordered: older entries only below
+        return None
 
     def record_batch(self, batch) -> tuple[int, int]:
         """Stamp + record a device batch's valid CURRENT rows; returns the
@@ -943,11 +999,32 @@ class LineageLedger:
                     "error": "record evicted or sampled out",
                 }
         elif prods:
-            # multi-writer, or a producer whose publish count doesn't
-            # match the arena (an external input handler also feeds this
-            # stream): seq attribution would be a guess — list, don't walk
-            node["producers"] = prods
-            node["mixed"] = True
+            # multi-writer stream: the arena's per-publish producer log
+            # (note_producer) resolves WHICH recorded query stamped this
+            # seq — walk that producer's record. Unlogged seqs (external
+            # input handler interleaved, or the log entry evicted) fall
+            # back to listing the candidates.
+            hit = (
+                arena.producer_for_seq(int(index))
+                if arena is not None
+                else None
+            )
+            if hit is not None and hit[0] in recs and depth > 0:
+                qid, pub_idx = hit
+                node["producer"] = qid
+                rec = recs[qid].record_for_pub_index(pub_idx)
+                if rec is not None:
+                    node["via"] = self._resolve_record(
+                        qid, rec, depth - 1, recs
+                    )
+                else:
+                    node["via"] = {
+                        "query": qid,
+                        "error": "record evicted or sampled out",
+                    }
+            else:
+                node["producers"] = prods
+                node["mixed"] = True
         return node
 
     def _resolve_record(
@@ -998,6 +1075,27 @@ class LineageLedger:
                         if up is not None:
                             ups.append(
                                 self._resolve_record(sole, up, depth - 1, recs)
+                            )
+                    if ups:
+                        entry["via"] = ups
+                else:
+                    # multi-producer upstream: resolve each contributing
+                    # seq to ITS producer via the arena's publish log
+                    ups = []
+                    for s in seqs[:8]:
+                        hit = (
+                            arena.producer_for_seq(s)
+                            if arena is not None
+                            else None
+                        )
+                        if hit is None or hit[0] not in recs:
+                            continue
+                        up = recs[hit[0]].record_for_pub_index(hit[1])
+                        if up is not None:
+                            ups.append(
+                                self._resolve_record(
+                                    hit[0], up, depth - 1, recs
+                                )
                             )
                     if ups:
                         entry["via"] = ups

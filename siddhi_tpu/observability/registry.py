@@ -30,7 +30,10 @@ class JunctionDeviceStats:
     dispatch time, h2d wire traffic, and d2h truth-sync stalls (the engine's
     live version of what bench.py's `timebudget` leg reconstructs offline)."""
 
-    __slots__ = ("step", "h2d_bytes", "h2d_chunks", "h2d_events", "sync_stall")
+    __slots__ = (
+        "step", "h2d_bytes", "h2d_chunks", "h2d_events", "h2d_logical",
+        "sync_stall",
+    )
 
     def __init__(self, registry: "StatisticsManager", component: str) -> None:
         self.step = registry.device_time_tracker(component, "fused_step")
@@ -40,6 +43,12 @@ class JunctionDeviceStats:
         # roofline attribution (bytes/event) the compact-wire-encoding
         # work targets (BENCH r04 `*_wire_B_per_ev`, but always-on)
         self.h2d_events = registry.device_counter(component, "h2d_events")
+        # what the FULL-WIDTH wire would have carried for the same events
+        # (core/wire.py logical_row_bytes): the logical side of the
+        # logical-vs-encoded bytes/event split
+        self.h2d_logical = registry.device_counter(
+            component, "h2d_logical_bytes"
+        )
         self.sync_stall = registry.device_time_tracker(component, "sync_stall")
 
 
@@ -219,13 +228,24 @@ class StatisticsManager:
             comp = t.component
             ev = self.device_counters.get(f"{comp}.h2d_events")
             n_ev = ev.count if ev is not None else 0
+            lg = self.device_counters.get(f"{comp}.h2d_logical_bytes")
+            n_lg = lg.count if lg is not None else 0
             entry = {
                 "h2d_bytes": t.count,
                 "h2d_events": n_ev,
+                "h2d_logical_bytes": n_lg,
                 "h2d_mb_s_1m": round(t.rate_1m / 1e6, 3),
             }
             if n_ev > 0:
+                # the encoded-vs-logical split (core/wire.py): encoded is
+                # what actually crossed the link, logical is the full-width
+                # equivalent; their ratio is the live wire reduction
                 entry["wire_bytes_per_event"] = round(t.count / n_ev, 3)
+                if n_lg > 0:
+                    entry["wire_logical_bytes_per_event"] = round(
+                        n_lg / n_ev, 3
+                    )
+                    entry["wire_reduction"] = round(n_lg / t.count, 3)
             out[comp] = entry
         return out
 

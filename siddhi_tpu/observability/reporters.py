@@ -131,10 +131,19 @@ _FAMILIES = {
         "counter",
         "Events shipped over the fused h2d wire per junction (the "
         "roofline denominator beside siddhi_h2d_bytes_total)"),
+    "siddhi_h2d_logical_bytes_total": (
+        "counter",
+        "Full-width (logical) bytes the same events would have shipped "
+        "with wire encoding off — the logical side of the encoded-vs-"
+        "logical split (core/wire.py)"),
     "siddhi_wire_bytes_per_event": (
         "gauge",
-        "Live wire bytes per event over the fused h2d path — the "
-        "roofline attribution the compact-wire-encoding work targets"),
+        "Live ENCODED wire bytes per event over the fused h2d path — the "
+        "roofline attribution the compact wire encodings shrink"),
+    "siddhi_wire_logical_bytes_per_event": (
+        "gauge",
+        "Logical (full-width) bytes per event of the same stream — "
+        "encoded/logical is the live wire reduction"),
     "siddhi_h2d_mb_s": (
         "gauge",
         "1-minute EWMA host-to-device wire throughput in MB/s per "
@@ -229,6 +238,12 @@ def render_prometheus(reports: list[dict]) -> str:
                 body["siddhi_wire_bytes_per_event"].append(
                     f"siddhi_wire_bytes_per_event{_labels(app=app, component=n)}"
                     f" {bpe}"
+                )
+            lpe = ent.get("wire_logical_bytes_per_event")
+            if lpe is not None:
+                body["siddhi_wire_logical_bytes_per_event"].append(
+                    "siddhi_wire_logical_bytes_per_event"
+                    f"{_labels(app=app, component=n)} {lpe}"
                 )
             body["siddhi_h2d_mb_s"].append(
                 f"siddhi_h2d_mb_s{_labels(app=app, component=n)}"

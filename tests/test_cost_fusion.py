@@ -51,7 +51,7 @@ select symbol insert into SlowOut;
 
 # the FusionPlan contract for SNAPSHOT_APP (costs asserted separately)
 SNAPSHOT_PLAN = {
-    "version": 1,
+    "version": 2,
     "app": "SiddhiApp",
     "chunk": {"batch_size": 64, "chunk_batches": 32},
     "groups": [
@@ -83,6 +83,19 @@ SNAPSHOT_PLAN = {
             "est_bytes_saved": 1600,
         }
     ],
+    # v2: the per-stream static WireSpec (core/wire.py) — SNAPSHOT_APP
+    # declares no @app:wire hints and no BOOL columns, so nothing is
+    # statically encodable; the section still names the predicted
+    # logical bytes/event the sampled narrow wire shrinks from
+    "wire": {
+        "S": {
+            "version": 1,
+            "source": "static",
+            "encodings": {},
+            "logical_B_per_ev": 16,
+            "encoded_B_per_ev_est": 12,
+        }
+    },
 }
 
 
@@ -133,7 +146,7 @@ class TestPlanSnapshot:
         p.write_text(SNAPSHOT_APP)
         assert lint_main(["--plan", str(p)]) == 0
         out = capsys.readouterr().out
-        assert "FUSION PLAN v1" in out
+        assert "FUSION PLAN v2" in out
         assert "stream S: avg50, max50" in out
         assert "slow on S: scheduler" in out
         assert "shared-state candidates:" in out
@@ -154,7 +167,7 @@ class TestPlanSnapshot:
             bench.WORKLOADS.items()
         ):
             plan = build_fusion_plan(ql).to_dict()
-            assert plan["version"] == 1, name
+            assert plan["version"] == 2, name
             assert plan["costs"]["queries"], name
 
 
@@ -495,4 +508,4 @@ class TestAnalyzeCarriesPlan:
         assert buf.getvalue() == ""
         from siddhi_tpu.analysis.fusion import render_plan_text
 
-        assert "FUSION PLAN v1" in render_plan_text(plan)
+        assert "FUSION PLAN v2" in render_plan_text(plan)

@@ -168,7 +168,14 @@ class TestTimeSnapshotRateLimitGolden:
     SnapshotOutputRateLimitTestCase: wall-clock-driven flushes are polled
     with generous bounds (the reference sleeps ~1.2 s and asserts counts)."""
 
-    def _run_timed(self, ql, sends, want, timeout=12.0):
+    def _run_timed(self, ql, sends, want, timeout=12.0, until=None):
+        """Wall-clock rate-limit harness. `want` stops the wait once that
+        many rows were delivered; `until(ins, rem)` instead waits for a
+        SEMANTIC condition — needed for snapshot outputs, where under a
+        loaded suite the 1-sec timer can fire several times before the
+        last sends are even processed, so a row count alone can stop the
+        wait on a snapshot that predates them (the or14/partition-golden
+        wall-clock-race class)."""
         mgr = SiddhiManager()
         rt = mgr.create_siddhi_app_runtime(ql)
         ins, rem = [], []
@@ -184,7 +191,12 @@ class TestTimeSnapshotRateLimitGolden:
         for row in sends:
             h.send(row)
         t0 = time.time()
-        while len(ins) + len(rem) < want and time.time() - t0 < timeout:
+        while time.time() - t0 < timeout:
+            if until is not None:
+                if until(ins, rem):
+                    break
+            elif len(ins) + len(rem) >= want:
+                break
             time.sleep(0.05)
         rt.shutdown()
         mgr.shutdown()
@@ -226,10 +238,15 @@ class TestTimeSnapshotRateLimitGolden:
 
     def test_snapshot2_aggregation(self):
         # snapshot of a group-by aggregation re-emits every group's latest
+        both = {("192.10.1.5", 2), ("192.10.1.3", 1)}
         ins, _ = self._run_timed(LOGIN + """@info(name = 'query1')
         from LoginEvents select ip, count() as total group by ip
         output snapshot every 1 sec
         insert into uniqueIps ;""",
-            [(1, "192.10.1.5"), (2, "192.10.1.5"), (3, "192.10.1.3")], 2)
+            [(1, "192.10.1.5"), (2, "192.10.1.5"), (3, "192.10.1.3")], 2,
+            # wait for a snapshot that saw ALL the sends: snapshots
+            # re-emit every group's latest each period, so one period
+            # after the last send processes, both rows appear
+            until=lambda i, _r: both <= {tuple(r) for r in i})
         got = {tuple(r) for r in ins}
-        assert ("192.10.1.5", 2) in got and ("192.10.1.3", 1) in got, ins
+        assert both <= got, ins

@@ -755,3 +755,78 @@ class TestSurfaces:
         assert st["streams"]["S"]["lineage"]["next_seq"] == 1
         assert st["queries"]["q"]["lineage"]["outputs"] >= 1
         mgr.shutdown()
+
+
+MULTI_PRODUCER_APP = """
+@app:lineage(capacity='256')
+define stream S (a int);
+define stream Mid (a int, tag int);
+@info(name='pA') from S[a % 2 == 0] select a, 100 as tag insert into Mid;
+@info(name='pB') from S[a % 2 == 1] select a, 200 as tag insert into Mid;
+@info(name='c') from Mid#window.length(4) select a, tag insert into Out;
+"""
+
+
+class TestMultiProducer:
+    """Per-publish producer capture (LineageArena.pub_log): a stream fed by
+    TWO recorded queries resolves each seq to the producer whose publish
+    stamped it, instead of listing candidates (the PR 12 carried-forward)."""
+
+    def test_seq_resolves_to_actual_producer(self):
+        mgr, rt = _mk(MULTI_PRODUCER_APP)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(10):
+            h.send([i], timestamp=1000 + i)
+        _drain()
+        arena = rt.junctions["Mid"].lineage
+        assert arena.next_seq == 10
+        for s in range(10):
+            node = rt.lineage("Mid", s)
+            a, tag = node["event"]
+            want = "pA" if a % 2 == 0 else "pB"
+            assert node.get("producer") == want, node
+            via = node["via"]
+            assert via["query"] == want
+            # the producer's record walks back to the exact S event
+            (inp,) = via["inputs"]
+            assert inp["stream"] == "S"
+            assert [e["event"] for e in inp["events"]] == [[a]]
+        mgr.shutdown()
+
+    def test_consumer_inputs_walk_through_producers(self):
+        mgr, rt = _mk(MULTI_PRODUCER_APP)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(6):
+            h.send([i], timestamp=1000 + i)
+        _drain()
+        # the window consumer's record on Mid resolves each contributing
+        # seq to ITS producer (pA for evens, pB for odds)
+        node = rt.lineage("c")
+        (mid,) = node["inputs"]
+        assert mid["stream"] == "Mid"
+        ups = mid.get("via")
+        assert ups, node
+        for up in ups:
+            a = up["inputs"][0]["events"][0]["event"][0]
+            assert up["query"] == ("pA" if a % 2 == 0 else "pB"), up
+        mgr.shutdown()
+
+    def test_external_interleaved_writer_stays_mixed(self):
+        # an input handler ALSO feeds Mid: unlogged seqs must not be
+        # mis-attributed — they fall back to the candidate listing
+        mgr, rt = _mk(MULTI_PRODUCER_APP)
+        rt.start()
+        h = rt.get_input_handler("S")
+        hm = rt.get_input_handler("Mid")
+        h.send([2], timestamp=1000)     # seq 0 <- pA
+        hm.send([9, 900], timestamp=1001)  # seq 1 <- external writer
+        h.send([3], timestamp=1002)     # seq 2 <- pB
+        _drain()
+        assert rt.lineage("Mid", 0).get("producer") == "pA"
+        ext = rt.lineage("Mid", 1)
+        assert "producer" not in ext and ext.get("mixed"), ext
+        assert sorted(ext["producers"]) == ["pA", "pB"]
+        assert rt.lineage("Mid", 2).get("producer") == "pB"
+        mgr.shutdown()

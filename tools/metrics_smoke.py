@@ -79,9 +79,13 @@ def _run(blob: dict) -> int:
     from siddhi_tpu import SiddhiManager
 
     mgr = SiddhiManager()
+    # @app:wire: dictionary-encode the interned symbol column statically
+    # (core/wire.py), so the encoded-vs-logical roofline split below is
+    # exercised by an analyzer-chosen encoder, not just the sampled narrow
     rt = mgr.create_siddhi_app_runtime("""
     @app:statistics(reporter='prometheus', port='0', trace.sample='1.0')
     @app:lineage(capacity='512')
+    @app:wire(dict.S.symbol='8')
     @flightRecorder(size='16')
     define stream S (symbol string, price float);
     @info(name='q')
@@ -170,11 +174,20 @@ def _run(blob: dict) -> int:
     # live roofline gauges: the fused columnar send above shipped wire
     # bytes, so /metrics and /profile must carry bytes/event + MB/s
     assert "siddhi_wire_bytes_per_event" in text, "no roofline gauge"
+    assert "siddhi_wire_logical_bytes_per_event" in text, (
+        "no logical-bytes gauge (encoded-vs-logical split)"
+    )
     assert "siddhi_h2d_mb_s" in text, "no h2d MB/s gauge"
     roof = profile[0].get("roofline", {})
-    assert roof.get("stream.S", {}).get("wire_bytes_per_event", 0) > 0, (
+    s_roof = roof.get("stream.S", {})
+    assert s_roof.get("wire_bytes_per_event", 0) > 0, (
         f"/profile roofline must be live: {roof}"
     )
+    # the compact wire encodings contract: on this statically dict-encoded
+    # stream the encoded bytes/event must be strictly below logical
+    assert 0 < s_roof["wire_bytes_per_event"] < s_roof[
+        "wire_logical_bytes_per_event"
+    ], f"encoded must undercut logical: {s_roof}"
 
     # event lineage & provenance: /lineage.json must resolve a known
     # match back to its exact contributing input events
