@@ -1176,6 +1176,115 @@ def _verify_tpu_vs_cpu(args) -> dict:
     return {"verify_pass": n_pass, "verify_cases": len(per_case)}
 
 
+def _leg_disorder(events: int) -> dict:
+    """A/B disorder run under @app:watermark: an ordered feed vs the SAME
+    feed shuffled within the watermark bound by the seeded `ingest_disorder`
+    fault site, pushed through the bounded reorder stage. Reports the
+    shuffled run's throughput, reorder-buffer occupancy, watermark-lag p99
+    across the feed, late-event counts, and whether the two runs' emissions
+    (rows + checksum) match exactly — the engine-level parity headline."""
+    import zlib
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.testing import faults
+
+    n = max(4_096, min(int(events), 200_000))
+    base = 1_700_000_000_000
+    step_ms = 7
+    jitter_ms = 1500  # < the 2 sec bound below; displaces rows ~214 slots
+    ql = """
+    @app:watermark(bound='2 sec')
+    define stream S (sym string, price double, vol long);
+    @info(name='q')
+    from S#window.length(64)
+    select sym, sum(price) as total, count() as cnt
+    insert into Out;
+    """
+    rng = np.random.default_rng(5)
+    ts = base + np.arange(n, dtype=np.int64) * step_ms
+    syms = np.asarray([f"S{i % 8}" for i in range(n)])
+    price = np.round(rng.uniform(10.0, 100.0, n), 2)
+    vol = rng.integers(1, 500, n).astype(np.int64)
+    chunk = 2048
+
+    def run(disorder: bool) -> dict:
+        if disorder:
+            faults.install(faults.parse_plan(
+                f"seed=29;ingest_disorder:jitter={jitter_ms},times=-1"
+            ))
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(ql)
+            crc = [0]
+            rows = [0]
+
+            def on_out(evs):
+                for e in evs:
+                    s = f"{e.timestamp}|{e.data[0]}|{e.data[1]:.3f}|{e.data[2]}"
+                    crc[0] = zlib.crc32(s.encode(), crc[0])
+                rows[0] += len(evs)
+
+            rt.add_callback("Out", on_out)
+            rt.start()
+            tracker = rt._watermark.trackers
+            lags, occupancy = [], []
+            h = rt.get_input_handler("S")
+            t0 = time.perf_counter()
+            for i in range(0, n, chunk):
+                h.send_columns(
+                    ts[i:i + chunk],
+                    {
+                        "sym": syms[i:i + chunk],
+                        "price": price[i:i + chunk],
+                        "vol": vol[i:i + chunk],
+                    },
+                )
+                d = tracker["S"].describe()
+                if d["lag_ms"] is not None:
+                    lags.append(d["lag_ms"])
+                occupancy.append(d["buffered"])
+            rt.drain_watermarks()
+            wall = time.perf_counter() - t0
+            ws = rt.snapshot_status()["watermark"]["streams"]["S"]
+            rt.shutdown()
+            mgr.shutdown()
+            return {
+                "events_per_s": n / wall if wall > 0 else 0.0,
+                "rows": rows[0],
+                "crc": crc[0],
+                "lag_p99_ms": (
+                    float(np.percentile(np.asarray(lags), 99)) if lags else 0.0
+                ),
+                "mean_buffered": (
+                    float(np.mean(occupancy)) if occupancy else 0.0
+                ),
+                "peak_buffered": ws["peak_buffered"],
+                "released": ws["released"],
+                "late_total": ws["late_total"],
+            }
+        finally:
+            if disorder:
+                faults.uninstall()
+
+    ordered = run(disorder=False)
+    shuffled = run(disorder=True)
+    return {
+        "disorder": round(shuffled["events_per_s"], 1),
+        "disorder_parity": (
+            ordered["rows"] == shuffled["rows"]
+            and ordered["crc"] == shuffled["crc"]
+            and ordered["rows"] > 0
+        ),
+        "disorder_rows": shuffled["rows"],
+        "disorder_lag_p99_ms": round(shuffled["lag_p99_ms"], 1),
+        "disorder_peak_buffered": shuffled["peak_buffered"],
+        "disorder_mean_buffered": round(shuffled["mean_buffered"], 1),
+        "disorder_released": shuffled["released"],
+        "disorder_late_total": shuffled["late_total"],
+        "disorder_ordered_events_per_s": round(ordered["events_per_s"], 1),
+    }
+
+
 def _run_leg(name: str, args) -> dict:
     if name in WORKLOADS or name.endswith("_delivered"):
         v = _leg_throughput(name, args.events, args.batch)
@@ -1191,6 +1300,8 @@ def _run_leg(name: str, args) -> dict:
         return _leg_timebudget(args.batch)
     if name == "verify_cases":
         return _leg_verify()
+    if name == "disorder":
+        return _leg_disorder(args.events)
     if name == "verify":
         return _verify_tpu_vs_cpu(args)
     if name == "wire":
@@ -1351,7 +1462,7 @@ def main():
     legs = list(WORKLOADS) + [
         "filter_window_avg_delivered", "pattern_2state_delivered",
         "tumbling_groupby_delivered", "p99", "tables", "wire", "timebudget",
-        "verify",
+        "disorder", "verify",
     ]
     if args.shard:
         legs.append("shard")

@@ -75,6 +75,9 @@ CODES: dict[str, str] = {
              "wide wire encoding that dominates the stream's h2d "
              "bytes/event — declare an int/long range (or dict/delta) via "
              "@app:wire, or use interned strings (warning)",
+    "SA134": "invalid @app:watermark annotation (missing/bad bound / bad "
+             "idle.timeout / unknown late.policy / allowed.lateness "
+             "without late.policy='apply' / unknown option)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

@@ -173,6 +173,21 @@ def _check_wire_annotation(
         diags.append(Diagnostic("SA132", problem))
 
 
+def _check_watermark_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
+    """Validate `@app:watermark(bound='...', idle.timeout='...',
+    late.policy='drop|stream|apply', allowed.lateness='...')` — the
+    event-time robustness layer. One SA134 per malformed element, using
+    the SAME rule set the runtime resolver raises on (core/watermark.py
+    iter_watermark_annotation_problems), so the two can never drift."""
+    ann = find_annotation(app.annotations, "app:watermark")
+    if ann is None:
+        return
+    from siddhi_tpu.core.watermark import iter_watermark_annotation_problems
+
+    for problem in iter_watermark_annotation_problems(ann):
+        diags.append(Diagnostic("SA134", problem))
+
+
 def _check_supervision_annotations(
     app: SiddhiApp, diags: list[Diagnostic]
 ) -> None:
@@ -264,6 +279,29 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
             fault["_error"] = AttrType.STRING
             sym.streams["!" + sid] = fault
 
+    # @app:watermark(late.policy='stream'|'apply') auto-defines `!S` for
+    # EVERY stream (the late/expired side channel — app_runtime mirrors
+    # this), so `from !S` must resolve even without @OnError(STREAM)
+    wm = find_annotation(app.annotations, "app:watermark")
+    if wm is not None and (wm.element("late.policy") or "drop") in (
+        "stream", "apply"
+    ):
+        for sid in app.stream_definitions:
+            if "!" + sid in sym.streams:
+                continue
+            if "_error" in sym.streams[sid]:
+                diags.append(Diagnostic(
+                    "SA111",
+                    f"stream '{sid}': @app:watermark late.policy="
+                    f"'{wm.element('late.policy')}' reserves the attribute "
+                    "name '_error' on every stream",
+                ))
+                continue
+            sym.fault_parents.add(sid)
+            fault = dict(sym.streams[sid])
+            fault["_error"] = AttrType.STRING
+            sym.streams["!" + sid] = fault
+
     from siddhi_tpu.core.error_store import (
         iter_definition_onerror_problems,
         resolve_definition_onerror_action,
@@ -322,6 +360,7 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
     _check_shard_annotation(app, diags)
     _check_lineage_annotation(app, diags)
     _check_wire_annotation(app, sym, diags)
+    _check_watermark_annotation(app, diags)
     _check_supervision_annotations(app, diags)
 
     return sym

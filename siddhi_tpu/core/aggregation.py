@@ -722,6 +722,136 @@ class AggregationRuntime:
                 t.notify_change()  # spills write through to the record store
         return aux
 
+    def apply_late(self, ts_ms: int, row: dict) -> bool:
+        """Best-effort merge of ONE late event (late.policy='apply',
+        core/watermark.py). Each duration whose open bucket still covers the
+        event absorbs it through the same masked merge the device step uses;
+        an already-closed bucket is corrected IN PLACE in its duration table
+        (sum/count add, min/max fold; `last` keeps the newer value already
+        there), inserting a fresh row when the group never reached that
+        bucket. find() returns table rows verbatim, so in-place update is
+        the only shape that keeps store-query results correction-consistent.
+
+        Host-side and rare by construction (each call is one metered late
+        row); returns False when the event fails the aggregation's filters."""
+        from siddhi_tpu.ops.group import mix_keys
+
+        batch = self.in_schema.to_batch_cols(
+            np.asarray([ts_ms], np.int64),
+            {n: np.asarray([row[n]]) for n in self.in_schema.attr_names},
+            self.interner,
+        )
+        env_cols = {(self.ref, None, n): c for n, c in batch.cols.items()}
+        env_cols[(self.ref, None, TS_ATTR)] = batch.ts
+        env = Env(env_cols, now=jnp.asarray(ts_ms, jnp.int64))
+        for f in self.filters:
+            if not bool(np.asarray(f(env))[0]):
+                return False
+        ev_ts = (
+            int(np.asarray(self.ts_expr(env).astype(jnp.int64))[0])
+            if self.ts_expr is not None
+            else ts_ms
+        )
+        if self.group_keys:
+            kcols = []
+            for c in self.group_keys:
+                col = jnp.asarray(c(env))
+                if c.type in (AttrType.FLOAT, AttrType.DOUBLE):
+                    col = col.view(jnp.int32).astype(jnp.int64)
+                kcols.append(col.astype(jnp.int64))
+            key = int(np.asarray(mix_keys(kcols))[0])
+        else:
+            key = 0
+        contribs: dict = {}
+        for bname, (kind, arg, _t) in self.bases.items():
+            dt = self._store_dtypes[bname]
+            if kind == "count":
+                contribs[bname] = np.ones((), dt)[()]
+            else:
+                contribs[bname] = np.asarray(arg(env)).astype(dt).reshape(-1)[0]
+
+        g = self.g
+        for di, dur in enumerate(self.durations):
+            b = int(np.asarray(align_bucket(jnp.asarray(ev_ts, jnp.int64), dur)))
+            store = self.state["stores"][di]
+            open_bucket = int(np.asarray(store["bucket"]))
+            if open_bucket < 0 or b == open_bucket:
+                # still in flight here: a one-hot [G] source through the
+                # regular merge (opens the bucket at `b` when none is open)
+                src_keys = jnp.zeros((g,), jnp.int64).at[0].set(key)
+                src_used = jnp.zeros((g,), jnp.bool_).at[0].set(True)
+                src_vals = {
+                    bn: jnp.zeros((g,), self._store_dtypes[bn]).at[0].set(
+                        contribs[bn]
+                    )
+                    for bn in self.bases
+                }
+                merged, _ovf = self._merge_into(
+                    store, src_keys, src_used, src_vals,
+                    jnp.asarray(ev_ts, jnp.int64), jnp.asarray(b, jnp.int64),
+                )
+                self.state["stores"][di] = merged
+                continue
+            if b > open_bucket:
+                # not actually late for this duration; the live path owns
+                # the close/rollup sequencing — never fast-forward it here
+                continue
+            # closed bucket: correct the spilled row in the duration table
+            table = self.tables[dur]
+            tstate = table.state
+            valid = np.asarray(tstate["valid"])
+            tcols = {n: np.asarray(c) for n, c in tstate["cols"].items()}
+            match = valid & (tcols[AGG_TS] == b)
+            for gname in self.group_names:
+                gv = contribs[f"last__g_{gname}"]
+                match = match & (tcols[gname] == tcols[gname].dtype.type(gv))
+            idx = np.flatnonzero(match)
+            if idx.size:
+                ri = int(idx[0])
+                new_cols = dict(tstate["cols"])
+                for bname, (kind, _arg, _t) in self.bases.items():
+                    if bname.startswith("last__g_") or kind == "last":
+                        # group cols identify the row; a late event is never
+                        # the newest by event time, so `last` stays put
+                        continue
+                    cname = f"AGG_{bname}"
+                    col = tcols[cname].copy()
+                    if kind in ("sum", "count"):
+                        col[ri] += contribs[bname]
+                    elif kind == "min":
+                        col[ri] = min(col[ri], contribs[bname])
+                    else:  # max
+                        col[ri] = max(col[ri], contribs[bname])
+                    new_cols[cname] = jnp.asarray(col)
+                table.state = {**tstate, "cols": new_cols}
+            else:
+                # the group never reached this bucket: a fresh closed row
+                # through the table's own insert (seq/index bookkeeping)
+                dtypes = {
+                    n: a.dtype
+                    for n, a in table.schema.empty_batch(1).cols.items()
+                }
+                cols = {AGG_TS: np.asarray([b], np.int64)}
+                for gname in self.group_names:
+                    cols[gname] = np.asarray([contribs[f"last__g_{gname}"]])
+                for bname in self.bases:
+                    if bname.startswith("last__g_"):
+                        continue
+                    cols[f"AGG_{bname}"] = np.asarray([contribs[bname]])
+                ins = EventBatch(
+                    ts=jnp.asarray([b], jnp.int64),
+                    kind=jnp.zeros((1,), jnp.int8),
+                    valid=jnp.ones((1,), jnp.bool_),
+                    cols={
+                        n: jnp.asarray(cols[n].astype(dtypes[n]))
+                        for n in table.schema.attr_names
+                    },
+                )
+                table.state = table.insert(table.state, ins, {})
+            if table.record_store is not None:
+                table.notify_change()
+        return True
+
     def _step_full(self, batch, now, tstates):
         if not hasattr(self, "_jit_full"):
             def full(state, batch, now, tstates):

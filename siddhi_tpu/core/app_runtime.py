@@ -75,6 +75,34 @@ class SiddhiAppRuntime:
 
             self._scheduler = SystemTimeScheduler()
 
+        # @app:watermark(bound, idle.timeout, late.policy, allowed.lateness):
+        # event-time robustness — bounded-disorder reordering at every
+        # ingress, per-stream watermarks with min-propagation, and late-event
+        # policies (core/watermark.py; SIDDHI_TPU_WATERMARK overrides).
+        # Without playback, the watermark clock takes over timekeeping so
+        # window flushes / pattern deadlines / bucket closes fire on
+        # watermark ADVANCE, never on raw (possibly disordered) arrival.
+        self._watermark = None
+        from siddhi_tpu.core.watermark import resolve_watermark_annotation
+
+        wm_cfg = resolve_watermark_annotation(
+            find_annotation(app.annotations, "app:watermark")
+        )
+        if wm_cfg is not None:
+            from siddhi_tpu.core.timestamp import (
+                EventTimeClock,
+                EventTimeScheduler,
+            )
+            from siddhi_tpu.core.watermark import WatermarkRuntime
+
+            if self._playback_clock is not None:
+                wm_clock = self._playback_clock
+            else:
+                wm_clock = EventTimeClock()
+                self.clock = wm_clock.now
+                self._scheduler = EventTimeScheduler(wm_clock)
+            self._watermark = WatermarkRuntime(self, wm_cfg, wm_clock)
+
         # @app:statistics(reporter='console'|'log'|'jsonl'|'prometheus'|'none',
         #                 interval='N', trace.sample='P', trace.seed='S',
         #                 trace.capacity='K', file='...', port='...')
@@ -252,6 +280,29 @@ class SiddhiAppRuntime:
                         "the attribute name '_error'"
                     )
                 fid = "!" + sid
+                self.stream_schemas[fid] = StreamSchema(
+                    fid,
+                    [(a.name, a.type) for a in d.attributes]
+                    + [("_error", _AttrType.STRING)],
+                )
+
+        # @app:watermark late.policy='stream'|'apply' diverts late/correction
+        # rows onto each stream's `!S` side stream — auto-define the schemas
+        # through the same @OnError STREAM machinery (skipping streams that
+        # already carry one)
+        if self._watermark is not None and self._watermark.cfg.late_policy in (
+            "stream", "apply",
+        ):
+            for sid, d in app.stream_definitions.items():
+                fid = "!" + sid
+                if fid in self.stream_schemas:
+                    continue
+                if any(a.name == "_error" for a in d.attributes):
+                    raise SiddhiAppCreationError(
+                        f"stream '{sid}': @app:watermark late.policy="
+                        f"'{self._watermark.cfg.late_policy}' reserves the "
+                        "attribute name '_error'"
+                    )
                 self.stream_schemas[fid] = StreamSchema(
                     fid,
                     [(a.name, a.type) for a in d.attributes]
@@ -483,8 +534,10 @@ class SiddhiAppRuntime:
 
         agg_groups = self._capacity_annotation("app:aggGroupCapacity", 64)
         self.aggregations: dict[str, AggregationRuntime] = {}
+        self._agg_inputs: dict[str, str] = {}
         for aid, ad in app.aggregation_definitions.items():
             in_sid = ad.basic_single_input_stream.stream_id
+            self._agg_inputs[aid] = in_sid
             in_schema = self.stream_schemas.get(in_sid)
             if in_schema is None:
                 raise DefinitionNotExistError(
@@ -1264,6 +1317,21 @@ class SiddhiAppRuntime:
         h = InputHandler(j, lambda: self.clock())
         if self._playback_clock is not None:
             h = _PlaybackInputHandler(h, self._playback_clock)
+        if self._watermark is not None:
+            # @app:watermark bounded reorder stage, OUTSIDE the playback
+            # wrapper so the clock only advances when ordered rows are
+            # RELEASED (never on raw disordered arrival), but inside
+            # admission/disorder (core/watermark.py)
+            h = _WatermarkInputHandler(
+                self._watermark, stream_id, h,
+                self.stream_schemas[stream_id].attr_names,
+            )
+        from siddhi_tpu.testing import faults as _faults
+
+        if _faults.ACTIVE is not None:
+            # `ingest_disorder` transform site: only wrapped while a fault
+            # plan is live, so normal operation pays nothing
+            h = _DisorderInputHandler(h, f"{self.name}:{stream_id}")
         if self._admission is not None:
             # @app:admission gate, outermost: over-quota/over-bound sends
             # block/shed/error BEFORE any encode work (core/admission.py)
@@ -1273,6 +1341,29 @@ class SiddhiAppRuntime:
         return h
 
     input_handler = get_input_handler
+
+    def _fault_junction_for(self, stream_id: str):
+        """The `!S` side junction of a stream (late-event diversion target),
+        or None when no fault schema was defined for it."""
+        fid = "!" + stream_id
+        if fid not in self.stream_schemas:
+            return None
+        return self._junction(fid)
+
+    def _aggregations_for_stream(self, stream_id: str) -> list:
+        """Aggregation runtimes fed by `stream_id` (the late.policy='apply'
+        re-open targets)."""
+        return [
+            ar for aid, ar in self.aggregations.items()
+            if self._agg_inputs.get(aid) == stream_id
+        ]
+
+    def drain_watermarks(self) -> None:
+        """Flush every @app:watermark reorder buffer and catch the clock up
+        to the newest event seen — the explicit end-of-feed signal (also
+        run automatically at shutdown). No-op when watermarks are off."""
+        if self._watermark is not None:
+            self._watermark.drain()
 
     # ---- zero-downtime churn (core/churn.py) ------------------------------
 
@@ -1524,6 +1615,15 @@ class SiddhiAppRuntime:
                 for aid, ar in self.aggregations.items()
             },
         }
+        if self._watermark is not None:
+            status["watermark"] = self._watermark.describe_state()
+            # the stream-level watermark beside each aggregation's
+            # per-duration bucket watermarks (ISSUE 16 satellite: uniform
+            # watermark surfacing)
+            for aid, agg_status in status["aggregations"].items():
+                agg_status["stream_watermark_ms"] = self._watermark.watermark_of(
+                    self._agg_inputs.get(aid, "")
+                )
         if self._shard is not None:
             status["shard"] = self._shard.describe_state()
         if self._selfmon is not None:
@@ -1845,6 +1945,14 @@ class SiddhiAppRuntime:
                 self.manager.serve_metrics(port)
         if self._playback_clock is not None:
             self._playback_clock.start_heartbeat()
+        if self._watermark is not None:
+            # idle heartbeat: quiet sources flush + go idle after
+            # idle.timeout so they cannot stall the app watermark
+            self._watermark.start()
+            if self.statistics_manager is not None:
+                self.statistics_manager.register_watermark(
+                    self._watermark.describe_state
+                )
         # absent-at-start patterns must arm their timers before any event
         # (reference: SiddhiAppRuntime.start -> eternalReferencedHolders.start)
         for qr in self.queries.values():
@@ -1892,6 +2000,12 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         self._running = False
+        if self._watermark is not None:
+            # tail delivery FIRST: release every buffered row through the
+            # still-live junctions and fire the timers the final watermark
+            # unlocks, before any ingest machinery stops
+            self._watermark.stop()
+            self._watermark.drain()
         for src in self.sources:
             src.stop()  # cancels pending reconnect retries too
         for tr in self.triggers.values():
@@ -2058,6 +2172,87 @@ class _PlaybackInputHandler:
 
         if len(timestamps):
             self._pb.advance(int(np.max(timestamps)))
+        self._inner.send_columns(timestamps, cols, now)
+
+
+class _WatermarkInputHandler:
+    """The @app:watermark bounded reorder stage (core/watermark.py): every
+    send buffers into the stream's ReorderTracker, which re-emits rows at
+    or below the watermark as ONE stably-sorted columnar send through the
+    inner handler chain — so the fused/pipelined/sharded paths downstream
+    always see ordered input — then drives the app watermark clock."""
+
+    def __init__(self, wm, stream_id: str, inner, attr_names) -> None:
+        self._wm = wm
+        self._attrs = list(attr_names)
+        self._tracker = wm.tracker(
+            stream_id,
+            deliver=lambda ts, cols, _h=inner: _h.send_columns(ts, cols),
+        )
+
+    def send(self, data, timestamp=None):
+        import numpy as np
+
+        if timestamp is None:
+            timestamp = self._wm.runtime.clock()
+        cols = {k: np.asarray([v]) for k, v in zip(self._attrs, data)}
+        self._tracker.offer([int(timestamp)], cols)
+        self._wm.advance_clock()
+
+    def send_many(self, rows, timestamps=None):
+        import numpy as np
+
+        if not rows:
+            return
+        if timestamps is None:
+            timestamps = [self._wm.runtime.clock()] * len(rows)
+        cols = {
+            k: np.asarray([r[i] for r in rows])
+            for i, k in enumerate(self._attrs)
+        }
+        self._tracker.offer(timestamps, cols)
+        self._wm.advance_clock()
+
+    def send_columns(self, timestamps, cols, now=None):
+        self._tracker.offer(timestamps, cols)
+        self._wm.advance_clock()
+
+
+class _DisorderInputHandler:
+    """testing/faults `ingest_disorder` transform site: shuffles batch
+    timestamps within a seeded jitter budget BEFORE the watermark reorder
+    stage sees them (installed by get_input_handler only while a fault
+    plan is active)."""
+
+    def __init__(self, inner, key: str) -> None:
+        self._inner = inner
+        self._key = key
+
+    def send(self, data, timestamp=None):
+        self._inner.send(data, timestamp)
+
+    def send_many(self, rows, timestamps=None):
+        from siddhi_tpu.testing import faults
+
+        if timestamps:
+            perm = faults.permutation("ingest_disorder", self._key, timestamps)
+            if perm is not None:
+                rows = [rows[i] for i in perm]
+                timestamps = [timestamps[i] for i in perm]
+        self._inner.send_many(rows, timestamps)
+
+    def send_columns(self, timestamps, cols, now=None):
+        import numpy as np
+
+        from siddhi_tpu.testing import faults
+
+        perm = faults.permutation(
+            "ingest_disorder", self._key, [int(t) for t in timestamps]
+        )
+        if perm is not None:
+            idx = np.asarray(perm)
+            timestamps = np.asarray(timestamps)[idx]
+            cols = {k: np.asarray(v)[idx] for k, v in cols.items()}
         self._inner.send_columns(timestamps, cols, now)
 
 

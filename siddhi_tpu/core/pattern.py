@@ -774,8 +774,15 @@ class PatternProgram:
                     tok = self._consume(tok, fire, slot)
                     if slot.persistent:
                         # surviving every-generator re-arms fresh, window
-                        # restarting at the deadline
-                        tok = self._clear_slot_caps(tok, fire, slot, ts=ts)
+                        # restarting at the deadline — NOT the row's raw
+                        # timestamp: a late row firing through the eff_now
+                        # rescue (ts < deadline <= timer_seen) would re-arm
+                        # the generator in the past, leaving its next
+                        # deadline already expired so every subsequent row
+                        # re-fires it (the resurrected-deadline hazard)
+                        tok = self._clear_slot_caps(
+                            tok, fire, slot, ts=deadline
+                        )
                 elif slot.persistent:
                     # `every` generator: fork the completion downstream and
                     # keep the generator armed with a fresh window

@@ -248,6 +248,19 @@ def build_plan(app, runtime=None) -> dict:
                             )
                 except Exception:
                     pass
+            # event-time watermark (core/watermark.py): the reorder stage's
+            # frontier + buffer pressure + late-row tally for this source
+            wm = getattr(runtime, "_watermark", None)
+            if wm is not None:
+                tr = wm.trackers.get(sid)
+                if tr is not None:
+                    d = tr.describe()
+                    counters["watermark"] = {
+                        "wm_ms": d["watermark_ms"],
+                        "lag_ms": d["lag_ms"],
+                        "buffered": d["buffered"],
+                        "late": d["late_total"],
+                    }
         if ct is not None:
             comp = ct.component(fused_component)
             if comp is not None:
@@ -468,6 +481,12 @@ def _fmt_counters(c: Optional[dict]) -> str:
             f"wire[{w.get('source')}] {encs} "
             f"{w.get('encoded_B_per_ev')}B/ev (logical "
             f"{w.get('logical_B_per_ev')}B/ev)"
+        )
+    if "watermark" in c:
+        w = c["watermark"]
+        parts.append(
+            f"watermark[wm={w.get('wm_ms')} lag={w.get('lag_ms')}ms "
+            f"buffered={w.get('buffered')} late={w.get('late')}]"
         )
     if "lineage" in c:
         li = c["lineage"]

@@ -135,6 +135,11 @@ class StatisticsManager:
         # object with describe_state() -> per-device dispatch/event counts
         # + occupancy; rendered as the siddhi_shard_* Prometheus families
         self.shard: dict[str, object] = {}
+        # event-time robustness (core/watermark.py): () -> the watermark
+        # runtime's describe_state() — per-stream watermarks/lag, late-event
+        # meters, lateness histograms; rendered as the siddhi_watermark_* /
+        # siddhi_late_* / siddhi_lateness_ms Prometheus families
+        self.watermark_fn = None
         # continuous profiler: compile telemetry + per-chunk stage
         # waterfalls (observability/profiler.py), gated by this registry
         from siddhi_tpu.observability.profiler import (
@@ -214,6 +219,12 @@ class StatisticsManager:
         describe_state() feeds the report's `shard` section and the
         siddhi_shard_* Prometheus families."""
         self.shard[component] = router
+
+    def register_watermark(self, fn) -> None:
+        """Attach the @app:watermark runtime's describe_state supplier; it
+        feeds the report's `watermark` section and the watermark/lateness
+        Prometheus families."""
+        self.watermark_fn = fn
 
     def roofline(self) -> dict:
         """Live per-stream wire roofline: bytes/event over the fused h2d
@@ -315,6 +326,9 @@ class StatisticsManager:
             "shard": {
                 n: r.describe_state() for n, r in list(self.shard.items())
             },
+            "watermark": (
+                self.watermark_fn() if self.watermark_fn is not None else {}
+            ),
             "roofline": self.roofline(),
             "traces_sampled": (
                 self.tracer.sampled_count if self.tracer is not None else 0

@@ -167,6 +167,27 @@ _FAMILIES = {
         "gauge",
         "Per-device share of a batch-sharded junction's events, "
         "normalized so 1.0 = a perfectly even split across the mesh"),
+    "siddhi_watermark_ms": (
+        "gauge",
+        "Per-source-stream event-time watermark (max event time minus the "
+        "@app:watermark bound) in ms since epoch"),
+    "siddhi_watermark_lag_ms": (
+        "gauge",
+        "Watermark lag per source stream: newest event time seen minus the "
+        "watermark (the reorder stage's live slack)"),
+    "siddhi_reorder_buffered_events": (
+        "gauge",
+        "Rows held back by the @app:watermark bounded reorder stage, "
+        "awaiting watermark advance"),
+    "siddhi_late_events_total": (
+        "counter",
+        "Events behind the watermark at arrival, by outcome label: "
+        "dropped (metered drop), streamed (diverted to !S), applied "
+        "(aggregation bucket re-opened + correction row), expired "
+        "(beyond allowed.lateness)"),
+    "siddhi_lateness_ms": (
+        "summary",
+        "How far behind the watermark late events arrived, per stream (ms)"),
     "siddhi_traces_sampled_total": ("counter", "Traces sampled per app"),
 }
 
@@ -276,6 +297,33 @@ def render_prometheus(reports: list[dict]) -> str:
                 f"siddhi_pipeline_depth{_labels(app=app, component=n)}"
                 f" {ent['depth']}"
             )
+        for sid, ent in rep.get("watermark", {}).get("streams", {}).items():
+            if ent.get("watermark_ms") is not None:
+                body["siddhi_watermark_ms"].append(
+                    f"siddhi_watermark_ms{_labels(app=app, stream=sid)}"
+                    f" {ent['watermark_ms']}"
+                )
+            if ent.get("lag_ms") is not None:
+                body["siddhi_watermark_lag_ms"].append(
+                    f"siddhi_watermark_lag_ms{_labels(app=app, stream=sid)}"
+                    f" {ent['lag_ms']}"
+                )
+            body["siddhi_reorder_buffered_events"].append(
+                "siddhi_reorder_buffered_events"
+                f"{_labels(app=app, stream=sid)} {ent.get('buffered', 0)}"
+            )
+            for outcome in ("dropped", "streamed", "applied", "expired"):
+                body["siddhi_late_events_total"].append(
+                    "siddhi_late_events_total"
+                    f"{_labels(app=app, stream=sid, outcome=outcome)}"
+                    f" {ent.get(outcome, 0)}"
+                )
+            summ = ent.get("lateness_ms")
+            if summ and summ.get("count"):
+                _summary_lines(
+                    body["siddhi_lateness_ms"], "siddhi_lateness_ms",
+                    app, None, summ, stream=sid,
+                )
         body["siddhi_traces_sampled_total"].append(
             "siddhi_traces_sampled_total"
             f"{_labels(app=app)} {rep.get('traces_sampled', 0)}"

@@ -27,6 +27,14 @@ Sites (the key passed at each):
                         contract
     churn_restore       "<app>"(redeploy) / "<app>:<qid>"(add_query seed)
                         state restore through the snapshot SPI during churn
+    ingest_disorder     "<app>:<stream>"  the input-handler feed
+                        (app_runtime.get_input_handler); rules carrying a
+                        `jitter=<ms>` budget TRANSFORM instead of raise:
+                        each row's timestamp is perturbed by uniform(0,
+                        jitter) and the batch re-sorted by the perturbed
+                        keys — a seeded within-bound shuffle, the
+                        adversary the @app:watermark reorder stage must
+                        exactly undo (core/watermark.py parity gate)
 
 Determinism: rules fire by hit count (`after` skips the first N matching
 hits, `times` bounds how often the rule fires), optionally thinned by a
@@ -43,8 +51,9 @@ subprocess chaos runs need no API access):
 
 Rule grammar: `site[@key_substring]:opt=val[,opt=val...]` joined by `;`,
 with opts `after`, `times` (-1 = forever), `p`, `error` (`fault` raises
-InjectedFault, `conn` raises ConnectionUnavailableError). A bare
-`seed=N` entry seeds the plan.
+InjectedFault, `conn` raises ConnectionUnavailableError), `jitter` (ms;
+makes the rule a timestamp-shuffle transform for the `ingest_disorder`
+site instead of an error). A bare `seed=N` entry seeds the plan.
 """
 
 from __future__ import annotations
@@ -68,6 +77,7 @@ class FaultRule:
     times: Optional[int] = 1  # fire at most this many times (None = forever)
     p: float = 1.0           # thinning probability once past `after`
     error: Optional[str] = None  # 'fault' | 'conn' (None = site default)
+    jitter: Optional[int] = None  # ms; transform rule (shuffle), not a raise
     hits: int = 0
     fired: int = 0
 
@@ -93,7 +103,9 @@ class FaultPlan:
     def check(self, site: str, key: str = "") -> None:
         """Count one hit at `site`; raise when a matching rule fires."""
         for i, r in enumerate(self.rules):
-            if r.site != site or (r.match and r.match not in key):
+            if r.site != site or r.jitter is not None or (
+                r.match and r.match not in key
+            ):
                 continue
             with self._lock:
                 r.hits += 1
@@ -113,6 +125,42 @@ class FaultPlan:
                     f"injected fault at {site} ({key})"
                 )
             raise InjectedFault(f"injected fault at {site} ({key})")
+
+    def permute(self, site: str, key: str, timestamps) -> Optional[list]:
+        """Count one hit at `site` against the TRANSFORM rules (those with a
+        `jitter` budget); return a permutation of range(len(timestamps))
+        that re-sorts the batch by jitter-perturbed timestamps, or None
+        when no rule fires. Each row's sort key is its timestamp plus
+        uniform(0, jitter) from the rule's seeded RNG, so a row is never
+        displaced behind rows more than `jitter` ms newer — the shuffle
+        stays within the disorder bound a watermark of `bound >= jitter`
+        must fully absorb. Stacked rules compose left to right."""
+        perm: Optional[list] = None
+        for i, r in enumerate(self.rules):
+            if r.site != site or r.jitter is None or (
+                r.match and r.match not in key
+            ):
+                continue
+            with self._lock:
+                r.hits += 1
+                if r.hits <= r.after:
+                    continue
+                if r.times is not None and r.fired >= r.times:
+                    continue
+                if r.p < 1.0 and self._rngs[i].random() >= r.p:
+                    continue
+                r.fired += 1
+                self.log.append((site, key))
+                ts = (
+                    timestamps if perm is None
+                    else [timestamps[j] for j in perm]
+                )
+                keys = [
+                    int(t) + self._rngs[i].random() * r.jitter for t in ts
+                ]
+            step = sorted(range(len(keys)), key=keys.__getitem__)
+            perm = step if perm is None else [perm[j] for j in step]
+        return perm
 
     def report(self) -> dict:
         """Fired/hit counts per rule (test assertions + chaos-run logs)."""
@@ -166,6 +214,10 @@ def parse_plan(spec: str) -> FaultPlan:
                 if v not in ("fault", "conn"):
                     raise ValueError(f"fault error='{v}' (fault|conn)")
                 kw["error"] = v
+            elif k == "jitter":
+                kw["jitter"] = int(v)
+                if kw["jitter"] <= 0:
+                    raise ValueError(f"fault jitter={v} must be a positive ms")
             else:
                 raise ValueError(f"unknown fault option '{k}'")
         rules.append(FaultRule(**kw))
@@ -193,6 +245,15 @@ def hit(site: str, key: str = "") -> None:
     plan = ACTIVE
     if plan is not None:
         plan.check(site, key)
+
+
+def permutation(site: str, key: str, timestamps) -> Optional[list]:
+    """Transform-site hook: a shuffle permutation over the batch, or None
+    (no plan / no matching jitter rule / nothing to shuffle)."""
+    plan = ACTIVE
+    if plan is None or len(timestamps) < 2:
+        return None
+    return plan.permute(site, key, timestamps)
 
 
 # env activation: parsed once at import so subprocess chaos legs need no API

@@ -1,0 +1,151 @@
+"""Golden disorder parity — the @app:watermark headline proof.
+
+A feed shuffled WITHIN the watermark bound by the seeded `ingest_disorder`
+fault site, pushed through the bounded reorder stage, must produce emissions
+EXACTLY equal to the ordered control run — same rows, same order, same
+timestamps — for every stateful operator class, under the fused and sharded
+execution paths both on and off.
+
+Mechanics that make the equality exact (not just set-equal):
+* each case feeds ONE columnar send with unique strictly-increasing
+  timestamps, so the ordered and shuffled runs share one watermark
+  trajectory and identical release boundaries;
+* jitter <= bound, so the shuffle never creates a late event — every row
+  re-sorts back to its original position before dispatch.
+
+FUSE/SHARD toggles are read from the environment per app start (conftest
+boots 8 host devices), so the matrix runs in-process.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.testing import faults
+
+BASE = 1_700_000_000_000
+N = 96
+JITTER_MS = 1500  # < the 2 sec bound in every app below
+
+WM = "@app:watermark(bound='2 sec')\n"
+
+CASES = {
+    "sliding_window": (
+        WM + """
+        define stream S (sym string, price double, vol long);
+        @info(name='q')
+        from S#window.length(5)
+        select sym, sum(price) as total, count() as n
+        insert into Out;
+        """,
+    ),
+    "length_batch_group_by": (
+        WM + """
+        define stream S (sym string, price double, vol long);
+        @info(name='q')
+        from S#window.lengthBatch(8)
+        select sym, sum(vol) as v, max(price) as hi
+        group by sym
+        insert into Out;
+        """,
+    ),
+    "pattern_within": (
+        WM + """
+        define stream S (sym string, price double, vol long);
+        @info(name='q')
+        from every a=S[price > 60] -> b=S[price < 40] within 3 sec
+        select a.sym as asym, b.sym as bsym, a.price as ap, b.price as bp
+        insert into Out;
+        """,
+    ),
+    "join": (
+        WM + """
+        define stream S (sym string, price double, vol long);
+        define stream R (sym string, lo double);
+        @info(name='q')
+        from S#window.length(6) join R#window.length(4)
+            on S.sym == R.sym
+        select S.sym as sym, S.price as price, R.lo as lo
+        insert into Out;
+        """,
+    ),
+}
+
+
+def _feed(seed=11):
+    rng = np.random.default_rng(seed)
+    ts = BASE + np.arange(N, dtype=np.int64) * 97  # unique, increasing
+    syms = np.asarray([f"S{i % 5}" for i in range(N)])
+    price = np.round(rng.uniform(10.0, 100.0, N), 2)
+    vol = rng.integers(1, 500, N).astype(np.int64)
+    return ts, {"sym": syms, "price": price, "vol": vol}
+
+
+def _run_case(ql, disorder: bool):
+    if disorder:
+        faults.install(faults.parse_plan(
+            f"seed=23;ingest_disorder:jitter={JITTER_MS},times=-1"
+        ))
+    try:
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback(
+            "Out",
+            lambda evs: got.extend((e.timestamp, tuple(e.data)) for e in evs),
+        )
+        rt.start()
+        ts, cols = _feed()
+        if "define stream R" in ql:
+            # join partner: ordered side-feed primed first so both runs see
+            # identical R state before S flows
+            rt.get_input_handler("R").send_columns(
+                np.asarray([BASE - 10, BASE - 9, BASE - 8], np.int64),
+                {
+                    "sym": np.asarray(["S0", "S1", "S2"]),
+                    "lo": np.asarray([20.0, 30.0, 40.0]),
+                },
+            )
+        rt.get_input_handler("S").send_columns(ts, cols)
+        rt.drain_watermarks()
+        status = rt.snapshot_status()
+        rt.shutdown()
+        mgr.shutdown()
+        return got, status
+    finally:
+        if disorder:
+            faults.uninstall()
+
+
+@pytest.mark.parametrize("fuse", ["1", "0"])
+@pytest.mark.parametrize("shard", ["8", "0"])
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_disorder_parity(case, fuse, shard, monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_FUSE", fuse)
+    monkeypatch.setenv("SIDDHI_TPU_SHARD", shard)
+    (ql,) = CASES[case]
+    ordered, _ = _run_case(ql, disorder=False)
+    shuffled, status = _run_case(ql, disorder=True)
+    assert ordered, f"{case}: control run produced no emissions"
+    assert shuffled == ordered, (
+        f"{case} fuse={fuse} shard={shard}: disorder parity broken\n"
+        f"ordered ({len(ordered)}): {ordered[:5]}...\n"
+        f"shuffled ({len(shuffled)}): {shuffled[:5]}..."
+    )
+    # the shuffle really happened and the reorder stage really undid it:
+    # rows buffered, none late
+    ws = status["watermark"]["streams"]["S"]
+    assert ws["released"] == N and ws["late_total"] == 0
+    assert ws["peak_buffered"] > 1
+
+
+def test_shuffle_is_genuinely_disordered(monkeypatch):
+    # guard against the parity matrix silently testing ordered-vs-ordered
+    ts, _ = _feed()
+    plan = faults.parse_plan(
+        f"seed=23;ingest_disorder:jitter={JITTER_MS},times=-1"
+    )
+    perm = plan.permute("ingest_disorder", "x:S", [int(t) for t in ts])
+    assert perm is not None and perm != list(range(N))

@@ -1,0 +1,98 @@
+"""Regression: the resurrected-deadline hazard in `every (A and not B for t)`
+(core/pattern.py absent-deadline timer branch).
+
+A persistent (`every`) and-not-for generator that fires at its deadline must
+re-arm with its window restarting AT THE DEADLINE. Before the fix it re-armed
+at the firing row's raw timestamp; a LATE row (event time below the already
+fired deadline, firing through the eff_now rescue) re-armed the generator in
+the past, so its next deadline was already expired and every subsequent row
+re-fired it — duplicate absent emissions from one logical window.
+
+Playback clock throughout: event time is the only clock, no wall races.
+"""
+
+from __future__ import annotations
+
+from siddhi_tpu import SiddhiManager
+
+QL = """
+define stream StockStream (symbol string, price float);
+define stream TickStream (symbol string, price float);
+
+@info(name='q')
+from every e1=StockStream[price > 10] and not TickStream[price > 20]
+     for 150 millisec
+select e1.symbol as sym, e1.price as price
+insert into Out;
+"""
+
+
+def _run(feeds):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("@app:playback\n" + QL)
+    got = []
+    rt.add_callback(
+        "Out", lambda evs: got.extend((e.timestamp, tuple(e.data)) for e in evs)
+    )
+    rt.start()
+    for sid, row, ts in feeds:
+        rt.get_input_handler(sid).send(row, timestamp=ts)
+    rt.shutdown()
+    mgr.shutdown()
+    return got
+
+
+def test_deadline_fires_once_on_time():
+    got = _run([
+        ("StockStream", ("A", 15.0), 0),
+        # inert clock advance past the 150 ms deadline (matches nothing)
+        ("StockStream", ("Z", 1.0), 200),
+    ])
+    assert [r for _, r in got] == [("A", 15.0)]
+
+
+def test_late_row_does_not_resurrect_fired_deadline():
+    got = _run([
+        ("StockStream", ("A", 15.0), 0),
+        ("StockStream", ("Z", 1.0), 200),   # deadline 150 fired -> 1 emission
+        # LATE row: event time 50 < the fired deadline. It matches the
+        # present side, entering the re-armed generator's NEXT window —
+        # which restarts at the deadline (150), so its own deadline is 300.
+        ("StockStream", ("B", 30.0), 50),
+        # rows at 210/250: before 300, nothing may fire (the buggy re-arm
+        # at ts=50 put the next deadline at 200, already expired, so each
+        # of these rows re-fired the generator)
+        ("StockStream", ("Z", 1.0), 210),
+        ("StockStream", ("Z", 1.0), 250),
+    ])
+    fired = [r for _, r in got]
+    assert fired == [("A", 15.0)], f"resurrected deadline refired: {fired}"
+
+
+def test_late_present_arrival_completes_exactly_once():
+    # timer passed the deadline with the present side absent (no fire);
+    # each LATE present-side arrival then completes its window instantly
+    # through the eff_now rescue — exactly once per arrival, and the
+    # trailing rows must not re-fire any resurrected deadline
+    got = _run([
+        ("StockStream", ("Z", 1.0), 400),   # deadline 150 passes, A absent
+        ("StockStream", ("A", 15.0), 40),   # late arrival -> rescue fire
+        ("StockStream", ("Z", 1.0), 45),
+        ("StockStream", ("A2", 15.0), 48),  # next window, same rescue
+        ("StockStream", ("Z", 1.0), 200),
+        ("StockStream", ("Z", 1.0), 320),
+    ])
+    assert [r for _, r in got] == [("A", 15.0), ("A2", 15.0)]
+
+
+def test_rearmed_window_still_completes_later():
+    got = _run([
+        ("StockStream", ("A", 15.0), 0),
+        ("StockStream", ("Z", 1.0), 200),   # fire #1 at deadline 150
+        ("StockStream", ("B", 30.0), 50),   # late capture into window @150
+        ("StockStream", ("Z", 1.0), 400),   # past deadline 300: fire #2
+    ])
+    fired = [r for _, r in got]
+    assert fired[0] == ("A", 15.0)
+    # exactly one more completion for the re-armed window — not one per row
+    assert len(fired) == 2, f"expected 2 firings, got {fired}"

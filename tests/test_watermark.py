@@ -1,0 +1,584 @@
+"""Event-time robustness (core/watermark.py): `@app:watermark` bounded
+reorder, watermark tracking/propagation, late-event policies, observability.
+
+Layers:
+* annotation/env config — shared rule set (SA134 + runtime resolver);
+* ReorderTracker unit behavior (ordering, lateness split, flush);
+* end-to-end policies — drop (metered), stream (`!S` divert), apply
+  (closed-bucket correction in aggregation duration tables);
+* zero-cost contract — no annotation means no wrapper on the send path;
+* observability — snapshot_status section, Prometheus families, explain();
+* fault-injection shuffle (`ingest_disorder` jitter rules) determinism.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.core.watermark import (
+    LatenessHistogram,
+    ReorderTracker,
+    WatermarkConfig,
+    iter_watermark_annotation_problems,
+    parse_watermark_spec,
+    resolve_watermark_annotation,
+)
+from siddhi_tpu.query_api.annotation import Annotation
+from siddhi_tpu.testing import faults
+
+BASE = 1_700_000_000_000
+
+
+def _ann(*pairs):
+    return Annotation("app:watermark", list(pairs))
+
+
+# ---------------------------------------------------------------------------
+# configuration: annotation + env, one rule set for analyzer and runtime
+# ---------------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_valid_annotation_resolves(self):
+        cfg = resolve_watermark_annotation(_ann(
+            ("bound", "5 sec"), ("idle.timeout", "30 sec"),
+            ("late.policy", "apply"), ("allowed.lateness", "1 min"),
+        ), env="")
+        assert cfg == WatermarkConfig(5000, 30000, "apply", 60000)
+
+    def test_bare_element_is_bound(self):
+        cfg = resolve_watermark_annotation(_ann((None, "2 sec")), env="")
+        assert cfg.bound_ms == 2000
+
+    def test_apply_defaults_allowed_lateness(self):
+        cfg = resolve_watermark_annotation(
+            _ann(("bound", "1 sec"), ("late.policy", "apply")), env="",
+        )
+        assert cfg.allowed_lateness_ms == 60_000
+
+    def test_problems_enumerated(self):
+        bad = _ann(
+            ("bound", "0 sec"), ("idle.timeout", "soon"),
+            ("late.policy", "retry"), ("allowed.lateness", "1 min"),
+            ("jitter", "5 sec"),
+        )
+        msgs = list(iter_watermark_annotation_problems(bad))
+        assert len(msgs) == 5
+        assert any("bound" in m for m in msgs)
+        assert any("late.policy" in m for m in msgs)
+        assert any("unknown" in m for m in msgs)
+
+    def test_missing_bound_is_a_problem(self):
+        msgs = list(iter_watermark_annotation_problems(
+            _ann(("late.policy", "drop"))
+        ))
+        assert any("bound" in m for m in msgs)
+
+    def test_runtime_resolver_raises_on_first_problem(self):
+        with pytest.raises(SiddhiAppCreationError):
+            resolve_watermark_annotation(_ann(("bound", "-3 sec")), env="")
+
+    def test_env_spec_parsing(self):
+        assert parse_watermark_spec("off") == "off"
+        spec = parse_watermark_spec("bound=2 sec;late.policy=stream")
+        assert spec == {"bound": "2 sec", "late.policy": "stream"}
+        with pytest.raises(ValueError):
+            parse_watermark_spec("bound")
+
+    def test_env_overrides_annotation(self):
+        cfg = resolve_watermark_annotation(
+            _ann(("bound", "5 sec")), env="bound=9 sec;late.policy=stream",
+        )
+        assert cfg.bound_ms == 9000 and cfg.late_policy == "stream"
+
+    def test_env_off_disables(self):
+        assert resolve_watermark_annotation(
+            _ann(("bound", "5 sec")), env="off"
+        ) is None
+
+    def test_env_arms_unannotated_app(self):
+        cfg = resolve_watermark_annotation(None, env="bound=4 sec")
+        assert cfg is not None and cfg.bound_ms == 4000
+
+    def test_sa134_shares_the_rule_set(self):
+        from siddhi_tpu.analysis import analyze
+
+        res = analyze("""
+        @app:watermark(bound='nope', late.policy='retry')
+        define stream S (a string);
+        from S select a insert into Out;
+        """)
+        codes = [d.code for d in res.diagnostics]
+        assert codes.count("SA134") == 2
+
+    def test_sa134_clean_on_valid(self):
+        from siddhi_tpu.analysis import analyze
+
+        res = analyze("""
+        @app:watermark(bound='5 sec', late.policy='stream')
+        define stream S (a string);
+        from S select a insert into Out;
+        """)
+        assert not [d for d in res.diagnostics if d.code == "SA134"]
+
+
+# ---------------------------------------------------------------------------
+# ReorderTracker unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestReorderTracker:
+    def _mk(self, bound=1000):
+        released, late = [], []
+        tr = ReorderTracker(
+            "S", bound,
+            deliver=lambda ts, cols: released.extend(int(t) for t in ts),
+            on_late=lambda ts, cols, lat: late.extend(int(t) for t in ts),
+        )
+        return tr, released, late
+
+    def test_releases_sorted_below_watermark(self):
+        tr, released, late = self._mk(bound=1000)
+        tr.offer([BASE + 500], {"v": np.asarray([1])})
+        tr.offer([BASE + 200], {"v": np.asarray([2])})
+        tr.offer([BASE + 1500], {"v": np.asarray([3])})  # wm -> BASE+500
+        assert released == [BASE + 200, BASE + 500]
+        assert late == []
+        tr.flush()
+        assert released == [BASE + 200, BASE + 500, BASE + 1500]
+
+    def test_strictly_late_rows_split_out(self):
+        tr, released, late = self._mk(bound=100)
+        tr.offer([BASE + 1000], {"v": np.asarray([1])})  # wm -> BASE+900
+        tr.offer([BASE + 100], {"v": np.asarray([2])})   # < wm: late
+        assert late == [BASE + 100]
+        assert tr.late_total == 1
+
+    def test_row_at_watermark_is_on_time(self):
+        tr, released, late = self._mk(bound=100)
+        tr.offer([BASE + 1000], {"v": np.asarray([1])})  # wm -> BASE+900
+        tr.offer([BASE + 900], {"v": np.asarray([2])})   # == wm: on time
+        assert late == []
+
+    def test_columnar_batch_sorted_within(self):
+        tr, released, late = self._mk(bound=10)
+        ts = [BASE + d for d in (5, 1, 3, 2, 4)]
+        tr.offer(ts, {"v": np.asarray([0, 1, 2, 3, 4])})
+        tr.flush()
+        assert released == sorted(ts)
+
+    def test_describe_counters(self):
+        tr, released, _ = self._mk(bound=1000)
+        tr.offer([BASE, BASE + 100], {"v": np.asarray([0, 1])})
+        d = tr.describe()
+        assert d["buffered"] == 2 and d["max_event_ms"] == BASE + 100
+        tr.flush()
+        d = tr.describe()
+        assert d["buffered"] == 0 and d["released"] == 2 and d["idle"]
+
+
+class TestLatenessHistogram:
+    def test_quantile_shape(self):
+        h = LatenessHistogram()
+        for v in (1, 10, 100, 1000):
+            h.record(v)
+        s = h.snapshot()
+        assert s["count"] == 4 and s["sum"] == 1111 and s["max"] == 1000
+        assert s["p50"] <= s["p99"] <= s["p9999"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: reorder + policies + status
+# ---------------------------------------------------------------------------
+
+
+def _run_app(ql, feeds, callbacks=("Out",), drain=True):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = {name: [] for name in callbacks}
+    for name in callbacks:
+        rt.add_callback(
+            name,
+            lambda evs, _n=name: got[_n].extend(
+                (e.timestamp, tuple(e.data)) for e in evs
+            ),
+        )
+    rt.start()
+    try:
+        for sid, row, ts in feeds:
+            rt.get_input_handler(sid).send(row, timestamp=ts)
+        if drain:
+            rt.drain_watermarks()
+        status = rt.snapshot_status()
+    finally:
+        rt.shutdown()
+        mgr.shutdown()
+    return got, status
+
+
+class TestEndToEnd:
+    QL = """
+    @app:watermark(bound='2 sec')
+    define stream S (sym string, v long);
+    from S select sym, v insert into Out;
+    """
+
+    def test_disordered_feed_released_in_order(self):
+        feeds = [
+            ("S", ("a", d), BASE + d)
+            for d in (0, 1500, 500, 3000, 2500, 4000, 9000)
+        ]
+        got, status = _run_app(self.QL, feeds)
+        assert [t - BASE for t, _ in got["Out"]] == [
+            0, 500, 1500, 2500, 3000, 4000, 9000
+        ]
+        ws = status["watermark"]["streams"]["S"]
+        assert ws["released"] == 7 and ws["late_total"] == 0
+        assert status["watermark"]["derived"]["Out"]["watermark_ms"] == \
+            ws["watermark_ms"]
+
+    def test_drop_policy_meters(self):
+        feeds = [
+            ("S", ("a", 1), BASE),
+            ("S", ("a", 2), BASE + 5000),   # wm -> BASE+3000
+            ("S", ("late", 3), BASE + 100),
+        ]
+        got, status = _run_app(self.QL, feeds)
+        assert all(r[0] != "late" for _, r in got["Out"])
+        ws = status["watermark"]["streams"]["S"]
+        assert ws["dropped"] == 1 and ws["late_total"] == 1
+        assert ws["lateness_ms"]["count"] == 1
+        assert ws["lateness_ms"]["max"] == 2900
+
+    def test_stream_policy_diverts_to_fault_stream(self):
+        ql = """
+        @app:watermark(bound='1 sec', late.policy='stream')
+        define stream S (sym string, v long);
+        from S select sym, v insert into Out;
+        from !S select sym, v, _error insert into LateOut;
+        """
+        feeds = [
+            ("S", ("a", 1), BASE),
+            ("S", ("b", 2), BASE + 5000),
+            ("S", ("z", 99), BASE + 100),
+        ]
+        got, status = _run_app(ql, feeds, callbacks=("Out", "LateOut"))
+        assert [r for _, r in got["LateOut"]] == [("z", 99, "late[3900 ms]")]
+        assert status["watermark"]["streams"]["S"]["streamed"] == 1
+
+    def test_apply_policy_corrects_closed_bucket(self):
+        ql = """
+        @app:watermark(bound='1 sec', late.policy='apply',
+                       allowed.lateness='1 min')
+        define stream T (sym string, v long, ts long);
+        define aggregation AggT from T select sym, sum(v) as total,
+            count() as n group by sym aggregate by ts
+            every seconds...minutes;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        rt.start()
+        try:
+            h = rt.get_input_handler("T")
+            h.send(("x", 10, BASE), timestamp=BASE)
+            h.send(("x", 20, BASE + 200), timestamp=BASE + 200)
+            # closes the first seconds bucket (wm -> BASE+6000)
+            h.send(("x", 5, BASE + 7000), timestamp=BASE + 7000)
+            # late into the CLOSED bucket: existing group corrected in place
+            h.send(("x", 100, BASE + 500), timestamp=BASE + 500)
+            # late new group: fresh closed row inserted
+            h.send(("y", 7, BASE + 300), timestamp=BASE + 300)
+            rt.drain_watermarks()
+            rows = sorted(
+                tuple(e.data) for e in rt.query(
+                    f"from AggT within {BASE - 1000}L, {BASE + 60_000}L "
+                    "per 'sec' select AGG_TIMESTAMP, sym, total, n"
+                )
+            )
+            assert rows == [
+                (BASE, "x", 130, 3),
+                (BASE, "y", 7, 1),
+                (BASE + 7000, "x", 5, 1),
+            ]
+            ws = rt.snapshot_status()["watermark"]["streams"]["T"]
+            assert ws["applied"] == 2 and ws["expired"] == 0
+            # drain flushed the tracker: the stream watermark caught up to
+            # the max event time
+            aggs = rt.snapshot_status()["aggregations"]["AggT"]
+            assert aggs["stream_watermark_ms"] == BASE + 7000
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_apply_policy_expires_past_allowed_lateness(self):
+        ql = """
+        @app:watermark(bound='1 sec', late.policy='apply',
+                       allowed.lateness='2 sec')
+        define stream T (sym string, v long, ts long);
+        define aggregation AggT from T select sym, sum(v) as total
+            group by sym aggregate by ts every seconds;
+        from !T select sym, _error insert into Exp;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Exp", lambda evs: got.extend(tuple(e.data) for e in evs))
+        rt.start()
+        try:
+            h = rt.get_input_handler("T")
+            h.send(("x", 1, BASE), timestamp=BASE)
+            h.send(("x", 1, BASE + 60_000), timestamp=BASE + 60_000)
+            h.send(("old", 9, BASE + 100), timestamp=BASE + 100)  # 58.9s late
+            rt.drain_watermarks()
+            ws = rt.snapshot_status()["watermark"]["streams"]["T"]
+            assert ws["expired"] == 1 and ws["applied"] == 0
+            assert got and got[0][0] == "old" and "expired" in got[0][1]
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_late_rows_are_never_silently_lost(self):
+        # drop policy still METERS every late row; totals must reconcile
+        feeds = [("S", ("a", 1), BASE), ("S", ("b", 2), BASE + 9000)]
+        feeds += [("S", ("l", i), BASE + 100 + i) for i in range(5)]
+        got, status = _run_app(self.QL, feeds)
+        ws = status["watermark"]["streams"]["S"]
+        assert ws["late_total"] == 5 == ws["dropped"]
+        assert ws["released"] + ws["late_total"] == len(feeds)
+
+    def test_reserved_error_attr_rejected_with_late_stream(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime("""
+            @app:watermark(bound='1 sec', late.policy='stream')
+            define stream S (sym string, _error string);
+            from S select sym insert into Out;
+            """)
+        mgr.shutdown()
+
+    def test_idle_timeout_flushes_quiet_stream(self):
+        ql = """
+        @app:watermark(bound='10 sec', idle.timeout='200 millisec')
+        define stream S (sym string, v long);
+        from S select sym, v insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", lambda evs: got.extend(tuple(e.data) for e in evs))
+        rt.start()
+        try:
+            rt.get_input_handler("S").send(("a", 1), timestamp=BASE)
+            # bound is 10s and nothing else arrives: only the idle timeout
+            # can release the buffered row
+            deadline = time.monotonic() + 5.0
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert got == [("a", 1)]
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_watermark_drives_window_timers(self):
+        # time-window expiry fires on WATERMARK advance, not wall clock:
+        # 1 sec of event time passes in microseconds of wall time
+        ql = """
+        @app:watermark(bound='100 millisec')
+        define stream S (sym string, v long);
+        from S#window.time(1 sec) select sym, count() as n insert all events into Out;
+        """
+        feeds = [
+            ("S", ("a", 1), BASE),
+            ("S", ("a", 2), BASE + 5000),
+            ("S", ("a", 3), BASE + 5100),
+        ]
+        got, _ = _run_app(ql, feeds)
+        # the first row expired from the window when event time crossed
+        # BASE+1000 — visible as an expired/current emission beyond it
+        assert len(got["Out"]) >= 3
+
+
+# ---------------------------------------------------------------------------
+# zero-cost contract
+# ---------------------------------------------------------------------------
+
+
+class TestZeroCost:
+    def test_no_annotation_no_wrapper(self):
+        from siddhi_tpu.core.app_runtime import _WatermarkInputHandler
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream S (sym string);
+        from S select sym insert into Out;
+        """)
+        try:
+            assert rt._watermark is None
+            h = rt.get_input_handler("S")
+            probe = h
+            while probe is not None:
+                assert not isinstance(probe, _WatermarkInputHandler)
+                probe = getattr(probe, "_inner", None)
+            assert "watermark" not in rt.snapshot_status()
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_annotation_installs_wrapper(self):
+        from siddhi_tpu.core.app_runtime import _WatermarkInputHandler
+
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        @app:watermark(bound='1 sec')
+        define stream S (sym string);
+        from S select sym insert into Out;
+        """)
+        try:
+            h = rt.get_input_handler("S")
+            found, probe = False, h
+            while probe is not None and not found:
+                found = isinstance(probe, _WatermarkInputHandler)
+                probe = getattr(probe, "_inner", None)
+            assert found
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# observability: Prometheus + explain
+# ---------------------------------------------------------------------------
+
+
+class TestObservability:
+    def test_prometheus_families(self):
+        from siddhi_tpu.observability.reporters import render_prometheus
+
+        ql = """
+        @app:statistics(reporter='none')
+        @app:watermark(bound='1 sec')
+        define stream S (sym string, v long);
+        from S select sym, v insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        rt.start()
+        try:
+            h = rt.get_input_handler("S")
+            h.send(("a", 1), timestamp=BASE)
+            h.send(("a", 2), timestamp=BASE + 5000)
+            h.send(("z", 3), timestamp=BASE + 100)  # late -> dropped
+            rt.drain_watermarks()
+            text = render_prometheus([rt.statistics_manager.report()])
+            wm_lines = [
+                ln for ln in text.splitlines()
+                if ln.startswith("siddhi_watermark_ms{") and 'stream="S"' in ln
+            ]
+            assert wm_lines, text
+            assert any(
+                ln.startswith("siddhi_watermark_lag_ms{")
+                for ln in text.splitlines()
+            )
+            dropped = [
+                ln for ln in text.splitlines()
+                if ln.startswith("siddhi_late_events_total{")
+                and 'outcome="dropped"' in ln and 'stream="S"' in ln
+            ]
+            assert dropped and dropped[0].endswith(" 1")
+            assert "siddhi_lateness_ms" in text
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+    def test_explain_includes_watermark(self):
+        from siddhi_tpu.observability.explain import explain
+
+        ql = """
+        @app:watermark(bound='1 sec')
+        define stream S (sym string, v long);
+        from S select sym, v insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        rt.start()
+        try:
+            rt.get_input_handler("S").send(("a", 1), timestamp=BASE)
+            rt.drain_watermarks()
+            text = explain(rt, fmt="text")
+            assert "watermark[" in text
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection disorder site
+# ---------------------------------------------------------------------------
+
+
+class TestDisorderFaultSite:
+    def test_permutation_deterministic_and_bounded(self):
+        ts = [BASE + i * 10 for i in range(64)]
+        p1 = faults.parse_plan("seed=7;ingest_disorder:jitter=50,times=-1")
+        p2 = faults.parse_plan("seed=7;ingest_disorder:jitter=50,times=-1")
+        perm1 = p1.permute("ingest_disorder", "a:S", ts)
+        perm2 = p2.permute("ingest_disorder", "a:S", ts)
+        assert perm1 == perm2 and sorted(perm1) == list(range(64))
+        assert perm1 != list(range(64))
+        # displacement bound: a row never lands behind one > jitter newer
+        shuffled = [ts[i] for i in perm1]
+        for i, t in enumerate(shuffled):
+            assert max(shuffled[: i + 1]) - t <= 50
+
+    def test_different_seed_different_shuffle(self):
+        ts = [BASE + i * 10 for i in range(64)]
+        a = faults.parse_plan("seed=1;ingest_disorder:jitter=50,times=-1")
+        b = faults.parse_plan("seed=2;ingest_disorder:jitter=50,times=-1")
+        assert a.permute("ingest_disorder", "k", ts) != \
+            b.permute("ingest_disorder", "k", ts)
+
+    def test_jitter_rules_never_raise_via_check(self):
+        plan = faults.parse_plan("ingest_disorder:jitter=50,times=-1")
+        plan.check("ingest_disorder", "k")  # transform rules are not errors
+
+    def test_disorder_wrapper_installed_only_with_plan(self):
+        from siddhi_tpu.core.app_runtime import _DisorderInputHandler
+
+        ql = """
+        define stream S (sym string, v long);
+        from S select sym, v insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        try:
+            probe = rt.get_input_handler("S")
+            while probe is not None:
+                assert not isinstance(probe, _DisorderInputHandler)
+                probe = getattr(probe, "_inner", None)
+        finally:
+            rt.shutdown()
+            mgr.shutdown()
+
+        faults.install(faults.parse_plan(
+            "seed=3;ingest_disorder:jitter=20,times=-1"
+        ))
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(ql)
+            try:
+                found, probe = False, rt.get_input_handler("S")
+                while probe is not None and not found:
+                    found = isinstance(probe, _DisorderInputHandler)
+                    probe = getattr(probe, "_inner", None)
+                assert found
+            finally:
+                rt.shutdown()
+                mgr.shutdown()
+        finally:
+            faults.uninstall()
