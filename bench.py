@@ -803,6 +803,19 @@ WIRE_WORKLOADS = {
         """,
         "Meters",
     ),
+    # the UN-annotated twin of wire_delta: no @app:wire at all — the value
+    # analysis (analysis/values.py) must PROVE seq monotone from its use as
+    # externalTimeBatch's event-time variable and delta-encode it with no
+    # hint. The leg reports how much of wire_delta's hinted reduction the
+    # inference recovers (`wire_delta_inferred_recovery`).
+    "wire_delta_inferred": (
+        """
+        define stream Meters (seq long, v float);
+        @info(name='q') from Meters#window.externalTimeBatch(seq, 1000)
+        select seq, v insert into Out;
+        """,
+        "Meters",
+    ),
 }
 
 
@@ -837,6 +850,7 @@ def _leg_wire(batch=4096, events=400_000) -> dict:
             },
         ),
     }
+    feeds["wire_delta_inferred"] = feeds["wire_delta"]
 
     def run(name, ql, stream, env_val, feed, cb_col):
         saved = os.environ.get("SIDDHI_TPU_WIRE")
@@ -899,6 +913,15 @@ def _leg_wire(batch=4096, events=400_000) -> dict:
         out[f"{name}_rows_match"] = enc["rows"] == raw["rows"]
         out[f"{name}_checksum_match"] = enc["checksum"] == raw["checksum"]
         out[f"{name}_rows"] = enc["rows"]
+    # how much of the DECLARED delta hint's byte reduction pure inference
+    # recovers on the un-annotated twin (ISSUE: must be >= 0.8 in CI)
+    if out.get("wire_delta_reduction") and out.get(
+        "wire_delta_inferred_reduction"
+    ):
+        out["wire_delta_inferred_recovery"] = round(
+            out["wire_delta_inferred_reduction"]
+            / out["wire_delta_reduction"], 3
+        )
 
     # forced mid-stream fallback: after the dict-encoded steady state, a
     # burst with 300 distinct symbols (> the declared 64) arrives — the

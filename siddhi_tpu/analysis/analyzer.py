@@ -147,12 +147,22 @@ def _analyze(
     check_dataflow(app, sym, flows, diags)
 
     if lints:
-        # cost model + fusion-feasibility lints (SA120-SA124, warnings)
+        # value analysis (SA135-SA137) feeds the cost model (narrowed
+        # widths, interval selectivity), SA133/SA138, and the plan's
+        # rewrites/domains sections; its own failure degrades to
+        # no-facts, never to a failed analysis
         from siddhi_tpu.analysis.cost import check_costs
         from siddhi_tpu.analysis.fusion import check_fusion
 
-        model = check_costs(app, sym, diags)
-        plan = check_fusion(app, sym, diags, model)
+        va = None
+        try:
+            from siddhi_tpu.analysis.values import check_values
+
+            va = check_values(app, sym, diags)
+        except Exception:  # pragma: no cover - analyzer defect guard
+            va = None
+        model = check_costs(app, sym, diags, values=va)
+        plan = check_fusion(app, sym, diags, model, values=va)
         if out is not None:
             out["fusion_plan"] = plan
     return flows

@@ -350,15 +350,27 @@ def _capacity_annotation(app: SiddhiApp, name: str, default: int) -> int:
         return default
 
 
-def _schema_tensors(schema: Optional[dict], rows: int, prefix: str = "cols") -> list:
-    """Per-attribute (rows,) lanes for a resolved schema; [] when open."""
+def _schema_tensors(
+    schema: Optional[dict], rows: int, prefix: str = "cols",
+    facts: Optional[dict] = None,
+) -> list:
+    """Per-attribute (rows,) lanes for a resolved schema; [] when open.
+    With `facts` (attr -> ValueFact from analysis/values.py), a LONG lane
+    whose proven interval fits int32 is sized at the narrowed width — the
+    same downcast the wire/state layer applies once the proof holds."""
     if schema is None:
         return []
     out = []
     for name, t in schema.items():
         if t is None:
             t = AttrType.LONG  # unknown attr type: widest assumption
-        out.append(TensorSpec(f"{prefix}.{name}", (rows,), _DTYPE_NAME[t]))
+        dt = _DTYPE_NAME[t]
+        if facts is not None and t is AttrType.LONG:
+            f = facts.get(name)
+            if f is not None and f.lo is not None and f.hi is not None \
+                    and -(2 ** 31) <= f.lo and f.hi < 2 ** 31:
+                dt = "int32"
+        out.append(TensorSpec(f"{prefix}.{name}", (rows,), dt))
     return out
 
 
@@ -437,7 +449,8 @@ def expr_signature(expr) -> str:
 
 
 def _window_cost(
-    spec: WindowSpec, schema: Optional[dict], qid: Optional[str]
+    spec: WindowSpec, schema: Optional[dict], qid: Optional[str],
+    facts: Optional[dict] = None,
 ) -> OperatorCost:
     """Mirror core/windows.py make_window sizing for one window handler,
     reading the state-bound metadata WindowSpec itself carries."""
@@ -464,7 +477,9 @@ def _window_cost(
     tensors = []
     for b in range(buffers):
         pref = ("cur" if b == 0 else "prev") if buffers == 2 else "ring"
-        tensors.extend(_schema_tensors(schema, rows, prefix=f"{pref}"))
+        tensors.extend(
+            _schema_tensors(schema, rows, prefix=f"{pref}", facts=facts)
+        )
         tensors.append(TensorSpec(f"{pref}.ts", (rows,), "int64"))
         if not is_batch:
             # sliding family: wts + seq ordering lanes (windows.py init_state)
@@ -478,18 +493,35 @@ def _source_operators(
     s: SingleInputStream,
     schema: Optional[dict],
     qid: str,
+    facts: Optional[dict] = None,
 ) -> tuple[list, bool]:
-    """(operators, scheduler_armed) for one single-source handler chain."""
+    """(operators, scheduler_armed) for one single-source handler chain.
+    With `facts` (attr -> ValueFact), a filter whose predicate narrows a
+    PROVEN bounded domain gets an interval-overlap selectivity estimate
+    in place of the flat default, and window rings size at narrowed
+    widths."""
     ops: list[OperatorCost] = []
     armed = False
     for h in s.handlers:
         if isinstance(h, Filter):
+            sel = _SEL["filter"]
+            if facts:
+                try:
+                    from siddhi_tpu.analysis.values import (
+                        filter_selectivity,
+                    )
+
+                    refined = filter_selectivity(h.expression, facts)
+                    if refined is not None:
+                        sel = refined
+                except Exception:  # pragma: no cover - defect guard
+                    pass
             ops.append(OperatorCost(
-                "filter", "filter", [], _SEL["filter"],
+                "filter", "filter", [], sel,
                 getattr(h, "line", None), getattr(h, "col", None),
             ))
         elif isinstance(h, WindowHandler):
-            ops.append(_window_cost(h.window, schema, qid))
+            ops.append(_window_cost(h.window, schema, qid, facts))
             armed = armed or h.window.arms_scheduler
     return ops, armed
 
@@ -616,9 +648,40 @@ def produced_streams(app: SiddhiApp) -> set:
     return produced
 
 
-def compute_costs(app: SiddhiApp, sym=None) -> AppCostModel:
+def _hint_lane_bytes(hint, t: AttrType) -> Optional[int]:
+    """Narrowed wire bytes/row one declared-or-inferred hint buys a lane
+    of declared type `t`, or None when the hint does not shrink it.
+    Mirrors core/wire.py lane widths without the amortized headers (the
+    cost model predicts per-row bytes, not per-chunk)."""
+    wide = _NBYTES[t or AttrType.LONG]
+    if hint is None or t not in (AttrType.INT, AttrType.LONG,
+                                 AttrType.STRING, AttrType.OBJECT):
+        return None
+    if hint[0] == "range" and t in (AttrType.INT, AttrType.LONG):
+        lo, hi = int(hint[1]), int(hint[2])
+        for width, bound in ((1, 1 << 7), (2, 1 << 15), (4, 1 << 31)):
+            if width < wide and -bound <= lo and hi < bound:
+                return width
+        return None
+    if hint[0] == "dict":
+        width = 1 if int(hint[1]) <= 256 else 2
+        return width if width < wide else None
+    if hint[0] == "delta" and t in (AttrType.INT, AttrType.LONG):
+        try:
+            width = int(getattr(hint[1], "itemsize", 2))
+        except (TypeError, ValueError):
+            width = 2
+        return width if width < wide else None
+    return None
+
+
+def compute_costs(app: SiddhiApp, sym=None, values=None) -> AppCostModel:
     """Build the full static cost model for `app`. Never raises on bad apps:
-    unresolvable pieces degrade to empty/None entries."""
+    unresolvable pieces degrade to empty/None entries. With `values` (a
+    ValueAnalysis from analysis/values.py), state tensors size at proven
+    narrowed widths, filter selectivities refine from interval overlap,
+    and wire-byte predictions price declared @app:wire contracts AND
+    inferred encoders instead of full declared widths."""
     from siddhi_tpu.analysis.symbols import build_symbols
 
     if sym is None:
@@ -629,10 +692,21 @@ def compute_costs(app: SiddhiApp, sym=None) -> AppCostModel:
     K = max(2, K)
     model = AppCostModel(app.name, B, K)
 
+    # inferred wire hints subsume the declared @app:wire contracts (the
+    # analysis is seeded from them), so one map prices both
+    wire_hints: dict = {}
+    if values is not None:
+        try:
+            from siddhi_tpu.analysis.values import infer_wire_hints
+
+            wire_hints = infer_wire_hints(values, sym)
+        except Exception:  # pragma: no cover - defect guard
+            wire_hints = {}
+
     produced = produced_streams(app)
     for qid, q, in_part in iter_query_entries(app):
         model.queries[qid] = _query_cost(
-            q, qid, app, sym, B, in_part, produced
+            q, qid, app, sym, B, in_part, produced, values
         )
 
     for sid, schema in sym.streams.items():
@@ -641,10 +715,15 @@ def compute_costs(app: SiddhiApp, sym=None) -> AppCostModel:
         ]
         if not consumers:
             continue
-        row_bytes = (
-            sum(_NBYTES[t or AttrType.LONG] for t in schema.values()) + 8
-            if schema is not None else None
-        )
+        row_bytes = None
+        if schema is not None:
+            row_bytes = 8  # int64 timestamp lane
+            for name, t in schema.items():
+                narrowed = _hint_lane_bytes(wire_hints.get((sid, name)), t)
+                row_bytes += (
+                    narrowed if narrowed is not None
+                    else _NBYTES[t or AttrType.LONG]
+                )
         has_interned = schema is not None and any(
             t in (AttrType.STRING, AttrType.OBJECT) for t in schema.values()
         )
@@ -666,6 +745,7 @@ def _query_cost(
     B: int,
     in_partition: bool,
     produced: set,
+    values=None,
 ) -> QueryCost:
     stream = q.input_stream
     operators: list[OperatorCost] = []
@@ -673,6 +753,12 @@ def _query_cost(
     consumed: list[str] = []
     armed = False
     kind = "single"
+
+    def stream_facts(sid: str) -> Optional[dict]:
+        if values is None:
+            return None
+        facts = values.facts_for(sid)
+        return facts or None
 
     def step_causes(extra_shapes: int) -> dict:
         causes = {"first_compile": 1}
@@ -685,7 +771,9 @@ def _query_cost(
             stream.stream_id
         )
         consumed.append(stream.stream_id)
-        ops, armed = _source_operators(stream, schema, qid)
+        ops, armed = _source_operators(
+            stream, schema, qid, stream_facts(stream.stream_id)
+        )
         operators.extend(ops)
         extra = (1 if armed else 0) + (
             1 if stream.stream_id in produced and B != 64 else 0
@@ -706,13 +794,17 @@ def _query_cost(
                 or sym.windows.get(sid)
             if sid in sym.streams:
                 consumed.append(sid)
-            ops, side_armed = _source_operators(s, schema, qid)
+            ops, side_armed = _source_operators(
+                s, schema, qid, stream_facts(sid)
+            )
             armed = armed or side_armed
             # a join side buffers its window content at join capacity
             win = [o for o in ops if o.op.startswith("window")]
             operators.extend(ops)
             if is_stream:
-                side_tensors = _schema_tensors(schema, jc, prefix="buf")
+                side_tensors = _schema_tensors(
+                    schema, jc, prefix="buf", facts=stream_facts(sid)
+                )
                 operators.append(OperatorCost(
                     f"join:{side}",
                     f"side buffer cap={jc}"
@@ -806,11 +898,12 @@ def aggregation_state_bytes(ad, app: SiddhiApp) -> Optional[int]:
 
 
 def check_costs(
-    app: SiddhiApp, sym, diags: list, model: Optional[AppCostModel] = None
+    app: SiddhiApp, sym, diags: list,
+    model: Optional[AppCostModel] = None, values=None,
 ) -> AppCostModel:
     """Run the cost lints; returns the model so callers reuse it."""
     if model is None:
-        model = compute_costs(app, sym)
+        model = compute_costs(app, sym, values)
     budget = state_budget_bytes()
 
     # SA120: every with no within, anywhere in a pattern/sequence
@@ -872,12 +965,12 @@ def check_costs(
             severity=WARNING,
         ))
 
-    # SA133: h2d-dominant wide column — a LONG column with no @app:wire
-    # encoding hint that alone accounts for >= half the stream's estimated
-    # wire bytes/event on a consumed (h2d-riding) stream. Actionable: a
-    # declared range/delta hint narrows it statically (core/wire.py), or
-    # interned strings ride as int32 ids.
-    _check_wire_dominance(app, sym, model, diags)
+    # SA133/SA138: h2d-dominant wide column — a LONG column with no
+    # @app:wire encoding hint that alone accounts for >= half the stream's
+    # estimated wire bytes/event on a consumed (h2d-riding) stream. SA133
+    # (add a hint) only when value analysis CANNOT prove the lane
+    # encodable; when it can, SA138 says inference already compacts it.
+    _check_wire_dominance(app, sym, model, diags, values)
 
     # SA122: @app:batch != 64 downstream of a query insert (re-published
     # slices arrive <= 64 rows: a second shape signature per program)
@@ -899,20 +992,38 @@ def check_costs(
 
 
 def _check_wire_dominance(
-    app: SiddhiApp, sym, model: AppCostModel, diags: list
+    app: SiddhiApp, sym, model: AppCostModel, diags: list, values=None
 ) -> None:
-    """SA133 (see check_costs). Skipped when the app opts out via
+    """SA133/SA138 (see check_costs). Skipped when the app opts out via
     `@app:wire(disable='true')` — the user already declined the wire
-    layer, so the hint would be noise. Specs come from the SAME shared
-    preamble the FusionPlan wire section uses (core/wire.py
-    app_wire_specs), at the model's real batch size."""
-    from siddhi_tpu.core.wire import app_wire_specs, estimate_wire_bytes
+    layer, so the hint would be noise. Dominance is judged on the
+    DECLARED-only spec (the wide lane is wide until someone encodes it);
+    the verdict then splits on whether value analysis proves the lane
+    encodable. Specs come from the SAME shared preamble the FusionPlan
+    wire section uses (core/wire.py app_wire_specs), at the model's real
+    batch size."""
+    from siddhi_tpu.core.wire import (
+        _hint_entry,
+        app_wire_specs,
+        estimate_wire_bytes,
+        lane_bytes_per_row,
+    )
 
     disabled, specs = app_wire_specs(
         app, sym.streams, sorted(model.streams), model.batch_size
     )
     if disabled:
         return
+    inferred: dict = {}
+    if values is not None:
+        try:
+            from siddhi_tpu.analysis.values import infer_wire_hints
+
+            inferred = infer_wire_hints(values, sym)
+        except Exception:  # pragma: no cover - defect guard
+            inferred = {}
+    _HINT_WORD = {"range": "bounded", "dict": "low-cardinality",
+                  "delta": "monotone"}
     for sid, (attrs, spec) in specs.items():
         enc = spec.encodings if spec is not None else {}
         total = max(
@@ -926,6 +1037,27 @@ def _check_wire_dominance(
             # else on the wire combined (a 50/50 split stays quiet — the
             # false-positive net is the whole test corpus)
             if 8.0 / total <= 0.5:
+                continue
+            hint = inferred.get((sid, name))
+            entry = None
+            if hint is not None:
+                import numpy as np
+
+                entry = _hint_entry(hint, t, np.dtype(np.int64))
+                if entry is not None and lane_bytes_per_row(
+                    name, np.dtype(np.int64), entry, model.batch_size
+                ) >= 8:
+                    entry = None
+            if entry is not None:
+                diags.append(Diagnostic(
+                    "SA138",
+                    f"stream '{sid}': LONG column '{name}' dominates the "
+                    f"h2d wire (8 of ~{total} B/event), and value "
+                    f"analysis proves it {_HINT_WORD[hint[0]]} — wire "
+                    f"inference {hint[0]}-encodes it with no annotation",
+                    getattr(d, "line", None), getattr(d, "col", None),
+                    severity=WARNING,
+                ))
                 continue
             diags.append(Diagnostic(
                 "SA133",

@@ -78,6 +78,19 @@ CODES: dict[str, str] = {
     "SA134": "invalid @app:watermark annotation (missing/bad bound / bad "
              "idle.timeout / unknown late.policy / allowed.lateness "
              "without late.policy='apply' / unknown option)",
+    # value analysis (analysis/values.py; warnings)
+    "SA135": "provably-false filter: on the proven value domain the "
+             "predicate can never hold, so the query is unreachable "
+             "(warning)",
+    "SA136": "comparison that can never vary: the proven value domain "
+             "decides it always-true or always-false (warning)",
+    "SA137": "arithmetic hazard on a proven domain: possible overflow of "
+             "the result type, or division/modulo by a domain containing "
+             "zero (warning)",
+    "SA138": "inferred-encodable wide column: the dominant wide column's "
+             "bounds/cardinality/monotonicity are PROVEN by value "
+             "analysis, so wire inference compacts it with no annotation "
+             "(informational successor to SA133; warning)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

@@ -62,7 +62,11 @@ from siddhi_tpu.analysis.diagnostics import WARNING, Diagnostic
 # v2: per-stream `wire` section — the versioned WireSpec (core/wire.py)
 # naming each consumed stream's analyzer-chosen per-column wire encodings
 # plus the predicted logical-vs-encoded bytes/event
-PLAN_VERSION = 2
+# v3: value-analysis facts — `domains` (per-stream inferred abstract
+# domains, analysis/values.py), `rewrites` (semantics-preserving rewrite
+# opportunities the analysis proved), and wire entries gain inferred-lane
+# provenance + prunable dead columns
+PLAN_VERSION = 3
 
 # hazard ids, stable (documented in the README; SA124 messages name them)
 H_ASYNC = "async-ingress"
@@ -102,6 +106,12 @@ class FusionPlan:
     # per-column encoding choice for every consumed stream, with the
     # predicted logical-vs-encoded bytes/event
     wire: dict = dataclasses.field(default_factory=dict)
+    # v3: semantics-preserving rewrites proven by value analysis
+    # (analysis/values.py) — constant folds, always-true conjunct drops,
+    # provably-false filters, prunable dead columns
+    rewrites: list = dataclasses.field(default_factory=list)
+    # v3: sid -> {attr -> abstract-domain dict} from the value fixpoint
+    domains: dict = dataclasses.field(default_factory=dict)
     costs: Optional[AppCostModel] = None
 
     def to_dict(self) -> dict:
@@ -116,6 +126,8 @@ class FusionPlan:
             "blockers": list(self.blockers),
             "shared_state": list(self.shared_state),
             "wire": dict(self.wire),
+            "rewrites": list(self.rewrites),
+            "domains": dict(self.domains),
             "costs": self.costs.to_dict() if self.costs is not None else None,
         }
 
@@ -144,6 +156,7 @@ class FusionPlan:
                  "est_bytes_saved": s["est_bytes_saved"]}
                 for s in self.shared_state
             ],
+            "rewrites": list(self.rewrites),
         }
 
 
@@ -203,7 +216,8 @@ def _query_hazard(
 
 
 def build_fusion_plan(
-    app: SiddhiApp, sym=None, model: Optional[AppCostModel] = None
+    app: SiddhiApp, sym=None, model: Optional[AppCostModel] = None,
+    values=None,
 ) -> FusionPlan:
     """Pure AST pass; never raises on semantically-bad apps (unknown
     streams simply do not form groups)."""
@@ -211,8 +225,15 @@ def build_fusion_plan(
 
     if sym is None:
         sym = build_symbols(app, [])
+    if values is None:
+        try:
+            from siddhi_tpu.analysis.values import analyze_values
+
+            values = analyze_values(app, sym)
+        except Exception:  # pragma: no cover — plan must survive bad apps
+            values = None
     if model is None:
-        model = compute_costs(app, sym)
+        model = compute_costs(app, sym, values=values)
 
     plan = FusionPlan(
         app.name, model.batch_size, model.chunk_batches, costs=model
@@ -285,12 +306,16 @@ def build_fusion_plan(
             })
 
     _collect_shared_state(app, sym, model, consumers, plan)
-    _collect_wire_specs(app, sym, model, plan)
+    _collect_wire_specs(app, sym, model, plan, values)
+    if values is not None:
+        plan.rewrites = list(values.rewrites)
+        plan.domains = values.domains_dict()
     return plan
 
 
 def _collect_wire_specs(
-    app: SiddhiApp, sym, model: AppCostModel, plan: FusionPlan
+    app: SiddhiApp, sym, model: AppCostModel, plan: FusionPlan,
+    values=None,
 ) -> None:
     """Per consumed stream: the static WireSpec (core/wire.py — the same
     builder the runtime's fused ingest consumes, so the plan and the
@@ -305,13 +330,23 @@ def _collect_wire_specs(
         logical_row_bytes,
     )
 
+    inferred = None
+    if values is not None:
+        try:
+            from siddhi_tpu.analysis.values import infer_wire_hints
+
+            inferred = infer_wire_hints(values, sym)
+        except Exception:  # pragma: no cover
+            inferred = None
     disabled, specs = app_wire_specs(
-        app, sym.streams, sorted(model.streams), model.batch_size
+        app, sym.streams, sorted(model.streams), model.batch_size,
+        inferred=inferred,
     )
+    dead = getattr(values, "dead_columns", None) or {}
     for sid, (attrs, spec) in specs.items():
         entry = {
             "version": WIRE_SPEC_VERSION,
-            "source": "static",
+            "source": spec.source if spec is not None else "static",
             "encodings": {
                 lane: encoding_label(e)
                 for lane, e in sorted(
@@ -323,6 +358,10 @@ def _collect_wire_specs(
                 attrs, spec, capacity=model.batch_size
             ),
         }
+        if spec is not None and spec.inferred_lanes:
+            entry["inferred_lanes"] = sorted(spec.inferred_lanes)
+        if sid in dead:
+            entry["pruned"] = list(dead[sid])
         if disabled:
             entry["disabled"] = True
         plan.wire[sid] = entry
@@ -377,9 +416,10 @@ def _collect_shared_state(
 
 
 def check_fusion(
-    app: SiddhiApp, sym, diags: list, model: Optional[AppCostModel] = None
+    app: SiddhiApp, sym, diags: list, model: Optional[AppCostModel] = None,
+    values=None,
 ) -> FusionPlan:
-    plan = build_fusion_plan(app, sym, model)
+    plan = build_fusion_plan(app, sym, model, values=values)
     nodes = {qid: q for qid, q, _in_part in iter_query_entries(app)}
 
     # SA123: identical window duplicated across queries (shareable)
@@ -451,13 +491,27 @@ def render_plan_text(plan: FusionPlan) -> str:
         lines.append("wire encodings:")
         for sid, w in sorted(encoded_streams.items()):
             encs = ", ".join(
-                f"{lane}={label}" for lane, label in w["encodings"].items()
+                f"{lane}={label}"
+                + ("*" if lane in w.get("inferred_lanes", []) else "")
+                for lane, label in w["encodings"].items()
             )
+            suffix = ""
+            if w.get("inferred_lanes"):
+                suffix += ", *=inferred"
+            if w.get("pruned"):
+                suffix += f", pruned: {', '.join(w['pruned'])}"
             lines.append(
                 f"  stream {sid}: {encs}  "
                 f"({w['logical_B_per_ev']} -> ~{w['encoded_B_per_ev_est']} "
-                f"B/ev{', DISABLED' if w.get('disabled') else ''})"
+                f"B/ev{', DISABLED' if w.get('disabled') else ''}{suffix})"
             )
+    if plan.rewrites:
+        lines.append("rewrites (value analysis):")
+        for r in plan.rewrites:
+            detail = ", ".join(
+                f"{k}={v}" for k, v in sorted(r.items()) if k != "kind"
+            )
+            lines.append(f"  {r['kind']}: {detail}")
     if plan.costs is not None:
         lines.append("per-query cost:")
         for qid, qc in sorted(plan.costs.queries.items()):

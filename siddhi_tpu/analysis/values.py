@@ -1,0 +1,1325 @@
+"""Abstract-interpretation value analysis over the query graph.
+
+Propagates per-attribute abstract domains — integer intervals, small
+constant sets (including low-cardinality string sets), null-ability, and
+monotonicity — from literals, filter predicates, selector arithmetic, and
+declared `@app:wire` contracts, through multi-hop insert-into chains,
+joins (per-side domains), windows, and partitions, to a fixpoint (TiLT's
+derive-layout-from-the-IR move, PAPERS.md, applied to SiddhiQL).
+
+The domain lattice, per attribute (`ValueFact`):
+
+* ``interval``  — integer bounds `[lo, hi]`, either side open (None).
+  INT/LONG only; floats never carry intervals.
+* ``consts``    — the set of values the attribute can possibly hold, when
+  provably small (<= MAX_CONSTS): int literals, or raw string literals on
+  interned columns. None = unknown/unbounded.
+* ``card``      — cardinality bound without known values (from a declared
+  `dict` hint, or len(consts)).
+* ``nullable``  — False only when provably non-null (literals, arithmetic
+  over non-null operands).
+* ``monotone``  — non-decreasing in stream order. Seeded from declared
+  `delta` hints and from the EVENT-TIME CONTRACT: a LONG/INT attribute
+  some consumer uses as the time attribute of `#window.externalTime` /
+  `#window.externalTimeBatch` is the stream's event clock, which the
+  engine (and PR 14's watermark reorder stage) treats as ordered.
+  Survives filters, plain insert-into chains, non-reordering windows
+  emitting CURRENT events, and `x + c` / `x * positive-c` arithmetic;
+  dies at joins, patterns, group-by, order-by, and expired-event outputs.
+
+Fixpoint & widening: DECLARED streams start from their external
+contribution (TOP per attribute, refined by `@app:wire` contracts and the
+event-time rule — external senders may inject anything the contract
+allows), then JOIN in every in-graph producer's output facts. Streams
+that exist only as insert-into targets start at BOTTOM and take exactly
+the join of their producers. Queries are re-run in execution order until
+nothing changes; an attribute whose interval/constant-set is still
+growing after WIDEN_AFTER joins is widened (the growing bound opens to
+None, the set drops to unknown), so cyclic insert-into graphs terminate
+instead of counting to 2^63.
+
+Consumers of the facts:
+
+* inferred wire specs — `infer_wire_hints()` turns proven facts into the
+  same hint tuples `@app:wire` declares (interval -> range/narrow, small
+  constant set -> dict, monotone -> delta int16), consumed by
+  `core/wire.py build_wire_spec(..., inferred=...)`. Declared hints win
+  per lane; every inferred encoder rides the existing per-chunk misfit
+  guard, so a wrong proof can only cost a full-width rebuild, never
+  wrong bytes.
+* query rewriting — `rewrites` notes (constant-folded selector
+  expressions, provably-true filter conjuncts, provably-false filters,
+  dead columns no consumer reads), surfaced in the FusionPlan (v3) and
+  `runtime.explain()`. Purely advisory: execution is not changed, so the
+  wire parity contract is untouched.
+* lints — SA135 (provably-false filter / unreachable query), SA136
+  (comparison that can never vary), SA137 (arithmetic overflow /
+  division by zero on a proven domain), SA138 (inferred-encodable
+  dominant wide column — the actionable successor to SA133).
+
+Everything here is a pure AST pass: deterministic iteration order
+(execution-id order for queries, schema order for attributes), so plan
+JSON is byte-stable across runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from siddhi_tpu.core.types import AttrType
+from siddhi_tpu.query_api.annotation import find_annotation
+from siddhi_tpu.query_api.execution import (
+    Filter,
+    JoinInputStream,
+    OutputEventsFor,
+    Query,
+    SingleInputStream,
+    StateInputStream,
+    StreamFunctionHandler,
+    WindowHandler,
+    iter_state_streams,
+)
+from siddhi_tpu.query_api.expression import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    CompareOp,
+    Constant,
+    Divide,
+    Expression,
+    In,
+    IsNull,
+    Mod,
+    Multiply,
+    Not,
+    Or,
+    Subtract,
+    Variable,
+)
+from siddhi_tpu.query_api.siddhi_app import SiddhiApp
+
+_INTEGRAL = (AttrType.INT, AttrType.LONG)
+_INTERNED = (AttrType.STRING, AttrType.OBJECT)
+
+TYPE_BOUNDS = {
+    AttrType.INT: (-(2 ** 31), 2 ** 31 - 1),
+    AttrType.LONG: (-(2 ** 63), 2 ** 63 - 1),
+}
+
+# constant sets larger than this collapse to unknown (lattice height cap)
+MAX_CONSTS = 16
+
+# joins into one (stream, attr) slot before a still-growing bound widens
+WIDEN_AFTER = 3
+
+# absolute fixpoint round cap — the widening proof makes this unreachable,
+# but a bug must degrade to imprecise facts, not a hang
+MAX_ROUNDS = 64
+
+# windows that neither reorder CURRENT-event emission nor synthesize
+# values: facts flow through; monotone survives (CURRENT output only)
+_ORDER_PRESERVING_WINDOWS = {
+    "length", "time", "timelength", "externaltime",
+    "lengthbatch", "timebatch", "externaltimebatch",
+}
+
+_EXTERNAL_TIME_WINDOWS = {"externaltime", "externaltimebatch"}
+
+
+# ---------------------------------------------------------------------------
+# the domain
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ValueFact:
+    """Abstract value of one attribute. The default instance is TOP."""
+
+    lo: Optional[int] = None
+    hi: Optional[int] = None
+    consts: Optional[frozenset] = None
+    card: Optional[int] = None
+    nullable: bool = True
+    monotone: bool = False
+    atype: Optional[AttrType] = None
+
+    def is_top(self) -> bool:
+        return (
+            self.lo is None and self.hi is None and self.consts is None
+            and self.card is None and self.nullable and not self.monotone
+        )
+
+    def contradiction(self) -> bool:
+        """An empty domain: no concrete value satisfies the facts."""
+        if self.lo is not None and self.hi is not None and self.lo > self.hi:
+            return True
+        return self.consts is not None and not self.consts
+
+    def to_dict(self) -> dict:
+        """JSON form for the plan `domains` section; TOP fields omitted."""
+        out: dict = {}
+        if self.lo is not None or self.hi is not None:
+            out["interval"] = [self.lo, self.hi]
+        if self.consts is not None:
+            out["consts"] = sorted(self.consts, key=lambda v: (str(type(v)), v))
+        if self.card is not None:
+            out["card"] = self.card
+        if not self.nullable:
+            out["non_null"] = True
+        if self.monotone:
+            out["monotone"] = True
+        return out
+
+
+TOP = ValueFact()
+
+
+def _min_open(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    if a is None or b is None:
+        return None
+    return min(a, b)
+
+
+def _max_open(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    if a is None or b is None:
+        return None
+    return max(a, b)
+
+
+def fact_join(a: ValueFact, b: ValueFact) -> ValueFact:
+    """Least upper bound: the result over-approximates both inputs."""
+    consts = None
+    if a.consts is not None and b.consts is not None:
+        u = a.consts | b.consts
+        consts = u if len(u) <= MAX_CONSTS else None
+    card = None
+    if consts is not None:
+        card = len(consts)
+    elif a.card is not None and b.card is not None:
+        card = max(a.card, b.card)
+    return ValueFact(
+        lo=_min_open(a.lo, b.lo),
+        hi=_max_open(a.hi, b.hi),
+        consts=consts,
+        card=card,
+        nullable=a.nullable or b.nullable,
+        monotone=a.monotone and b.monotone,
+        atype=a.atype if a.atype is b.atype else None,
+    )
+
+
+def fact_widen(old: ValueFact, new: ValueFact) -> ValueFact:
+    """Widening: any bound still moving after WIDEN_AFTER joins opens."""
+    return dataclasses.replace(
+        new,
+        lo=None if (old.lo is None or new.lo is None or new.lo < old.lo)
+        else new.lo,
+        hi=None if (old.hi is None or new.hi is None or new.hi > old.hi)
+        else new.hi,
+        consts=new.consts if new.consts == old.consts else None,
+        card=new.card if new.card == old.card else None,
+    )
+
+
+def _const_fact(c: Constant) -> ValueFact:
+    v = c.value
+    t = c.type
+    if t in _INTEGRAL or (isinstance(v, int) and not isinstance(v, bool)):
+        iv = int(v)
+        return ValueFact(
+            lo=iv, hi=iv, consts=frozenset({iv}), card=1, nullable=False,
+            atype=t if t in _INTEGRAL else AttrType.LONG,
+        )
+    if t is AttrType.STRING and isinstance(v, str):
+        return ValueFact(
+            consts=frozenset({v}), card=1, nullable=False, atype=t
+        )
+    return ValueFact(nullable=False, atype=t)
+
+
+# ---------------------------------------------------------------------------
+# abstract expression evaluation
+# ---------------------------------------------------------------------------
+
+# env: ref -> {attr: ValueFact} (per query, after source resolution)
+
+
+def _lookup(var: Variable, env: dict) -> ValueFact:
+    if var.stream_id is not None:
+        facts = env.get(var.stream_id)
+        if facts is None:
+            return TOP
+        return facts.get(var.attribute, TOP)
+    hits = [f for f in env.values() if var.attribute in f]
+    if len(hits) == 1:
+        return hits[0][var.attribute]
+    return TOP
+
+
+def _promote(a: Optional[AttrType], b: Optional[AttrType]) -> Optional[AttrType]:
+    if a in _INTEGRAL and b in _INTEGRAL:
+        return AttrType.LONG if AttrType.LONG in (a, b) else AttrType.INT
+    return None  # float/unknown arithmetic carries no integer bounds
+
+
+def _arith_bounds(op: str, a: ValueFact, b: ValueFact):
+    """Exact interval arithmetic for +, -, *; None bounds poison."""
+    if None in (a.lo, a.hi, b.lo, b.hi):
+        return None, None
+    if op == "+":
+        return a.lo + b.lo, a.hi + b.hi
+    if op == "-":
+        return a.lo - b.hi, a.hi - b.lo
+    prods = [a.lo * b.lo, a.lo * b.hi, a.hi * b.lo, a.hi * b.hi]
+    return min(prods), max(prods)
+
+
+class _Evaluator:
+    """One query's abstract transfer: expression evaluation + predicate
+    narrowing, collecting lint sites and rewrite notes along the way."""
+
+    def __init__(self, qid: str, collect: bool = False):
+        self.qid = qid
+        self.collect = collect  # final pass: record lints/rewrites
+        self.lints: list = []  # (code, message, node)
+        self.decided: list = []  # (truth, node, label) per decided compare
+
+    # -- expressions --------------------------------------------------------
+
+    def eval(self, expr: Expression, env: dict) -> ValueFact:
+        if isinstance(expr, Constant):
+            return _const_fact(expr)
+        if isinstance(expr, Variable):
+            return _lookup(expr, env)
+        if isinstance(expr, (Add, Subtract, Multiply)):
+            return self._eval_arith(expr, env)
+        if isinstance(expr, (Divide, Mod)):
+            return self._eval_div(expr, env)
+        if isinstance(expr, AttributeFunction):
+            return self._eval_function(expr, env)
+        if isinstance(expr, (Compare, And, Or, Not, IsNull, In)):
+            truth = self.truth(expr, env)
+            if truth is None:
+                return ValueFact(atype=AttrType.BOOL)
+            return ValueFact(
+                consts=frozenset({truth}), card=1, nullable=False,
+                atype=AttrType.BOOL,
+            )
+        return TOP
+
+    def _eval_arith(self, expr, env: dict) -> ValueFact:
+        a = self.eval(expr.left, env)
+        b = self.eval(expr.right, env)
+        t = _promote(a.atype, b.atype)
+        op = {Add: "+", Subtract: "-", Multiply: "*"}[type(expr)]
+        lo = hi = None
+        if t is not None:
+            lo, hi = _arith_bounds(op, a, b)
+            bounds = TYPE_BOUNDS[t]
+            if lo is not None and (lo < bounds[0] or hi > bounds[1]):
+                self._lint(
+                    "SA137",
+                    f"'{_expr_str(expr)}' can overflow {t.name.lower()}: the "
+                    f"proven operand domains give [{lo}, {hi}], outside "
+                    f"[{bounds[0]}, {bounds[1]}]",
+                    expr,
+                )
+                lo = hi = None
+        mono = False
+        if op in ("+", "-"):
+            # monotone +/- a single constant keeps order
+            mono = (a.monotone and b.lo is not None and b.lo == b.hi) or (
+                op == "+" and b.monotone and a.lo is not None and a.lo == a.hi
+            )
+        elif op == "*":
+            mono = (a.monotone and b.lo is not None and b.lo == b.hi
+                    and b.lo > 0) or (
+                b.monotone and a.lo is not None and a.lo == a.hi and a.lo > 0
+            )
+        return ValueFact(
+            lo=lo, hi=hi, nullable=a.nullable or b.nullable,
+            monotone=mono, atype=t,
+        )
+
+    def _eval_div(self, expr, env: dict) -> ValueFact:
+        a = self.eval(expr.left, env)
+        b = self.eval(expr.right, env)
+        zero = False
+        if b.consts is not None and 0 in b.consts:
+            zero = True
+        elif b.lo is not None and b.hi is not None and b.lo <= 0 <= b.hi:
+            zero = True
+        if zero:
+            kind = "modulo" if isinstance(expr, Mod) else "division"
+            self._lint(
+                "SA137",
+                f"'{_expr_str(expr)}': {kind} by zero is possible — the "
+                "divisor's proven domain contains 0",
+                expr,
+            )
+        return ValueFact(
+            nullable=a.nullable or b.nullable,
+            atype=_promote(a.atype, b.atype),
+        )
+
+    def _eval_function(self, expr: AttributeFunction, env: dict) -> ValueFact:
+        from siddhi_tpu.core.executor import AGGREGATOR_NAMES
+
+        low = expr.name.lower()
+        if expr.namespace is None and expr.name in AGGREGATOR_NAMES:
+            if low == "count":
+                return ValueFact(lo=0, nullable=False, atype=AttrType.LONG)
+            if low in ("min", "max", "minforever", "maxforever") \
+                    and expr.parameters:
+                arg = self.eval(expr.parameters[0], env)
+                # extrema stay inside the argument's domain but lose
+                # order/cardinality facts (window expiry can re-raise min)
+                return ValueFact(
+                    lo=arg.lo, hi=arg.hi, nullable=arg.nullable,
+                    atype=arg.atype,
+                )
+            return TOP
+        if expr.namespace is None and low == "coalesce" and expr.parameters:
+            out = self.eval(expr.parameters[0], env)
+            for p in expr.parameters[1:]:
+                out = fact_join(out, self.eval(p, env))
+            return dataclasses.replace(
+                out,
+                nullable=all(
+                    self.eval(p, env).nullable for p in expr.parameters
+                ),
+            )
+        return TOP
+
+    # -- predicates ---------------------------------------------------------
+
+    def truth(self, expr: Expression, env: dict) -> Optional[bool]:
+        """3-valued abstract truth of a boolean expression."""
+        if isinstance(expr, And):
+            lt = self.truth(expr.left, env)
+            rt = self.truth(expr.right, env)
+            if lt is False or rt is False:
+                return False
+            if lt is True and rt is True:
+                return True
+            return None
+        if isinstance(expr, Or):
+            lt = self.truth(expr.left, env)
+            rt = self.truth(expr.right, env)
+            if lt is True or rt is True:
+                return True
+            if lt is False and rt is False:
+                return False
+            return None
+        if isinstance(expr, Not):
+            t = self.truth(expr.expression, env)
+            return None if t is None else not t
+        if isinstance(expr, Compare):
+            return self._compare_truth(expr, env)
+        if isinstance(expr, IsNull):
+            if expr.expression is not None \
+                    and not self.eval(expr.expression, env).nullable:
+                return False
+            return None
+        if isinstance(expr, Constant) and isinstance(expr.value, bool):
+            return bool(expr.value)
+        return None
+
+    def _compare_truth(self, cmp: Compare, env: dict) -> Optional[bool]:
+        a = self.eval(cmp.left, env)
+        b = self.eval(cmp.right, env)
+        op = cmp.op
+        if a.consts is not None and b.consts is not None:
+            if op is CompareOp.EQ and not (a.consts & b.consts):
+                return False
+            if op is CompareOp.NEQ and not (a.consts & b.consts):
+                return True
+            if len(a.consts) == 1 and len(b.consts) == 1:
+                av, bv = next(iter(a.consts)), next(iter(b.consts))
+                if type(av) is type(bv):
+                    return {
+                        CompareOp.EQ: av == bv, CompareOp.NEQ: av != bv,
+                        CompareOp.LT: av < bv, CompareOp.LE: av <= bv,
+                        CompareOp.GT: av > bv, CompareOp.GE: av >= bv,
+                    }[op]
+        # interval separation (integer domains only)
+        if op in (CompareOp.LT, CompareOp.LE):
+            if a.hi is not None and b.lo is not None and (
+                a.hi < b.lo or (op is CompareOp.LE and a.hi == b.lo)
+            ):
+                return True
+            if a.lo is not None and b.hi is not None and (
+                a.lo > b.hi or (op is CompareOp.LT and a.lo == b.hi)
+            ):
+                return False
+        if op in (CompareOp.GT, CompareOp.GE):
+            inv = CompareOp.LT if op is CompareOp.GT else CompareOp.LE
+            t = self._compare_truth(
+                Compare(left=cmp.right, op=inv, right=cmp.left), env
+            )
+            return t
+        if op is CompareOp.EQ:
+            if a.lo is not None and b.hi is not None and a.lo > b.hi:
+                return False
+            if a.hi is not None and b.lo is not None and a.hi < b.lo:
+                return False
+        if op is CompareOp.NEQ:
+            eq = self._compare_truth(
+                Compare(left=cmp.left, op=CompareOp.EQ, right=cmp.right), env
+            )
+            return None if eq is None else not eq
+        return None
+
+    def narrow(self, pred: Expression, env: dict) -> tuple[dict, Optional[bool]]:
+        """(narrowed env, abstract truth) of `pred` holding over `env`.
+        Decided leaf comparisons are recorded for SA136/rewrites."""
+        if isinstance(pred, And):
+            env1, lt = self.narrow(pred.left, env)
+            env2, rt = self.narrow(pred.right, env1)
+            if lt is False or rt is False:
+                return env2, False
+            return env2, (True if lt is True and rt is True else None)
+        if isinstance(pred, Or):
+            envl, lt = self.narrow(pred.left, env)
+            envr, rt = self.narrow(pred.right, env)
+            if lt is True or rt is True:
+                return env, True
+            if lt is False and rt is False:
+                return envl, False
+            if lt is False:
+                return envr, rt
+            if rt is False:
+                return envl, lt
+            return _env_join(envl, envr), None
+        if isinstance(pred, Not):
+            inner = _negate(pred.expression)
+            if inner is not None:
+                return self.narrow(inner, env)
+            t = self.truth(pred, env)
+            return env, t
+        if isinstance(pred, Compare):
+            t = self._compare_truth(pred, env)
+            if t is not None:
+                self.decided.append((t, pred, _expr_str(pred)))
+                return env, t
+            return self._narrow_compare(pred, env), None
+        t = self.truth(pred, env)
+        return env, t
+
+    def _narrow_compare(self, cmp: Compare, env: dict) -> dict:
+        """Narrow `var <op> literal` (either side) into a fresh env."""
+        var, op, c = None, cmp.op, None
+        if isinstance(cmp.left, Variable) and isinstance(cmp.right, Constant):
+            var, c = cmp.left, cmp.right
+        elif isinstance(cmp.right, Variable) and isinstance(cmp.left, Constant):
+            var, c = cmp.right, cmp.left
+            op = {
+                CompareOp.LT: CompareOp.GT, CompareOp.LE: CompareOp.GE,
+                CompareOp.GT: CompareOp.LT, CompareOp.GE: CompareOp.LE,
+            }.get(op, op)
+        if var is None:
+            return env
+        ref = _resolve_ref(var, env)
+        if ref is None:
+            return env
+        old = env[ref].get(var.attribute, TOP)
+        new = _narrow_fact(old, op, c)
+        if new is old:
+            return env
+        env = dict(env)
+        env[ref] = dict(env[ref])
+        env[ref][var.attribute] = new
+        return env
+
+    def _lint(self, code: str, message: str, node) -> None:
+        if self.collect:
+            self.lints.append((code, message, node))
+
+
+def _resolve_ref(var: Variable, env: dict) -> Optional[str]:
+    if var.stream_id is not None:
+        return var.stream_id if var.stream_id in env else None
+    hits = [ref for ref, facts in env.items() if var.attribute in facts]
+    return hits[0] if len(hits) == 1 else None
+
+
+def _narrow_fact(old: ValueFact, op: CompareOp, c: Constant) -> ValueFact:
+    v = c.value
+    if isinstance(v, str):
+        if op is CompareOp.EQ:
+            consts = (
+                old.consts & {v} if old.consts is not None else frozenset({v})
+            )
+            return dataclasses.replace(
+                old, consts=consts, card=len(consts), nullable=False
+            )
+        if op is CompareOp.NEQ and old.consts is not None:
+            consts = old.consts - {v}
+            return dataclasses.replace(old, consts=consts, card=len(consts))
+        return old
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return old
+    if old.atype not in _INTEGRAL:
+        # float/unknown domains carry no integer intervals; the exclusive-
+        # bound rounding below would be unsound there (price > 10.0 does
+        # NOT imply price >= 11). A passing comparison still proves
+        # non-null.
+        return old if not old.nullable else dataclasses.replace(
+            old, nullable=False
+        )
+    iv = int(v)
+    lo, hi, consts = old.lo, old.hi, old.consts
+    if op is CompareOp.EQ:
+        lo = iv if lo is None else max(lo, iv)
+        hi = iv if hi is None else min(hi, iv)
+        consts = (
+            consts & {iv} if consts is not None else frozenset({iv})
+        )
+        return dataclasses.replace(
+            old, lo=lo, hi=hi, consts=consts, card=len(consts),
+            nullable=False,
+        )
+    if op is CompareOp.NEQ:
+        if consts is not None:
+            consts = consts - {iv}
+            return dataclasses.replace(old, consts=consts, card=len(consts))
+        return old
+    # order comparisons: integer narrowing (float literals round safely
+    # toward the retained side)
+    if op is CompareOp.GT:
+        bound = int(v) + 1 if float(v).is_integer() else int(-(-v // 1))
+        lo = bound if lo is None else max(lo, bound)
+    elif op is CompareOp.GE:
+        bound = int(-(-v // 1))
+        lo = bound if lo is None else max(lo, bound)
+    elif op is CompareOp.LT:
+        bound = int(v) - 1 if float(v).is_integer() else int(v // 1)
+        hi = bound if hi is None else min(hi, bound)
+    elif op is CompareOp.LE:
+        bound = int(v // 1)
+        hi = bound if hi is None else min(hi, bound)
+    if consts is not None:
+        kept = frozenset(
+            x for x in consts
+            if isinstance(x, int)
+            and (lo is None or x >= lo) and (hi is None or x <= hi)
+        )
+    else:
+        kept = None
+    return dataclasses.replace(
+        old, lo=lo, hi=hi, consts=kept,
+        card=len(kept) if kept is not None else old.card, nullable=False,
+    )
+
+
+def _negate(expr: Expression) -> Optional[Expression]:
+    """Push a NOT one level down (De Morgan / comparison flip)."""
+    if isinstance(expr, Compare):
+        flip = {
+            CompareOp.LT: CompareOp.GE, CompareOp.LE: CompareOp.GT,
+            CompareOp.GT: CompareOp.LE, CompareOp.GE: CompareOp.LT,
+            CompareOp.EQ: CompareOp.NEQ, CompareOp.NEQ: CompareOp.EQ,
+        }
+        return Compare(left=expr.left, op=flip[expr.op], right=expr.right)
+    if isinstance(expr, And):
+        left, right = _negate(expr.left), _negate(expr.right)
+        if left is not None and right is not None:
+            return Or(left=left, right=right)
+    if isinstance(expr, Or):
+        left, right = _negate(expr.left), _negate(expr.right)
+        if left is not None and right is not None:
+            return And(left=left, right=right)
+    if isinstance(expr, Not):
+        return expr.expression
+    return None
+
+
+def _env_join(a: dict, b: dict) -> dict:
+    out: dict = {}
+    for ref in a:
+        if ref not in b:
+            out[ref] = a[ref]
+            continue
+        fa, fb = a[ref], b[ref]
+        merged = {}
+        for attr in fa:
+            if attr in fb:
+                merged[attr] = fact_join(fa[attr], fb[attr])
+            else:
+                merged[attr] = fa[attr]
+        for attr in fb:
+            merged.setdefault(attr, fb[attr])
+        out[ref] = merged
+    for ref in b:
+        out.setdefault(ref, b[ref])
+    return out
+
+
+def _expr_str(expr: Expression) -> str:
+    """Compact deterministic rendering for rewrite notes and lint text."""
+    if isinstance(expr, Constant):
+        return repr(expr.value) if isinstance(expr.value, str) else str(
+            expr.value
+        )
+    if isinstance(expr, Variable):
+        return (
+            f"{expr.stream_id}.{expr.attribute}" if expr.stream_id
+            else expr.attribute
+        )
+    if isinstance(expr, Compare):
+        return (
+            f"{_expr_str(expr.left)} {expr.op.value} {_expr_str(expr.right)}"
+        )
+    if isinstance(expr, And):
+        return f"({_expr_str(expr.left)} and {_expr_str(expr.right)})"
+    if isinstance(expr, Or):
+        return f"({_expr_str(expr.left)} or {_expr_str(expr.right)})"
+    if isinstance(expr, Not):
+        return f"not {_expr_str(expr.expression)}"
+    ops = {Add: "+", Subtract: "-", Multiply: "*", Divide: "/", Mod: "%"}
+    for cls, sym in ops.items():
+        if isinstance(expr, cls):
+            def side(e):
+                s = _expr_str(e)
+                return f"({s})" if isinstance(e, tuple(ops)) else s
+            return f"{side(expr.left)} {sym} {side(expr.right)}"
+    if isinstance(expr, AttributeFunction):
+        args = ", ".join(_expr_str(p) for p in expr.parameters)
+        ns = f"{expr.namespace}:" if expr.namespace else ""
+        return f"{ns}{expr.name}({args})"
+    if isinstance(expr, IsNull):
+        inner = (
+            _expr_str(expr.expression) if expr.expression is not None
+            else str(expr.stream_id)
+        )
+        return f"{inner} is null"
+    return type(expr).__name__
+
+
+# ---------------------------------------------------------------------------
+# the analysis result
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ValueAnalysis:
+    """Fixpoint facts + their consumers' inputs (rewrites, lints)."""
+
+    # stream id -> {attr: ValueFact} — facts at stream ingress
+    stream_facts: dict = dataclasses.field(default_factory=dict)
+    # advisory rewrite notes, deterministic order (plan v3 `rewrites`)
+    rewrites: list = dataclasses.field(default_factory=list)
+    # (code, message, line, col, qid) for SA135-SA137
+    lint_sites: list = dataclasses.field(default_factory=list)
+    # stream id -> attrs no consumer reads (mirrors ingest _compute_keep)
+    dead_columns: dict = dataclasses.field(default_factory=dict)
+    # declared-hint lanes inference could NOT independently prove
+    unprovable: list = dataclasses.field(default_factory=list)
+    rounds: int = 0
+    widened: list = dataclasses.field(default_factory=list)
+
+    def facts_for(self, sid: str) -> dict:
+        return self.stream_facts.get(sid, {})
+
+    def domains_dict(self) -> dict:
+        """{sid: {attr: fact-dict}} with TOP entries omitted; sorted."""
+        out: dict = {}
+        for sid in sorted(self.stream_facts):
+            entries = {
+                attr: fact.to_dict()
+                for attr, fact in sorted(self.stream_facts[sid].items())
+                if not fact.is_top()
+            }
+            if entries:
+                out[sid] = entries
+        return out
+
+
+def _iter_entries(app: SiddhiApp):
+    """(qid, query, partition | None) in execution order — the one shared
+    id walk (query_api assign_execution_ids), like cost.iter_query_entries
+    but keeping the owning partition for inner-stream scoping."""
+    from siddhi_tpu.query_api.execution import assign_execution_ids
+
+    for ent in assign_execution_ids(app):
+        if ent[0] == "query":
+            yield ent[1], ent[2], None
+        else:
+            for qid, q in ent[3]:
+                yield qid, q, ent[1]
+
+
+def _inner_key(pid: Optional[str], name: str) -> str:
+    return f"{pid or '?'}::#{name}"
+
+
+def analyze_values(app: SiddhiApp, sym=None) -> ValueAnalysis:
+    """Run the abstract interpretation to a fixpoint. Pure and total:
+    semantically-bad apps degrade to TOP facts, never exceptions."""
+    from siddhi_tpu.analysis.symbols import build_symbols
+    from siddhi_tpu.core.wire import parse_wire_hints
+
+    if sym is None:
+        sym = build_symbols(app, [])
+    va = ValueAnalysis()
+
+    hints = parse_wire_hints(find_annotation(app.annotations, "app:wire"))
+    entries = list(_iter_entries(app))
+
+    # ---- seed: declared streams start from their external contribution
+    for sid, schema in sym.streams.items():
+        if schema is None or sid.startswith("!"):
+            continue
+        facts = {}
+        for attr, t in schema.items():
+            fact = ValueFact(atype=t)
+            hint = hints.get((sid, attr))
+            if hint is not None and t is not None:
+                if hint[0] == "range" and t in _INTEGRAL:
+                    fact = dataclasses.replace(
+                        fact, lo=int(hint[1]), hi=int(hint[2])
+                    )
+                elif hint[0] == "dict":
+                    fact = dataclasses.replace(fact, card=int(hint[1]))
+                elif hint[0] == "delta" and t in _INTEGRAL:
+                    fact = dataclasses.replace(fact, monotone=True)
+            facts[attr] = fact
+        va.stream_facts[sid] = facts
+
+    # ---- seed: the event-time contract — a LONG/INT attribute consumed as
+    # the time attribute of an external-time window is the stream's event
+    # clock, ordered by contract (PR 14's reorder stage enforces exactly
+    # this); a wrong assumption costs one misfit rebuild, never wrong bytes
+    for _qid, q, _part in entries:
+        for src in _query_sources(q):
+            if src.is_inner or src.is_fault:
+                continue
+            facts = va.stream_facts.get(src.stream_id)
+            if facts is None:
+                continue
+            for h in src.handlers:
+                if not isinstance(h, WindowHandler):
+                    continue
+                w = h.window
+                key = (
+                    w.name.lower() if w.namespace is None
+                    else f"{w.namespace}:{w.name}".lower()
+                )
+                if key not in _EXTERNAL_TIME_WINDOWS or not w.parameters:
+                    continue
+                p0 = w.parameters[0]
+                if isinstance(p0, Variable) and p0.attribute in facts \
+                        and facts[p0.attribute].atype in _INTEGRAL:
+                    facts[p0.attribute] = dataclasses.replace(
+                        facts[p0.attribute], monotone=True
+                    )
+
+    declared = set(va.stream_facts)
+
+    # ---- fixpoint over the insert-into graph
+    join_counts: dict = {}
+    for round_no in range(1, MAX_ROUNDS + 1):
+        va.rounds = round_no
+        changed = False
+        for qid, q, part in entries:
+            target, out_facts = _transfer(q, qid, part, sym, va, declared)
+            if target is None or out_facts is None:
+                continue
+            old = va.stream_facts.get(target)
+            if old is None:
+                va.stream_facts[target] = dict(out_facts)
+                changed = True
+                continue
+            for attr, fact in out_facts.items():
+                prev = old.get(attr)
+                if prev is None:
+                    old[attr] = fact
+                    changed = True
+                    continue
+                new = fact_join(prev, fact)
+                if new == prev:
+                    continue
+                key = (target, attr)
+                join_counts[key] = join_counts.get(key, 0) + 1
+                if join_counts[key] > WIDEN_AFTER:
+                    new = fact_widen(prev, new)
+                    if key not in va.widened:
+                        va.widened.append(key)
+                if new != prev:
+                    old[attr] = new
+                    changed = True
+        if not changed:
+            break
+
+    # ---- final pass: lints + rewrites against the stable facts
+    _collect_notes(app, sym, va, entries, declared)
+    _collect_dead_columns(app, sym, va, entries)
+    _check_declared_agreement(sym, va, hints)
+    return va
+
+
+def _query_sources(q: Query):
+    stream = q.input_stream
+    if isinstance(stream, SingleInputStream):
+        return [stream]
+    if isinstance(stream, JoinInputStream):
+        return [stream.left, stream.right]
+    if isinstance(stream, StateInputStream):
+        return list(iter_state_streams(stream.state))
+    return []
+
+
+def _source_env_entry(
+    src: SingleInputStream, part, sym, va: ValueAnalysis, ev: _Evaluator
+) -> tuple[Optional[dict], Optional[bool]]:
+    """(facts after this source's handler chain, filter truth). None facts
+    = unknown source (table/window/aggregation/open schema): skip."""
+    sid = src.stream_id
+    if src.is_fault:
+        return None, None
+    if src.is_inner:
+        facts = va.stream_facts.get(_inner_key(part_id(part), sid))
+    elif sid in va.stream_facts:
+        facts = va.stream_facts[sid]
+    elif sid in sym.streams or sid in sym.windows:
+        return None, None  # open schema / named window: no facts
+    else:
+        facts = va.stream_facts.get(sid)  # insert-into-only stream
+    if facts is None:
+        return None, None
+    env = {src.ref: dict(facts)}
+    truth: Optional[bool] = None
+    for h in src.handlers:
+        if isinstance(h, Filter):
+            env, t = ev.narrow(h.expression, env)
+            if t is False:
+                truth = False
+            elif truth is None and t is not None:
+                truth = t if truth is None else truth
+        elif isinstance(h, WindowHandler):
+            w = h.window
+            key = (
+                w.name.lower() if w.namespace is None
+                else f"{w.namespace}:{w.name}".lower()
+            )
+            if key not in _ORDER_PRESERVING_WINDOWS:
+                env = {
+                    src.ref: {
+                        a: dataclasses.replace(f, monotone=False)
+                        for a, f in env[src.ref].items()
+                    }
+                }
+        elif isinstance(h, StreamFunctionHandler):
+            return None, truth  # schema may change: facts unknown
+    return env.get(src.ref), truth
+
+
+def part_id(part) -> Optional[str]:
+    # `part` is already the pid string assign_execution_ids handed out
+    return part
+
+
+def _transfer(
+    q: Query, qid: str, part, sym, va: ValueAnalysis, declared: set,
+    ev: Optional[_Evaluator] = None,
+):
+    """(target stream key, output facts) for one query under the current
+    stream facts; (None, None) when the query writes no stream or its
+    sources are unknown."""
+    out_stream = q.output_stream
+    target = getattr(out_stream, "target", None)
+    if ev is None:
+        ev = _Evaluator(qid)
+    env: dict = {}
+    mono_ok = isinstance(q.input_stream, SingleInputStream)
+    for src in _query_sources(q):
+        facts, _t = _source_env_entry(src, part, sym, va, ev)
+        if facts is None:
+            env[src.ref] = {}
+        else:
+            env[src.ref] = facts
+    if not isinstance(q.input_stream, SingleInputStream):
+        # joins/patterns: per-side domains survive, order does not
+        env = {
+            ref: {
+                a: dataclasses.replace(f, monotone=False)
+                for a, f in facts.items()
+            }
+            for ref, facts in env.items()
+        }
+
+    sel = q.selector
+    if sel.group_by or sel.order_by:
+        mono_ok = False
+    if getattr(out_stream, "output_events", None) in (
+        OutputEventsFor.EXPIRED, OutputEventsFor.ALL
+    ):
+        mono_ok = False
+
+    out_facts: dict = {}
+    if sel.select_all or not sel.selection_list:
+        for facts in env.values():
+            for attr, fact in facts.items():
+                out_facts[attr] = fact
+    else:
+        for oa in sel.selection_list:
+            try:
+                name = oa.name
+            except ValueError:
+                continue
+            has_agg = _has_aggregator(oa.expression)
+            fact = ev.eval(oa.expression, env)
+            if has_agg and not isinstance(oa.expression, AttributeFunction):
+                fact = dataclasses.replace(fact, lo=None, hi=None,
+                                           consts=None, card=None)
+            out_facts[name] = fact
+    if not mono_ok:
+        out_facts = {
+            a: dataclasses.replace(f, monotone=False)
+            for a, f in out_facts.items()
+        }
+    if sel.having is not None:
+        henv, _t = ev.narrow(sel.having, {None: out_facts})
+        out_facts = henv.get(None, out_facts)
+
+    if not target:
+        return None, None
+    if target.startswith("!"):
+        return None, None
+    if target in sym.tables or target in sym.windows \
+            or target in sym.aggregations:
+        return None, None
+    if getattr(out_stream, "is_inner", False):
+        return _inner_key(part_id(part), target), out_facts
+    if target in declared:
+        # declared target: external senders already contribute TOP/contract
+        # facts — join the producer's contribution into that floor
+        return target, out_facts
+    return target, out_facts
+
+
+def _has_aggregator(expr: Expression) -> bool:
+    from siddhi_tpu.core.executor import AGGREGATOR_NAMES
+
+    if isinstance(expr, AttributeFunction):
+        if expr.namespace is None and expr.name in AGGREGATOR_NAMES:
+            return True
+        return any(_has_aggregator(p) for p in expr.parameters)
+    for child in ("left", "right", "expression"):
+        c = getattr(expr, child, None)
+        if isinstance(c, Expression) and _has_aggregator(c):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# consumers: lints + rewrites (final pass over stable facts)
+# ---------------------------------------------------------------------------
+
+
+def _collect_notes(
+    app: SiddhiApp, sym, va: ValueAnalysis, entries, declared: set
+) -> None:
+    for qid, q, part in entries:
+        ev = _Evaluator(qid, collect=True)
+        for src in _query_sources(q):
+            sid = src.stream_id
+            if src.is_inner:
+                facts = va.stream_facts.get(_inner_key(part_id(part), sid))
+            else:
+                facts = va.stream_facts.get(sid)
+            if facts is None:
+                continue
+            env = {src.ref: dict(facts)}
+            for h in src.handlers:
+                if not isinstance(h, Filter):
+                    continue
+                ev.decided = []
+                env, truth = ev.narrow(h.expression, env)
+                node = h.expression
+                if truth is False:
+                    va.lint_sites.append((
+                        "SA135",
+                        f"filter '{_expr_str(node)}' on stream '{sid}' is "
+                        "provably false on the proven value domain: the "
+                        "query can never emit",
+                        getattr(node, "line", None),
+                        getattr(node, "col", None), qid,
+                    ))
+                    va.rewrites.append({
+                        "kind": "unreachable-filter", "query": qid,
+                        "stream": sid, "filter": _expr_str(node),
+                    })
+                    continue
+                for t, cnode, label in ev.decided:
+                    va.lint_sites.append((
+                        "SA136",
+                        f"comparison '{label}' is always "
+                        f"{'true' if t else 'false'} on the proven value "
+                        "domain",
+                        getattr(cnode, "line", None),
+                        getattr(cnode, "col", None), qid,
+                    ))
+                    if t:
+                        va.rewrites.append({
+                            "kind": "drop-true-conjunct", "query": qid,
+                            "stream": sid, "conjunct": label,
+                        })
+        # selector: const folds + overflow lints over the full source env
+        env = {}
+        for src in _query_sources(q):
+            facts, _t = _source_env_entry(src, part, sym, va, ev)
+            env[src.ref] = facts if facts is not None else {}
+        for oa in q.selector.selection_list:
+            try:
+                name = oa.name
+            except ValueError:
+                continue
+            if _has_aggregator(oa.expression):
+                continue
+            fact = ev.eval(oa.expression, env)
+            if not isinstance(oa.expression, (Constant, Variable)) \
+                    and fact.consts is not None and len(fact.consts) == 1:
+                va.rewrites.append({
+                    "kind": "const-fold", "query": qid, "attr": name,
+                    "expr": _expr_str(oa.expression),
+                    "value": next(iter(fact.consts)),
+                })
+        if q.selector.having is not None:
+            ev.decided = []
+            _env2, truth = ev.narrow(q.selector.having, {None: {}})
+        for code, message, node in ev.lints:
+            va.lint_sites.append((
+                code, message,
+                getattr(node, "line", None), getattr(node, "col", None),
+                qid,
+            ))
+    va.lint_sites.sort(
+        key=lambda s: (s[4] or "", s[0], s[2] or 0, s[3] or 0, s[1])
+    )
+
+
+def _iter_query_exprs(q: Query):
+    """Every expression a query evaluates, source refs included."""
+    for src in _query_sources(q):
+        for h in src.handlers:
+            if isinstance(h, Filter):
+                yield h.expression
+            elif isinstance(h, WindowHandler):
+                yield from h.window.parameters
+            elif isinstance(h, StreamFunctionHandler):
+                yield from h.parameters
+    stream = q.input_stream
+    if isinstance(stream, JoinInputStream):
+        if stream.on is not None:
+            yield stream.on
+        if stream.within is not None:
+            yield stream.within
+        if stream.per is not None:
+            yield stream.per
+    sel = q.selector
+    for oa in sel.selection_list:
+        yield oa.expression
+    yield from sel.group_by
+    if sel.having is not None:
+        yield sel.having
+    for ob in sel.order_by:
+        yield ob.variable
+
+
+def _mark_used(expr: Expression, by_ref: dict, used: dict) -> None:
+    if isinstance(expr, Variable):
+        if expr.stream_id is not None:
+            sid = by_ref.get(expr.stream_id, expr.stream_id)
+            used.setdefault(sid, set()).add(expr.attribute)
+        else:
+            for sid in by_ref.values():
+                used.setdefault(sid, set()).add(expr.attribute)
+        return
+    if isinstance(expr, AttributeFunction):
+        for p in expr.parameters:
+            _mark_used(p, by_ref, used)
+        return
+    for child in ("left", "right", "expression"):
+        c = getattr(expr, child, None)
+        if isinstance(c, Expression):
+            _mark_used(c, by_ref, used)
+
+
+def _collect_dead_columns(app: SiddhiApp, sym, va, entries) -> None:
+    """Per consumed outer stream: attributes NO consumer reads — the
+    static mirror of the fused ingest's projected wire (`_compute_keep`),
+    surfaced as plan rewrites so the pruning is visible pre-runtime."""
+    used: dict = {}
+    consumed: set = set()
+    keep_all: set = set(sym.sinked)
+    for _qid, q, _part in entries:
+        by_ref = {}
+        for src in _query_sources(q):
+            if src.is_inner or src.is_fault:
+                continue
+            by_ref[src.ref] = src.stream_id
+            consumed.add(src.stream_id)
+            if q.selector.select_all or not q.selector.selection_list:
+                keep_all.add(src.stream_id)
+        for expr in _iter_query_exprs(q):
+            _mark_used(expr, by_ref, used)
+    for elem in app.execution_elements:
+        for pt in getattr(elem, "partition_types", []) or []:
+            consumed.add(pt.stream_id)
+            expr = getattr(pt, "expression", None)
+            if expr is not None:
+                _mark_used(expr, {pt.stream_id: pt.stream_id}, used)
+            for rng in getattr(pt, "ranges", []) or []:
+                _mark_used(
+                    rng.condition, {pt.stream_id: pt.stream_id}, used
+                )
+    for ad in app.aggregation_definitions.values():
+        sid = getattr(getattr(ad, "input", None), "stream_id", None)
+        if sid is not None:
+            keep_all.add(sid)
+    for sid in sorted(consumed):
+        schema = sym.streams.get(sid)
+        if not schema or sid in keep_all:
+            continue
+        dead = [a for a in schema if a not in used.get(sid, set())]
+        if dead:
+            va.dead_columns[sid] = dead
+            va.rewrites.append({
+                "kind": "prune-dead-columns", "stream": sid,
+                "columns": dead,
+            })
+
+
+def _check_declared_agreement(sym, va: ValueAnalysis, hints: dict) -> None:
+    """Every declared `@app:wire` lane must come back from inference at
+    least as narrow (it is seeded from the contract, so normally it does)
+    or be recorded as explicitly unprovable — the agreement contract the
+    sweep test asserts."""
+    inferred = infer_wire_hints(va, sym)
+    for (sid, col), hint in sorted(hints.items()):
+        got = inferred.get((sid, col))
+        if got is None:
+            va.unprovable.append({
+                "stream": sid, "attr": col, "declared": hint[0],
+                "reason": "no fact survives at this lane (open schema or "
+                          "unknown column)",
+            })
+
+
+# ---------------------------------------------------------------------------
+# inferred wire hints
+# ---------------------------------------------------------------------------
+
+
+def infer_wire_hints(va: ValueAnalysis, sym) -> dict:
+    """(stream_id, attr) -> hint tuple in `parse_wire_hints` format, from
+    the proven facts: monotone -> delta int16 (the same default a declared
+    `delta='true'` picks), small constant set / cardinality bound -> dict,
+    bounded interval -> range. One entry per lane, preferring the
+    strongest encoder; `build_wire_spec` applies declared hints first and
+    drops anything that does not undercut the wide lane."""
+    import numpy as np
+
+    out: dict = {}
+    for sid in sorted(va.stream_facts):
+        if "::#" in sid:
+            continue  # partition-inner streams have no junction wire
+        facts = va.stream_facts[sid]
+        for attr in facts:
+            fact = facts[attr]
+            t = fact.atype
+            if t is None:
+                continue
+            if fact.monotone and t in _INTEGRAL:
+                out[(sid, attr)] = ("delta", np.dtype(np.int16))
+                continue
+            card = fact.card
+            if fact.consts is not None:
+                card = len(fact.consts)
+            if card is not None and 1 <= card <= 65536 \
+                    and t in _INTEGRAL + _INTERNED:
+                out[(sid, attr)] = ("dict", max(2, card))
+                continue
+            if t in _INTEGRAL and fact.lo is not None \
+                    and fact.hi is not None:
+                out[(sid, attr)] = ("range", fact.lo, fact.hi)
+    return out
+
+
+def infer_wire_hints_for_app(app: SiddhiApp, sym=None) -> dict:
+    """One-call form for the runtime (`app_runtime._rebuild_fused_ingest`):
+    never raises — inference failure means no overlay, not no wire."""
+    try:
+        from siddhi_tpu.analysis.symbols import build_symbols
+
+        if sym is None:
+            sym = build_symbols(app, [])
+        return infer_wire_hints(analyze_values(app, sym), sym)
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "wire inference failed for app '%s'; declared hints only",
+            getattr(app, "name", "?"), exc_info=True,
+        )
+        return {}
+
+
+def filter_selectivity(pred: Expression, facts: dict) -> Optional[float]:
+    """Interval-overlap refinement of a filter's static selectivity for
+    the cost model (analysis/cost.py): the fraction of each attribute's
+    PROVEN domain the predicate retains, under a uniform-distribution
+    assumption, multiplied across narrowed attributes and clamped to
+    [0.01, 1.0] (0.0 exactly when the filter is provably false). Returns
+    None when no bounded domain narrows — the flat per-operator default
+    then stands."""
+    ev = _Evaluator("sel")
+    env = {"_s": dict(facts)}
+    env2, truth = ev.narrow(pred, env)
+    if truth is False:
+        return 0.0
+    if truth is True:
+        return 1.0
+    after = env2.get("_s", facts)
+    ratio = 1.0
+    narrowed = False
+    for attr, f0 in facts.items():
+        f1 = after.get(attr, f0)
+        if f1 is f0:
+            continue
+        if f0.consts is not None and f1.consts is not None \
+                and len(f1.consts) < len(f0.consts):
+            narrowed = True
+            ratio *= len(f1.consts) / len(f0.consts)
+        elif f0.lo is not None and f0.hi is not None \
+                and f1.lo is not None and f1.hi is not None \
+                and (f1.lo, f1.hi) != (f0.lo, f0.hi):
+            w0 = f0.hi - f0.lo + 1
+            w1 = max(0, f1.hi - f1.lo + 1)
+            if w0 > 0 and w1 < w0:
+                narrowed = True
+                ratio *= w1 / w0
+    if not narrowed:
+        return None
+    return min(1.0, max(0.01, round(ratio, 4)))
+
+
+# ---------------------------------------------------------------------------
+# lint driver (SA135-SA137; SA138 rides cost._check_wire_dominance)
+# ---------------------------------------------------------------------------
+
+
+def check_values(app: SiddhiApp, sym, diags: list, va=None) -> "ValueAnalysis":
+    """Emit the value-analysis lints; returns the analysis for reuse."""
+    from siddhi_tpu.analysis.diagnostics import WARNING, Diagnostic
+
+    if va is None:
+        va = analyze_values(app, sym)
+    for code, message, line, col, qid in va.lint_sites:
+        diags.append(Diagnostic(
+            code, message, line, col, severity=WARNING, query=qid,
+        ))
+    return va

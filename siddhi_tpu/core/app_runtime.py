@@ -1813,21 +1813,34 @@ class SiddhiAppRuntime:
                 "fusion planning failed for app '%s'; falling back to "
                 "per-junction fusion only", self.name, exc_info=True,
             )
-        from siddhi_tpu.core.wire import build_wire_spec
+        from siddhi_tpu.core.wire import (
+            build_wire_spec,
+            wire_inference_enabled,
+        )
 
+        # value-analysis inferred wire hints (analysis/values.py): one
+        # cheap AST pass per rebuild, overlaid under the declared hints
+        # (declared wins per lane). Inference failure degrades to
+        # declared-only, never to no wire.
+        inferred: dict = {}
+        if self._wire_enabled and wire_inference_enabled():
+            from siddhi_tpu.analysis.values import infer_wire_hints_for_app
+
+            inferred = infer_wire_hints_for_app(self.app)
         for j in list(self.junctions.values()):
             sid = j.schema.stream_id
             pipe_on, pipe_depth = self._pipeline_conf.get(
                 sid, resolve_pipeline_annotation(None)
             )
             # analyzer-chosen per-column wire encodings (core/wire.py):
-            # the static spec from declared types + @app:wire hints; None
-            # when nothing is statically encodable (the sampled narrow
-            # wire stands alone) or wire encoding is disabled
+            # the static spec from declared types + @app:wire hints +
+            # inferred overlay; None when nothing is statically encodable
+            # (the sampled narrow wire stands alone) or wire encoding is
+            # disabled
             spec = (
                 build_wire_spec(
                     sid, j.schema.attrs, self._wire_hints,
-                    capacity=j.batch_size,
+                    capacity=j.batch_size, inferred=inferred,
                 )
                 if self._wire_enabled
                 else None

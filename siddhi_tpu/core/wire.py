@@ -59,6 +59,12 @@ from siddhi_tpu.core.types import AttrType, PHYSICAL_DTYPE
 
 WIRE_ENV = "SIDDHI_TPU_WIRE"
 
+# value-analysis inferred encoders (analysis/values.py): default ON; set
+# SIDDHI_TPU_WIRE_INFER=0 to fall back to declared @app:wire hints only.
+# Independent of WIRE_ENV: inference chooses encoders, WIRE_ENV gates
+# whether any encoder runs at all.
+WIRE_INFER_ENV = "SIDDHI_TPU_WIRE_INFER"
+
 WIRE_SPEC_VERSION = 1
 
 _TRUE = ("1", "on", "true", "force")
@@ -87,6 +93,12 @@ def wire_env_override() -> Optional[bool]:
     if v in _FALSE:
         return False
     return None
+
+
+def wire_inference_enabled() -> bool:
+    """Whether inferred wire hints (analysis/values.py) overlay the
+    declared ones. On by default; SIDDHI_TPU_WIRE_INFER=0 disables."""
+    return os.environ.get(WIRE_INFER_ENV, "").strip().lower() not in _FALSE
 
 
 def _parse_range(v) -> Optional[tuple[int, int]]:
@@ -260,9 +272,12 @@ class WireSpec:
     encodings: dict = dataclasses.field(default_factory=dict)
     source: str = "static"
     version: int = WIRE_SPEC_VERSION
+    # lanes whose encoding was PROVEN by value analysis rather than
+    # declared via @app:wire (provenance for the plan + explain())
+    inferred_lanes: list = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "version": self.version,
             "stream": self.stream_id,
             "source": self.source,
@@ -271,6 +286,9 @@ class WireSpec:
                 for lane, e in sorted(self.encodings.items())
             },
         }
+        if self.inferred_lanes:
+            out["inferred_lanes"] = sorted(self.inferred_lanes)
+        return out
 
 
 def encoding_label(entry) -> str:
@@ -290,11 +308,40 @@ def encoding_label(entry) -> str:
     return str(entry)
 
 
+def _hint_entry(hint, t: AttrType, wide: np.dtype) -> Optional[tuple]:
+    """Encoding entry for one hint tuple against one declared type, or
+    None when the hint does not apply / does not shrink the lane."""
+    if hint is None:
+        return None
+    if hint[0] == "range" and t in _INTEGRAL:
+        dt = _narrow_for_range(int(hint[1]), int(hint[2]), wide)
+        if dt is not None:
+            return ("narrow", dt)
+    elif hint[0] == "dict" and t in _INTEGRAL + _INTERNED:
+        card = int(hint[1])
+        code = np.dtype(np.uint8 if card <= 256 else np.uint16)
+        if code.itemsize < wide.itemsize:
+            return ("dict", code, card)
+    elif hint[0] == "delta" and t in _INTEGRAL:
+        dt = np.dtype(hint[1])
+        if dt.itemsize < wide.itemsize:
+            return ("delta", dt)
+    return None
+
+
 def build_wire_spec(
-    stream_id: str, attrs, hints: dict, capacity: Optional[int] = None
+    stream_id: str,
+    attrs,
+    hints: dict,
+    capacity: Optional[int] = None,
+    inferred: Optional[dict] = None,
 ) -> Optional[WireSpec]:
     """Static per-stream spec from declared attribute types + `@app:wire`
-    hints. `attrs` is [(name, AttrType)] (StreamSchema.attrs or the
+    hints, optionally overlaid with value-analysis `inferred` hints (same
+    (sid, col) -> hint-tuple shape; a DECLARED hint wins its lane — the
+    user's contract beats a proof, and both ride the same per-chunk misfit
+    guard, so a wrong proof can only cost a full-width rebuild, never
+    wrong bytes). `attrs` is [(name, AttrType)] (StreamSchema.attrs or the
     analyzer's schema items). With `capacity` (the micro-batch row count
     each chunk amortizes a dictionary/delta header over) an encoding is
     kept only when its amortized bytes/row actually undercut the wide
@@ -303,31 +350,24 @@ def build_wire_spec(
     width), so it is dropped. Returns None when nothing is statically
     encodable (the sampled narrow wire then stands alone)."""
     enc: dict = {}
+    inferred_lanes: list = []
     for name, t in attrs:
         if t is None:
             continue
         wide = np.dtype(PHYSICAL_DTYPE[t])
-        hint = hints.get((stream_id, name))
         entry = None
+        from_inference = False
         if t is AttrType.BOOL:
             # 1 bit/value, lossless, guard-free: on whenever wire
             # encoding is enabled
             entry = ("bitpack",)
-        elif hint is None:
-            continue
-        elif hint[0] == "range" and t in _INTEGRAL:
-            dt = _narrow_for_range(hint[1], hint[2], wide)
-            if dt is not None:
-                entry = ("narrow", dt)
-        elif hint[0] == "dict" and t in _INTEGRAL + _INTERNED:
-            card = int(hint[1])
-            code = np.dtype(np.uint8 if card <= 256 else np.uint16)
-            if code.itemsize < wide.itemsize:
-                entry = ("dict", code, card)
-        elif hint[0] == "delta" and t in _INTEGRAL:
-            dt = np.dtype(hint[1])
-            if dt.itemsize < wide.itemsize:
-                entry = ("delta", dt)
+        else:
+            entry = _hint_entry(hints.get((stream_id, name)), t, wide)
+            if entry is None and inferred is not None:
+                entry = _hint_entry(
+                    inferred.get((stream_id, name)), t, wide
+                )
+                from_inference = entry is not None
         if entry is None:
             continue
         if capacity is not None and lane_bytes_per_row(
@@ -335,16 +375,30 @@ def build_wire_spec(
         ) >= wide.itemsize:
             continue  # net loss at this chunk shape: stay wide
         enc[name] = entry
+        if from_inference:
+            inferred_lanes.append(name)
     if not enc:
         return None
-    return WireSpec(stream_id, enc)
+    declared = [
+        lane for lane in enc
+        if lane not in inferred_lanes and enc[lane][0] != "bitpack"
+    ]
+    source = "static"
+    if inferred_lanes:
+        source = "static+inferred" if declared else "inferred"
+    return WireSpec(
+        stream_id, enc, source=source, inferred_lanes=inferred_lanes
+    )
 
 
-def app_wire_specs(app, sym_streams: dict, stream_ids, capacity: int):
+def app_wire_specs(
+    app, sym_streams: dict, stream_ids, capacity: int,
+    inferred: Optional[dict] = None,
+):
     """(disabled, {sid: (attrs, spec)}) for the given consumed streams —
-    ONE preamble (annotation fetch, disable parse, hint parsing, schema
-    filtering, spec building) shared by the analyzer's SA133 lint
-    (analysis/cost.py) and the FusionPlan wire section
+    ONE preamble (annotation fetch, disable parse, hint parsing, spec
+    building with the optional inferred overlay) shared by the analyzer's
+    SA133/SA138 lint (analysis/cost.py) and the FusionPlan wire section
     (analysis/fusion.py), so hint resolution can never drift between
     them. Streams with open/unknown schemas are skipped."""
     from siddhi_tpu.query_api.annotation import find_annotation
@@ -354,13 +408,17 @@ def app_wire_specs(app, sym_streams: dict, stream_ids, capacity: int):
         ann.element("disable", "false")
     ).strip().lower() == "true"
     hints = parse_wire_hints(ann)
+    if not wire_inference_enabled():
+        inferred = None
     out: dict = {}
     for sid in stream_ids:
         schema = sym_streams.get(sid)
         if not schema or any(t is None for t in schema.values()):
             continue
         attrs = list(schema.items())
-        out[sid] = (attrs, build_wire_spec(sid, attrs, hints, capacity))
+        out[sid] = (
+            attrs, build_wire_spec(sid, attrs, hints, capacity, inferred)
+        )
     return disabled, out
 
 

@@ -163,9 +163,18 @@ def build_plan(app, runtime=None) -> dict:
         from siddhi_tpu.analysis.symbols import build_symbols
 
         _sym = build_symbols(app, [])
-        _model = compute_costs(app, _sym)
+        _values = None
+        try:
+            from siddhi_tpu.analysis.values import analyze_values
+
+            _values = analyze_values(app, _sym)
+        except Exception:
+            _values = None
+        _model = compute_costs(app, _sym, values=_values)
         static_costs = _model.queries
-        fusion_summary = build_fusion_plan(app, _sym, model=_model).summary()
+        fusion_summary = build_fusion_plan(
+            app, _sym, model=_model, values=_values
+        ).summary()
     except Exception:
         pass
 
@@ -600,6 +609,13 @@ def render_text(plan: dict) -> str:
                     f"  blocked: {b['query']} on {b['stream']} "
                     f"({b['hazard']})"
                 )
+        if fusion.get("rewrites"):
+            lines.append("rewrites (value analysis):")
+            for r in fusion["rewrites"]:
+                detail = ", ".join(
+                    f"{k}={v}" for k, v in sorted(r.items()) if k != "kind"
+                )
+                lines.append(f"  {r['kind']}: {detail}")
     churn = plan.get("churn")
     if churn:
         line = (

@@ -51,7 +51,7 @@ select symbol insert into SlowOut;
 
 # the FusionPlan contract for SNAPSHOT_APP (costs asserted separately)
 SNAPSHOT_PLAN = {
-    "version": 2,
+    "version": 3,
     "app": "SiddhiApp",
     "chunk": {"batch_size": 64, "chunk_batches": 32},
     "groups": [
@@ -95,6 +95,13 @@ SNAPSHOT_PLAN = {
             "logical_B_per_ev": 16,
             "encoded_B_per_ev_est": 12,
         }
+    },
+    # v3: value-analysis sections — SNAPSHOT_APP has no provable rewrite,
+    # and the only non-TOP fact is max(price) under the price > 10 filter
+    # (float: narrowed to non-null only, never to an interval)
+    "rewrites": [],
+    "domains": {
+        "MaxOut": {"mx": {"non_null": True}},
     },
 }
 
@@ -146,7 +153,7 @@ class TestPlanSnapshot:
         p.write_text(SNAPSHOT_APP)
         assert lint_main(["--plan", str(p)]) == 0
         out = capsys.readouterr().out
-        assert "FUSION PLAN v2" in out
+        assert "FUSION PLAN v3" in out
         assert "stream S: avg50, max50" in out
         assert "slow on S: scheduler" in out
         assert "shared-state candidates:" in out
@@ -167,7 +174,7 @@ class TestPlanSnapshot:
             bench.WORKLOADS.items()
         ):
             plan = build_fusion_plan(ql).to_dict()
-            assert plan["version"] == 2, name
+            assert plan["version"] == 3, name
             assert plan["costs"]["queries"], name
 
 
@@ -508,4 +515,4 @@ class TestAnalyzeCarriesPlan:
         assert buf.getvalue() == ""
         from siddhi_tpu.analysis.fusion import render_plan_text
 
-        assert "FUSION PLAN v2" in render_plan_text(plan)
+        assert "FUSION PLAN v3" in render_plan_text(plan)

@@ -1,0 +1,543 @@
+"""Abstract-interpretation value analysis (analysis/values.py): the
+lattice, the fixpoint (widening on cyclic insert-into graphs), fact
+propagation through filters/selectors/windows, the SA135-SA138 lints, the
+inferred wire hints that overlay `core/wire.py build_wire_spec`, the
+cost-model selectivity refinement, and end-to-end runtime parity: an
+UN-annotated app whose wire shrinks purely from inference must emit
+byte-identical rows inference-on vs full-width."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from siddhi_tpu.analysis import analyze
+from siddhi_tpu.analysis.symbols import build_symbols
+from siddhi_tpu.analysis.values import (
+    MAX_CONSTS,
+    MAX_ROUNDS,
+    TOP,
+    ValueFact,
+    analyze_values,
+    fact_join,
+    fact_widen,
+    filter_selectivity,
+    infer_wire_hints,
+    infer_wire_hints_for_app,
+)
+from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+from siddhi_tpu.core.types import AttrType
+
+
+def _va(ql: str):
+    app = SiddhiCompiler.parse(ql)
+    sym = build_symbols(app, [])
+    return analyze_values(app, sym), sym
+
+
+# ---------------------------------------------------------------------------
+# lattice
+# ---------------------------------------------------------------------------
+
+
+class TestLattice:
+    def test_join_interval_hull(self):
+        a = ValueFact(lo=0, hi=10, nullable=False)
+        b = ValueFact(lo=5, hi=20, nullable=True)
+        j = fact_join(a, b)
+        assert (j.lo, j.hi) == (0, 20)
+        assert j.nullable is True  # nullable ORs
+
+    def test_join_open_bound_absorbs(self):
+        a = ValueFact(lo=0, hi=10)
+        j = fact_join(a, ValueFact(lo=None, hi=5))
+        assert j.lo is None and j.hi == 10
+
+    def test_join_consts_union_and_cap(self):
+        a = ValueFact(consts=frozenset(range(10)))
+        b = ValueFact(consts=frozenset(range(5, 15)))
+        assert fact_join(a, b).consts == frozenset(range(15))
+        big = ValueFact(consts=frozenset(range(MAX_CONSTS)))
+        other = ValueFact(consts=frozenset(range(MAX_CONSTS, 2 * MAX_CONSTS)))
+        assert fact_join(big, other).consts is None  # cap collapses
+
+    def test_join_monotone_ands(self):
+        m = ValueFact(monotone=True)
+        assert fact_join(m, m).monotone is True
+        assert fact_join(m, TOP).monotone is False
+
+    def test_widen_opens_moving_bounds(self):
+        old = ValueFact(lo=0, hi=10)
+        grown = ValueFact(lo=0, hi=12)
+        w = fact_widen(old, grown)
+        assert w.lo == 0 and w.hi is None  # still-moving hi opens
+        stable = fact_widen(old, ValueFact(lo=0, hi=10))
+        assert (stable.lo, stable.hi) == (0, 10)
+
+    def test_contradiction(self):
+        assert ValueFact(lo=5, hi=4).contradiction()
+        assert ValueFact(consts=frozenset()).contradiction()
+        assert not ValueFact(lo=4, hi=4).contradiction()
+
+    def test_to_dict_omits_top_fields(self):
+        assert TOP.to_dict() == {}
+        d = ValueFact(lo=1, hi=2, nullable=False, monotone=True).to_dict()
+        assert d == {"interval": [1, 2], "non_null": True, "monotone": True}
+
+
+# ---------------------------------------------------------------------------
+# fixpoint + widening
+# ---------------------------------------------------------------------------
+
+
+CYCLE_APP = """
+define stream Seed (x int);
+@info(name='seed') from Seed[x > 0 and x < 10] select x insert into Loop;
+@info(name='grow') from Loop select x + 1 as x insert into Loop;
+"""
+
+
+class TestFixpoint:
+    def test_cycle_terminates_via_widening(self):
+        va, _sym = _va(CYCLE_APP)
+        assert va.rounds < MAX_ROUNDS
+        assert ("Loop", "x") in va.widened
+        f = va.facts_for("Loop")["x"]
+        assert f.hi is None  # the growing bound opened
+        assert f.nullable is False  # non-null survives the cycle
+
+    def test_analysis_is_deterministic(self):
+        va1, _ = _va(CYCLE_APP)
+        va2, _ = _va(CYCLE_APP)
+        assert va1.domains_dict() == va2.domains_dict()
+        assert va1.rewrites == va2.rewrites
+        assert va1.lint_sites == va2.lint_sites
+
+
+# ---------------------------------------------------------------------------
+# propagation
+# ---------------------------------------------------------------------------
+
+
+class TestPropagation:
+    def test_filter_interval_through_insert_into(self):
+        va, _ = _va("""
+        define stream Orders (sym string, price int);
+        from Orders[price > 10 and price < 500]
+        select sym, price insert into Mid;
+        """)
+        f = va.facts_for("Mid")["price"]
+        assert (f.lo, f.hi) == (11, 499)
+        assert f.nullable is False
+
+    def test_declared_range_seeds_interval(self):
+        va, _ = _va("""
+        @app:wire(range.S.qty='0..30000')
+        define stream S (qty long);
+        from S select qty insert into Out;
+        """)
+        f = va.facts_for("S")["qty"]
+        assert (f.lo, f.hi) == (0, 30000)
+        assert va.facts_for("Out")["qty"].hi == 30000
+
+    def test_declared_dict_seeds_cardinality(self):
+        va, _ = _va("""
+        @app:wire(dict.S.sym='64')
+        define stream S (sym string);
+        from S select sym insert into Out;
+        """)
+        assert va.facts_for("S")["sym"].card == 64
+
+    def test_float_narrows_nullability_only(self):
+        # exclusive-bound integer rounding is UNSOUND on floats: a filter
+        # over a float attr must never manufacture an interval
+        va, _ = _va("""
+        define stream S (price float);
+        from S[price > 10 and price < 5] select price insert into Out;
+        """)
+        f = va.facts_for("Out")["price"]
+        assert f.lo is None and f.hi is None
+        assert f.nullable is False
+        # ... and the impossible-float-filter app carries NO SA135
+        r = analyze("""
+        define stream S (price float);
+        from S[price > 10 and price < 5] select price insert into Out;
+        """)
+        assert not [d for d in r.warnings if d.code == "SA135"]
+
+    def test_external_time_consumer_proves_monotone(self):
+        va, _ = _va("""
+        define stream Ticks (seq long, v int);
+        from Ticks#window.externalTimeBatch(seq, 1000)
+        select seq, v insert into Out;
+        """)
+        assert va.facts_for("Ticks")["seq"].monotone is True
+        assert va.facts_for("Out")["seq"].monotone is True
+
+    def test_group_by_kills_monotone(self):
+        va, _ = _va("""
+        define stream Ticks (seq long, v int);
+        from Ticks#window.externalTimeBatch(seq, 1000)
+        select seq, sum(v) as s group by seq insert into G;
+        """)
+        assert va.facts_for("G")["seq"].monotone is False
+
+    def test_join_kills_monotone(self):
+        va, _ = _va("""
+        define stream A (seq long);
+        define stream B (seq long);
+        from A#window.externalTime(seq, 1000) select seq insert into MA;
+        from MA#window.length(4) join B#window.length(4) on MA.seq == B.seq
+        select MA.seq as seq insert into J;
+        """)
+        assert va.facts_for("MA")["seq"].monotone is True
+        assert va.facts_for("J")["seq"].monotone is False
+
+    def test_count_aggregator_fact(self):
+        va, _ = _va("""
+        define stream S (v int);
+        from S#window.lengthBatch(8) select count() as c insert into Out;
+        """)
+        f = va.facts_for("Out")["c"]
+        assert f.lo == 0 and f.nullable is False
+
+
+# ---------------------------------------------------------------------------
+# lints SA135-SA138
+# ---------------------------------------------------------------------------
+
+
+class TestLints:
+    def test_sa135_location_and_severity(self):
+        r = analyze(
+            "define stream O (p int);\n"
+            "from O[p > 10 and p < 5] select p insert into Out;\n"
+        )
+        (d,) = [d for d in r.diagnostics if d.code == "SA135"]
+        assert d.severity == "warning"
+        assert (d.line, d.col) == (2, 15)
+
+    def test_sa136_on_decided_disjunct(self):
+        r = analyze(
+            "@app:wire(range.R.status='0..3')\n"
+            "define stream R (status int, size int);\n"
+            "from R[status == 7 or size > 0] select size insert into Out;\n"
+        )
+        (d,) = [d for d in r.diagnostics if d.code == "SA136"]
+        assert "status == 7" in d.message and "always false" in d.message
+
+    def test_sa137_overflow_and_div_by_zero(self):
+        r = analyze(
+            "@app:wire(range.M.a='0..2000000')\n"
+            "define stream M (a int);\n"
+            "from M select a * a as sq, 1 / (a - a) as bad insert into Out;\n"
+        )
+        codes = [d.code for d in r.diagnostics]
+        assert codes.count("SA137") == 2
+
+    def test_sa137_silent_on_unbounded(self):
+        r = analyze(
+            "define stream M (a int);\n"
+            "from M select a * a as sq insert into Out;\n"
+        )
+        assert not [d for d in r.diagnostics if d.code == "SA137"]
+
+    def test_sa133_downgrades_to_sa138_when_provable(self):
+        # UN-provable dominant LONG: the actionable-annotation lint stays
+        unprovable = analyze(
+            "define stream Meters (seq long);\n"
+            "from Meters[seq > 0] select seq insert into Out;\n"
+        )
+        assert [d.code for d in unprovable.warnings] == ["SA133"]
+        # provably monotone via its externalTime consumer: SA138 instead
+        provable = analyze(
+            "define stream Ticks (seq long);\n"
+            "from Ticks#window.externalTime(seq, 1000) "
+            "select seq insert into Out;\n"
+        )
+        assert [d.code for d in provable.warnings] == ["SA138"]
+        (d,) = provable.warnings
+        assert "monotone" in d.message and "no annotation" in d.message
+
+
+# ---------------------------------------------------------------------------
+# inferred wire hints
+# ---------------------------------------------------------------------------
+
+
+class TestInferWireHints:
+    def test_monotone_gives_delta(self):
+        va, sym = _va("""
+        define stream Ticks (seq long);
+        from Ticks#window.externalTime(seq, 1000) select seq insert into Out;
+        """)
+        hints = infer_wire_hints(va, sym)
+        assert hints[("Ticks", "seq")] == ("delta", np.dtype(np.int16))
+
+    def test_const_set_gives_dict(self):
+        va, sym = _va("""
+        define stream S (status int);
+        from S[status == 1 or status == 2] select status insert into T;
+        """)
+        hints = infer_wire_hints(va, sym)
+        assert hints[("T", "status")] == ("dict", 2)
+
+    def test_bounded_interval_gives_range(self):
+        va, sym = _va("""
+        define stream S (qty int);
+        from S[qty >= 0 and qty <= 30000] select qty insert into T;
+        """)
+        hints = infer_wire_hints(va, sym)
+        assert hints[("T", "qty")] == ("range", 0, 30000)
+
+    def test_for_app_never_raises(self):
+        # unknown stream: analysis still returns (empty or partial), no throw
+        app = SiddhiCompiler.parse(
+            "define stream S (a int);\n"
+            "from Missing select a insert into Out;\n"
+        )
+        assert isinstance(infer_wire_hints_for_app(app), dict)
+
+
+# ---------------------------------------------------------------------------
+# selectivity refinement
+# ---------------------------------------------------------------------------
+
+
+class TestFilterSelectivity:
+    def _pred(self, ql_pred: str):
+        app = SiddhiCompiler.parse(
+            "define stream S (x int, y float);\n"
+            f"from S[{ql_pred}] select x insert into Out;\n"
+        )
+        q = app.execution_elements[0]
+        return q.input_stream.handlers[0].expression
+
+    def test_interval_overlap_ratio(self):
+        facts = {"x": ValueFact(lo=0, hi=99, atype=AttrType.INT)}
+        sel = filter_selectivity(self._pred("x < 50"), facts)
+        assert sel == 0.5
+
+    def test_provably_false_is_zero(self):
+        facts = {"x": ValueFact(lo=0, hi=9, atype=AttrType.INT)}
+        assert filter_selectivity(self._pred("x > 100"), facts) == 0.0
+
+    def test_unbounded_returns_none(self):
+        assert filter_selectivity(self._pred("x < 50"), {"x": TOP}) is None
+
+    def test_cost_model_consumes_intervals(self):
+        from siddhi_tpu.analysis.cost import compute_costs
+
+        ql = """
+        @app:wire(range.S.x='0..99')
+        define stream S (x int);
+        @info(name='q') from S[x < 50]#window.length(8)
+        select x insert into Out;
+        """
+        app = SiddhiCompiler.parse(ql)
+        sym = build_symbols(app, [])
+        va = analyze_values(app, sym)
+        with_facts = compute_costs(app, sym, values=va)
+        without = compute_costs(app, sym)
+        q1 = with_facts.queries["q"].est_selectivity
+        q0 = without.queries["q"].est_selectivity
+        assert q1 != q0  # the interval overlap refined the flat default
+        # filter factor 0.5 (50 of [0,99]) x sliding-window 2.0, vs the
+        # flat 0.25 default
+        assert q1 == 1.0 and q0 == 0.5
+
+
+# ---------------------------------------------------------------------------
+# wire-spec overlay (core/wire.py)
+# ---------------------------------------------------------------------------
+
+
+class TestWireSpecOverlay:
+    def test_inferred_fills_unhinted_lane_declared_wins(self):
+        from siddhi_tpu.core.wire import build_wire_spec
+
+        attrs = [("seq", AttrType.LONG), ("qty", AttrType.LONG)]
+        declared = {("S", "qty"): ("range", 0, 100)}
+        inferred = {
+            ("S", "seq"): ("delta", np.dtype(np.int16)),
+            ("S", "qty"): ("range", 0, 10**9),  # must NOT override declared
+        }
+        spec = build_wire_spec("S", attrs, declared, 64, inferred)
+        assert spec.encodings["seq"][0] == "delta"
+        assert spec.encodings["qty"] == ("narrow", np.dtype(np.int8))
+        assert spec.inferred_lanes == ["seq"]
+        assert spec.source == "static+inferred"
+        assert sorted(spec.to_dict()["inferred_lanes"]) == ["seq"]
+
+    def test_pure_inference_source_label(self):
+        from siddhi_tpu.core.wire import build_wire_spec
+
+        spec = build_wire_spec(
+            "S", [("seq", AttrType.LONG)], {}, 64,
+            {("S", "seq"): ("delta", np.dtype(np.int16))},
+        )
+        assert spec.source == "inferred"
+
+    def test_env_kill_switch(self, monkeypatch):
+        from siddhi_tpu.core import wire as W
+
+        monkeypatch.setenv(W.WIRE_INFER_ENV, "0")
+        assert not W.wire_inference_enabled()
+        app = SiddhiCompiler.parse("""
+        define stream Ticks (seq long);
+        from Ticks#window.externalTime(seq, 1000) select seq insert into Out;
+        """)
+        sym = build_symbols(app, [])
+        va = analyze_values(app, sym)
+        _dis, specs = W.app_wire_specs(
+            app, sym.streams, ["Ticks"], 64,
+            inferred=infer_wire_hints(va, sym),
+        )
+        _attrs, spec = specs["Ticks"]
+        # inference off + no declared hints: nothing statically encodable
+        assert spec is None
+
+
+# ---------------------------------------------------------------------------
+# declared-vs-inferred agreement sweep
+# ---------------------------------------------------------------------------
+
+
+CORPUS = sorted(glob.glob(os.path.join(
+    os.path.dirname(__file__), "analysis_corpus", "*.siddhi"
+)))
+
+
+class TestAgreementSweep:
+    @pytest.mark.parametrize(
+        "path", CORPUS, ids=[os.path.basename(p)[:-7] for p in CORPUS]
+    )
+    def test_declared_lanes_inferred_or_explicitly_unprovable(self, path):
+        from siddhi_tpu.core.wire import parse_wire_hints
+        from siddhi_tpu.query_api.annotation import find_annotation
+
+        try:
+            app = SiddhiCompiler.parse(open(path).read())
+        except Exception:
+            pytest.skip("corpus app does not parse")
+        hints = parse_wire_hints(find_annotation(app.annotations, "app:wire"))
+        sym = build_symbols(app, [])
+        va = analyze_values(app, sym)
+        inferred = infer_wire_hints(va, sym)
+        unprovable = {(u["stream"], u["attr"]) for u in va.unprovable}
+        for (sid, col), _hint in hints.items():
+            assert (sid, col) in inferred or (sid, col) in unprovable, (
+                f"{path}: declared lane {sid}.{col} neither re-inferred "
+                f"nor recorded unprovable"
+            )
+
+
+# ---------------------------------------------------------------------------
+# plan + rewrites integration
+# ---------------------------------------------------------------------------
+
+
+class TestPlanIntegration:
+    def test_dead_column_prune_rewrite(self):
+        from siddhi_tpu.analysis import build_fusion_plan
+
+        plan = build_fusion_plan("""
+        define stream S (a int, b int, c int);
+        from S[a > 0] select a insert into Out;
+        """).to_dict()
+        (prune,) = [
+            r for r in plan["rewrites"] if r["kind"] == "prune-dead-columns"
+        ]
+        assert prune["stream"] == "S"
+        assert prune["columns"] == ["b", "c"]
+        assert plan["wire"]["S"]["pruned"] == ["b", "c"]
+
+    def test_plan_json_byte_stable(self):
+        from siddhi_tpu.analysis import build_fusion_plan
+
+        ql = """
+        @app:wire(range.S.qty='0..30000')
+        define stream S (sym string, qty long);
+        from S[qty > 10 and qty > 5] select sym, qty insert into Mid;
+        from Mid select sym insert into Out;
+        """
+        assert build_fusion_plan(ql).to_json() == build_fusion_plan(
+            ql
+        ).to_json()
+
+    def test_explain_carries_rewrites(self):
+        from siddhi_tpu.observability.explain import explain_static
+
+        app = SiddhiCompiler.parse(
+            "define stream O (p int);\n"
+            "from O[p > 10 and p < 5] select p insert into Out;\n"
+        )
+        plan = explain_static(app, fmt="dict")
+        kinds = {r["kind"] for r in plan["fusion"]["rewrites"]}
+        assert "unreachable-filter" in kinds
+        assert "rewrites (value analysis):" in explain_static(app)
+
+
+# ---------------------------------------------------------------------------
+# runtime parity: inference-on vs full-width, un-annotated app
+# ---------------------------------------------------------------------------
+
+
+INFER_APP = """
+define stream Meters (seq long, v float);
+@info(name='q') from Meters#window.externalTimeBatch(seq, 64)
+select seq, v insert into Out;
+"""
+
+
+def _run_infer(env: dict, n=512):
+    from siddhi_tpu import SiddhiManager
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("@app:batch(size='64')\n" + INFER_APP)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    rows = []
+    rt.add_callback("q", lambda t, ins, rem: rows.extend(
+        tuple(e.data) for e in (ins or [])
+    ))
+    rt.start()
+    ts = np.arange(n, dtype=np.int64) + 1_700_000_000_000
+    cols = {
+        "seq": np.arange(n, dtype=np.int64) + 10**12,
+        "v": np.linspace(0, 10, n).astype(np.float32),
+    }
+    rt.get_input_handler("Meters").send_columns(ts, cols, now=int(ts[-1]))
+    fi = rt.junctions["Meters"].fused_ingest
+    wire_bytes = fi._wire_bytes if fi else None
+    rt.shutdown()
+    mgr.shutdown()
+    return rows, wire_bytes
+
+
+class TestRuntimeParity:
+    def test_unannotated_inference_parity_and_shrink(self):
+        on_rows, on_bytes = _run_infer(
+            {"SIDDHI_TPU_WIRE": "1", "SIDDHI_TPU_WIRE_INFER": "1"}
+        )
+        off_rows, off_bytes = _run_infer({"SIDDHI_TPU_WIRE": "0"})
+        assert on_rows == off_rows and on_rows
+        assert on_bytes is not None and off_bytes is not None
+        assert on_bytes < off_bytes  # the wire shrank with ZERO annotations
+
+    def test_infer_kill_switch_still_parity(self):
+        on_rows, _ = _run_infer(
+            {"SIDDHI_TPU_WIRE": "1", "SIDDHI_TPU_WIRE_INFER": "0"}
+        )
+        off_rows, _ = _run_infer({"SIDDHI_TPU_WIRE": "0"})
+        assert on_rows == off_rows and on_rows

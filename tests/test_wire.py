@@ -391,7 +391,7 @@ class TestPlanWireSection:
         from siddhi_tpu.analysis import build_fusion_plan
 
         plan = build_fusion_plan(WIRE_APP).to_dict()
-        assert plan["version"] == 2
+        assert plan["version"] == 3
         w = plan["wire"]["S"]
         assert w["version"] == W.WIRE_SPEC_VERSION
         assert w["encodings"]["symbol"] == "dict:uint8[16]"

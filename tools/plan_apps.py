@@ -49,6 +49,10 @@ def main() -> int:
     # the timebudget leg's multi-query fused-group app: the one bench app
     # whose plan actually FORMS a group (the headline legs are single-query)
     jobs.append(("bench_fusedgroup", bench.FUSED_GROUP_QL))
+    # the wire leg's A/B apps — their plans carry the inferred wire lanes
+    # and value domains the `--leg wire` inference assertions rely on
+    for name, (ql, _stream) in sorted(bench.WIRE_WORKLOADS.items()):
+        jobs.append((f"bench_{name}", ql))
 
     failures = 0
     index = []
@@ -67,11 +71,15 @@ def main() -> int:
             "groups": len(plan["groups"]),
             "blockers": len(plan["blockers"]),
             "shared_state": len(plan["shared_state"]),
+            "rewrites": len(plan["rewrites"]),
+            "domains": len(plan["domains"]),
         })
         print(
             f"{name}: {len(plan['groups'])} group(s), "
             f"{len(plan['blockers'])} blocker(s), "
-            f"{len(plan['shared_state'])} shared-state candidate(s)"
+            f"{len(plan['shared_state'])} shared-state candidate(s), "
+            f"{len(plan['rewrites'])} rewrite(s), "
+            f"{len(plan['domains'])} stream(s) with domains"
         )
     with open(os.path.join(args.out, "index.json"), "w") as f:
         json.dump(index, f, indent=2)
