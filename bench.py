@@ -91,11 +91,23 @@ def _truth_sync(rt):
 def _snapshot_status(rt):
     """Steady-state engine shape at the end of a leg (runtime.snapshot_status
     per the observability layer), stashed into the detail blob. Guarded: a
-    snapshot failure must never fail a leg."""
+    snapshot failure must never fail a leg. Statistics-armed legs also
+    persist the plan-vs-actual calibration blob + the roofline split so
+    tools/calib_report.py can diff two runs' prediction errors."""
     try:
-        return rt.snapshot_status()
+        status = rt.snapshot_status()
     except Exception:
         return None
+    try:
+        rep = rt.calibration_report()
+        if rep is not None:
+            status["calibration"] = rep
+        sm = rt.statistics_manager
+        if sm is not None:
+            status["roofline"] = sm.roofline()
+    except Exception:
+        pass
+    return status
 
 
 _LAST_STATUS: list = [None]  # snapshot of the most recent _run_workload leg
@@ -411,6 +423,65 @@ def _leg_p99(batch=256, batches=96) -> dict:
         }
     if status is not None:
         out["p99_status"] = status
+    return out
+
+
+def _leg_calibration(batch=256, chunks=6) -> dict:
+    """Plan-vs-actual calibration sentinel (`--leg calibration`): a fused
+    app shaped to exercise every prediction kind the ledger pairs —
+    shared filter+window queries (selectivity, state bytes, dispatch
+    reduction), a declared dict wire lane plus an inferred delta lane
+    (both wire B/ev kinds), compiling under the fused group (compiles).
+    The full calibration blob lands in the detail JSON; the CI sentinel
+    asserts all six kinds pair and tools/calib_report.py diffs the blob
+    against the committed baseline to catch prediction-error drift."""
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(f"""@app:statistics(reporter='none')
+    @app:batch(size='{batch}')
+    @app:wire(dict.S.symbol='64')
+    define stream S (symbol string, price float, volume long);
+    @info(name='q1') from S[price > 50.0]#window.length(16)
+    select symbol, price insert into Out1;
+    @info(name='q2') from S[price > 50.0]#window.length(16)
+    select symbol, max(price) as mp insert into Out2;
+    @info(name='q3') from S#window.externalTimeBatch(volume, 1000)
+    select symbol, sum(price) as sp insert into Out3;
+    """)
+    delivered = [0]
+    for q in ("q1", "q2", "q3"):
+        rt.add_callback(
+            q,
+            lambda ts, ins, rem, _d=delivered: _d.__setitem__(
+                0, _d[0] + len(ins or ()) + len(rem or ())
+            ),
+        )
+    rt.start()
+    for s in ("A", "B", "C", "D"):
+        mgr.interner.intern(s)
+    n = batch * 4
+    rng = np.random.default_rng(7)
+    cols = {
+        "symbol": rng.integers(1, 5, n).astype(np.int32),
+        "price": rng.uniform(0, 100, n).astype(np.float32),
+        "volume": (np.arange(n, dtype=np.int64) * 7) % 2000,
+    }
+    ts = np.arange(n, dtype=np.int64) + 1_700_000_000_000
+    h = rt.get_input_handler("S")
+    for k in range(chunks):
+        h.send_columns(ts + k * n, cols, now=int(ts[-1] + k * n))
+    _truth_sync(rt)
+    rep = rt.calibration_report()
+    status = _snapshot_status(rt)
+    rt.shutdown()
+    mgr.shutdown()
+    out: dict = {"calibration_delivered_rows": delivered[0]}
+    if rep is not None:
+        out["calibration"] = rep
+        out["calibration_kinds"] = rep.get("kinds_paired", [])
+    if status is not None and "roofline" in status:
+        out["calibration_roofline"] = status["roofline"]
     return out
 
 
@@ -1321,6 +1392,8 @@ def _run_leg(name: str, args) -> dict:
         return _leg_p99()
     if name == "timebudget":
         return _leg_timebudget(args.batch)
+    if name == "calibration":
+        return _leg_calibration()
     if name == "verify_cases":
         return _leg_verify()
     if name == "disorder":
@@ -1485,7 +1558,7 @@ def main():
     legs = list(WORKLOADS) + [
         "filter_window_avg_delivered", "pattern_2state_delivered",
         "tumbling_groupby_delivered", "p99", "tables", "wire", "timebudget",
-        "disorder", "verify",
+        "calibration", "disorder", "verify",
     ]
     if args.shard:
         legs.append("shard")

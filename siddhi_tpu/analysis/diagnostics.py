@@ -91,6 +91,9 @@ CODES: dict[str, str] = {
              "bounds/cardinality/monotonicity are PROVEN by value "
              "analysis, so wire inference compacts it with no annotation "
              "(informational successor to SA133; warning)",
+    "SA139": "malformed @app:slo annotation: unknown option, invalid "
+             "objective/window/burn threshold, no objective at all, or a "
+             "user definition of the reserved SloAlertStream",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

@@ -239,6 +239,32 @@ def _apply_selfmon_annotation(
         sym.sourced.add(SELFMON_STREAM_ID)  # engine-fed, never query-fed
 
 
+def _apply_slo_annotation(
+    app: SiddhiApp, sym: SymbolTable, diags: list[Diagnostic]
+) -> None:
+    """`@app:slo(p99.latency.ms='...', ...)`: validate (SA139, same rule
+    set as the runtime resolver — observability/slo.py) and inject the
+    engine-fed `SloAlertStream` system definition so alert subscribers
+    resolve — the selfmon precedent."""
+    ann = find_annotation(app.annotations, "app:slo")
+    if ann is None:
+        return
+    from siddhi_tpu.observability.slo import (
+        SLO_STREAM_ID,
+        iter_slo_annotation_problems,
+        slo_attrs,
+    )
+
+    problems = list(iter_slo_annotation_problems(
+        ann, defined_streams=app.stream_definitions
+    ))
+    for problem in problems:
+        diags.append(Diagnostic("SA139", problem))
+    if SLO_STREAM_ID not in sym.streams:
+        sym.streams[SLO_STREAM_ID] = dict(slo_attrs())
+        sym.sourced.add(SLO_STREAM_ID)  # engine-fed, never query-fed
+
+
 def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
     sym = SymbolTable()
 
@@ -356,6 +382,7 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
         sym.aggregation_defs[aid] = adef
 
     _apply_selfmon_annotation(app, sym, diags)
+    _apply_slo_annotation(app, sym, diags)
     _check_fuse_annotation(app, diags)
     _check_shard_annotation(app, diags)
     _check_lineage_annotation(app, diags)

@@ -394,6 +394,47 @@ class SiddhiAppRuntime:
             )
             self._selfmon = SelfMonitor(self, interval_ms)
 
+        # @app:slo(p99.latency.ms=..., ...): SLO burn-rate engine — inject
+        # the SloAlertStream system schema (same runtime-side-only contract
+        # as selfmon) and build the scheduler-fed evaluator armed at
+        # start() (observability/slo.py)
+        self._slo = None
+        slo_ann = find_annotation(app.annotations, "app:slo")
+        if slo_ann is not None:
+            from siddhi_tpu.observability.slo import (
+                SLO_STREAM_ID,
+                SloEngine,
+                resolve_slo_annotation,
+                slo_attrs,
+            )
+
+            slo_cfg = resolve_slo_annotation(
+                slo_ann, defined_streams=app.stream_definitions
+            )
+            self.stream_schemas[SLO_STREAM_ID] = StreamSchema(
+                SLO_STREAM_ID, slo_attrs()
+            )
+            self._slo = SloEngine(self, slo_cfg)
+            if self.statistics_manager is not None:
+                self.statistics_manager.register_slo(
+                    self._slo.prometheus_section
+                )
+
+        # plan-vs-actual calibration ledger: pairs static predictions with
+        # live meters (observability/calibration.py). Gated on
+        # @app:statistics — without it no ledger exists and every hot-path
+        # touchpoint is one `is None` check (the zero-overhead contract)
+        self._calibration = None
+        if self.statistics_manager is not None:
+            from siddhi_tpu.observability.calibration import (
+                CalibrationLedger,
+            )
+
+            self._calibration = CalibrationLedger(self)
+            self.statistics_manager.register_calibration(
+                self._calibration.prometheus_section
+            )
+
         for sid, action in self.on_error_actions.items():
             j = self._junction(sid)
             j.fault_policy = action
@@ -1581,6 +1622,21 @@ class SiddhiAppRuntime:
             rep["shard"] = self._shard.describe_state()
         return rep
 
+    def calibration_report(self):
+        """Plan-vs-actual calibration ledger: every static prediction
+        paired with its live meter, error ratios + EWMA drift, mispricing
+        flags (`/calibration` payload, observability/calibration.py); None
+        without `@app:statistics` (the zero-overhead gate)."""
+        c = self._calibration
+        return c.report() if c is not None else None
+
+    def slo_report(self):
+        """Multi-window SLO burn rates for this app's `@app:slo`
+        objectives (`/slo` payload, observability/slo.py); None without
+        the annotation."""
+        s = self._slo
+        return s.report() if s is not None else None
+
     # ---- state introspection (observability/introspect.py) ----------------
 
     def snapshot_status(self) -> dict:
@@ -1628,6 +1684,10 @@ class SiddhiAppRuntime:
             status["shard"] = self._shard.describe_state()
         if self._selfmon is not None:
             status["selfmon"] = self._selfmon.describe_state()
+        if self._slo is not None:
+            status["slo"] = self._slo.describe_state()
+        if self._calibration is not None:
+            status["calibration"] = self._calibration.describe_state()
         if self._admission is not None:
             status["admission"] = self._admission.describe_state()
         if self._autopersist is not None:
@@ -1863,6 +1923,12 @@ class SiddhiAppRuntime:
                 )
         if self._shard is not None:
             self._shard.rearm_routers()
+        # re-pair the calibration ledger against the AST that just formed
+        # these engines: churn splices and fused re-formations re-price
+        # automatically while cumulative mispriced counters survive (the
+        # rearm_routers precedent — rebuild-owned re-arming)
+        if self._calibration is not None:
+            self._calibration.pair()
 
     def _teardown_fused_ingest(self) -> None:
         """Disable and close every fused ingest engine, splitting any
@@ -1951,6 +2017,11 @@ class SiddhiAppRuntime:
                 sm.register_memory(
                     f"aggregation.{aid}", _tree_bytes(lambda _a=ar: _a.state)
                 )
+            # pair the calibration ledger at start when no fused rebuild
+            # already did (fuse disabled or no fusable junctions)
+            if self._calibration is not None and \
+                    self._calibration.generation == 0:
+                self._calibration.pair()
             sm.start_reporting()
             if str(sm.reporter).lower() == "prometheus":
                 # pull-based exposition: serve every app on this manager
@@ -1987,6 +2058,13 @@ class SiddhiAppRuntime:
 
             self._junction(SELFMON_STREAM_ID)
             self._selfmon.start()
+        # SLO burn-rate evaluation (observability/slo.py): same junction
+        # materialization + recurring-target contract as selfmon
+        if self._slo is not None:
+            from siddhi_tpu.observability.slo import SLO_STREAM_ID
+
+            self._junction(SLO_STREAM_ID)
+            self._slo.start()
         # @app:persist auto-checkpoint (core/supervision.AutoPersist): armed
         # only when a persistence store is actually wired — a missing store
         # would otherwise fail EVERY interval until someone noticed

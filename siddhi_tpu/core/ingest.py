@@ -1452,6 +1452,16 @@ class FusedJunctionIngest:
         from siddhi_tpu.query_api.execution import OutputEventsFor
 
         qr = self.endpoints[i].qr
+        sm = getattr(self.app, "statistics_manager", None)
+        if sm is not None and total:
+            # fused insert targets are dead-end junctions (eligible()
+            # excludes subscribed targets), so the per-publish throughput
+            # hook never fires for them; meter delivered rows here so the
+            # calibration ledger can pair predicted selectivity against an
+            # actual out-rate on the fused path
+            sm.throughput_tracker(
+                f"stream.{qr.out_schema.stream_id}"
+            ).add(total)
         layout, _row_bytes = self._deliver_layout[i]
         lanes = {}
         for name, dt, off in layout:

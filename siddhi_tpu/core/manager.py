@@ -243,8 +243,10 @@ class SiddhiManager:
         """Serve Prometheus text (`/metrics`), raw reports (`/metrics.json`),
         sampled traces (`/traces`), live engine state (`/status`,
         `/status.json`), flight-recorder rings (`/flight`), the continuous
-        profiler (`/profile`), and EXPLAIN ANALYZE plans (`/explain`,
-        `/explain.json`) for EVERY app runtime registered on this manager. Idempotent: a second call
+        profiler (`/profile`), EXPLAIN ANALYZE plans (`/explain`,
+        `/explain.json`), the plan-vs-actual calibration ledger
+        (`/calibration`, `/calibration.json`), and SLO burn rates (`/slo`,
+        `/slo.json`) for EVERY app runtime registered on this manager. Idempotent: a second call
         returns the already-bound port. Pass port=0 for an ephemeral port;
         the bound port is returned either way."""
         if self._metrics_server is not None:
@@ -366,6 +368,47 @@ class SiddhiManager:
             )
             or "no apps registered\n"
         )
+
+    def calibration_reports(self) -> dict:
+        """app name -> plan-vs-actual calibration report
+        (`/calibration.json`, observability/calibration.py); apps without
+        `@app:statistics` have no ledger and are omitted."""
+        out = {}
+        for name, rt in list(self._runtimes.items()):
+            rep = rt.calibration_report()
+            if rep is not None:
+                out[name] = rep
+        return out
+
+    def calibration_text(self) -> str:
+        """Rendered calibration ledger for every app (`/calibration`)."""
+        from siddhi_tpu.observability.calibration import (
+            render_calibration_text,
+        )
+
+        reports = self.calibration_reports()
+        if not reports:
+            return "no calibration-enabled apps (add @app:statistics)\n"
+        return render_calibration_text(reports)
+
+    def slo_reports(self) -> dict:
+        """app name -> SLO burn-rate report (`/slo.json`,
+        observability/slo.py); apps without `@app:slo` are omitted."""
+        out = {}
+        for name, rt in list(self._runtimes.items()):
+            rep = rt.slo_report()
+            if rep is not None:
+                out[name] = rep
+        return out
+
+    def slo_text(self) -> str:
+        """Rendered SLO burn rates for every app (`/slo`)."""
+        from siddhi_tpu.observability.slo import render_slo_text
+
+        reports = self.slo_reports()
+        if not reports:
+            return "no slo-enabled apps (add @app:slo)\n"
+        return render_slo_text(reports)
 
     # ---- state introspection (observability/introspect.py) ----------------
 

@@ -181,6 +181,33 @@ def build_plan(app, runtime=None) -> dict:
     sm = getattr(runtime, "statistics_manager", None) if runtime else None
     ct = sm.compile_telemetry if sm is not None else None
 
+    # plan-vs-actual calibration pairs (observability/calibration.py),
+    # indexed by component so each query node renders its `calib:` line
+    # beside the `static:` line. Guarded like the cost model above.
+    calib_by_comp: dict = {}
+    calib_summary = None
+    ledger = getattr(runtime, "_calibration", None) if runtime else None
+    if ledger is not None:
+        try:
+            for p in ledger.observe():
+                calib_by_comp.setdefault(p["component"], {})[p["kind"]] = p
+            calib_summary = {
+                "generation": ledger.generation,
+                "flags": sorted({
+                    f
+                    for kinds in calib_by_comp.values()
+                    for p in kinds.values()
+                    for f in p.get("flags", ())
+                }),
+                "mispriced": [
+                    {"reason": r, "component": c, "count": n}
+                    for (r, c), n in sorted(ledger.mispriced.items())
+                ],
+            }
+        except Exception:
+            calib_by_comp = {}
+            calib_summary = None
+
     # total device step time across the app: the device-share denominator
     total_dev_ns = 0
     if sm is not None:
@@ -274,6 +301,12 @@ def build_plan(app, runtime=None) -> dict:
             comp = ct.component(fused_component)
             if comp is not None:
                 counters["compile"] = comp
+        # wire-kind calibration pairs live under `stream.<sid>`; the
+        # fused group's dispatch pair under its plan component
+        cp = dict(calib_by_comp.get(f"stream.{sid}", ()))
+        cp.update(calib_by_comp.get(fused_component, ()))
+        if cp:
+            node["calib"] = cp
         if counters:
             node["counters"] = counters
         nodes.append(node)
@@ -320,6 +353,9 @@ def build_plan(app, runtime=None) -> dict:
                     "predicted_compiles": qc.predicted_compiles,
                     "programs": [p.to_dict() for p in qc.programs],
                 }
+            cp = calib_by_comp.get(f"query.{f.qid}")
+            if cp:
+                node["calib"] = cp
             nodes.append(node)
         for sid in sorted(f.consumes):
             edges.append({"from": add_stream(sid), "to": nid})
@@ -359,6 +395,8 @@ def build_plan(app, runtime=None) -> dict:
         "edges": edges,
         "fusion": fusion_summary,
     }
+    if calib_summary is not None:
+        plan["calibration"] = calib_summary
     # churn ledger (core/churn.py): deploy/undeploy/redeploy counters, last
     # splice wall time, and the last state-seed outcome per component —
     # manager-owned, so it survives the runtime this plan annotates
@@ -522,6 +560,26 @@ def _fmt_counters(c: Optional[dict]) -> str:
     return "  (" + " ".join(parts) + ")" if parts else ""
 
 
+def _fmt_calib(cp: dict) -> str:
+    """One `calib:` line per node: live-over-predicted ratio per paired
+    kind (observability/calibration.py), rendered beside the `static:`
+    prediction it calibrates."""
+    parts = []
+    flags: list[str] = []
+    for kind, p in sorted(cp.items()):
+        if p.get("live") is None:
+            continue
+        parts.append(
+            f"{kind} {p['predicted']}->{p['live']} x{p['ratio']}"
+        )
+        for f in p.get("flags", ()):
+            if f not in flags:
+                flags.append(f)
+    if flags:
+        parts.append("!! " + ",".join(flags))
+    return " | ".join(parts)
+
+
 def render_text(plan: dict) -> str:
     """Human-readable plan: one block per query with its inputs/outputs,
     then the remaining definition nodes."""
@@ -560,6 +618,11 @@ def render_text(plan: dict) -> str:
                 f"compiles~{st['predicted_compiles']}"
                 + (f" [{progs}]" if progs else "")
             )
+        cp = n.get("calib")
+        if cp:
+            rendered = _fmt_calib(cp)
+            if rendered:
+                lines.append(f"    calib: {rendered}")
         for src in sorted(in_edges.get(n["id"], [])):
             sn = nodes.get(src)
             if sn is None:
@@ -568,6 +631,11 @@ def render_text(plan: dict) -> str:
             lines.append(
                 f"    in  <- {sn['label']}" + _fmt_counters(sn.get("counters"))
             )
+            scp = sn.get("calib")
+            if scp:
+                rendered = _fmt_calib(scp)
+                if rendered:
+                    lines.append(f"      calib: {rendered}")
         for dst in sorted(out_edges.get(n["id"], [])):
             dn = nodes.get(dst)
             if dn is None:
@@ -616,6 +684,16 @@ def render_text(plan: dict) -> str:
                     f"{k}={v}" for k, v in sorted(r.items()) if k != "kind"
                 )
                 lines.append(f"  {r['kind']}: {detail}")
+    calib = plan.get("calibration")
+    if calib:
+        line = f"calibration: generation={calib.get('generation')}"
+        if calib.get("flags"):
+            line += f"  flags={','.join(calib['flags'])}"
+        lines.append(line)
+        for m in calib.get("mispriced", ()):
+            lines.append(
+                f"  mispriced {m['reason']} {m['component']}: {m['count']}"
+            )
     churn = plan.get("churn")
     if churn:
         line = (

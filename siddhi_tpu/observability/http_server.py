@@ -17,6 +17,13 @@ Routes:
   /explain        EXPLAIN ANALYZE: the dataflow plan annotated with live
                   counters, human-readable text
   /explain.json   the raw plan dicts (nodes + edges) per app
+  /calibration    plan-vs-actual calibration ledger, human-readable text
+  /calibration.json  every static prediction paired with its live meter:
+                  error ratios + EWMA drift, mispricing reason codes
+                  (observability/calibration.py)
+  /slo            SLO burn rates per objective, human-readable text
+  /slo.json       multi-window burn rates + budget left per @app:slo
+                  objective (observability/slo.py)
 
 Started by `manager.serve_metrics(port)` (idempotent; port 0 picks an
 ephemeral port and returns it). No dependency beyond the stdlib — the
@@ -87,6 +94,22 @@ class MetricsServer:
                     elif path == "/explain.json":
                         body = json.dumps(
                             outer.manager.explain_reports(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/calibration":
+                        body = outer.manager.calibration_text().encode()
+                        ctype = "text/plain; charset=utf-8"
+                    elif path == "/calibration.json":
+                        body = json.dumps(
+                            outer.manager.calibration_reports(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path == "/slo":
+                        body = outer.manager.slo_text().encode()
+                        ctype = "text/plain; charset=utf-8"
+                    elif path == "/slo.json":
+                        body = json.dumps(
+                            outer.manager.slo_reports(), default=str
                         ).encode()
                         ctype = "application/json"
                     else:

@@ -106,6 +106,18 @@ class LogHistogram:
                     break
             return out
 
+    def count_over(self, v) -> int:
+        """Samples recorded strictly above `v`, at bucket resolution: the
+        bucket holding `v` itself counts as not-over (~3% relative slack,
+        same contract as `quantiles`). Feeds SLO burn rates (slo.py), where
+        "bad" = latency samples above the objective threshold."""
+        v = int(v)
+        if v < 0:
+            v = 0
+        i = self._index(v)
+        with self._lock:
+            return sum(self.counts[i + 1:])
+
     @property
     def mean(self) -> float:
         return self.total / self.count if self.count else 0.0

@@ -180,9 +180,13 @@ class CompileTelemetry:
         `name` plus every `name[...]` entry (pattern per-stream steps, join
         sides each jit their own program). For explain annotations."""
         with self._lock:
+            # "_" variants: fused groups compile mode-specific programs
+            # under suffixed names (e.g. `...fusedgroup.0_deliver`) — same
+            # logical component, so summaries and calibration pair them
             ents = [
                 e for k, e in self._components.items()
                 if k == name or k.startswith(name + "[")
+                or k.startswith(name + "_")
             ]
             if not ents:
                 return None

@@ -140,6 +140,13 @@ class StatisticsManager:
         # meters, lateness histograms; rendered as the siddhi_watermark_* /
         # siddhi_late_* / siddhi_lateness_ms Prometheus families
         self.watermark_fn = None
+        # plan-vs-actual calibration (observability/calibration.py): () ->
+        # the ledger's prometheus section — error-ratio pairs + cumulative
+        # mispriced counters; rendered as siddhi_calibration_* families
+        self.calibration_fn = None
+        # SLO burn rates (observability/slo.py): () -> the engine's
+        # prometheus section; rendered as siddhi_slo_burn_rate
+        self.slo_fn = None
         # continuous profiler: compile telemetry + per-chunk stage
         # waterfalls (observability/profiler.py), gated by this registry
         from siddhi_tpu.observability.profiler import (
@@ -225,6 +232,17 @@ class StatisticsManager:
         feeds the report's `watermark` section and the watermark/lateness
         Prometheus families."""
         self.watermark_fn = fn
+
+    def register_calibration(self, fn) -> None:
+        """Attach the CalibrationLedger's prometheus-section supplier; it
+        feeds the report's `calibration` section and the
+        siddhi_calibration_* Prometheus families."""
+        self.calibration_fn = fn
+
+    def register_slo(self, fn) -> None:
+        """Attach the SloEngine's prometheus-section supplier; it feeds the
+        report's `slo` section and siddhi_slo_burn_rate."""
+        self.slo_fn = fn
 
     def roofline(self) -> dict:
         """Live per-stream wire roofline: bytes/event over the fused h2d
@@ -330,10 +348,28 @@ class StatisticsManager:
                 self.watermark_fn() if self.watermark_fn is not None else {}
             ),
             "roofline": self.roofline(),
+            # compile-cause taxonomy totals (observability/profiler.py):
+            # promoted out of /profile so a recompile storm is alertable as
+            # siddhi_compiles_total{cause=,component=}
+            "compiles": {
+                n: {"compiles": e["compiles"], "causes": dict(e["causes"])}
+                for n, e in self.compile_telemetry.report().items()
+            },
             "traces_sampled": (
                 self.tracer.sampled_count if self.tracer is not None else 0
             ),
         }
+        # advisory sections must never take a scrape down with them
+        if self.calibration_fn is not None:
+            try:
+                rep["calibration"] = self.calibration_fn()
+            except Exception:
+                rep["calibration"] = {}
+        if self.slo_fn is not None:
+            try:
+                rep["slo"] = self.slo_fn()
+            except Exception:
+                rep["slo"] = {}
         return rep
 
     def prometheus_text(self) -> str:

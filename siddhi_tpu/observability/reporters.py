@@ -189,6 +189,27 @@ _FAMILIES = {
         "summary",
         "How far behind the watermark late events arrived, per stream (ms)"),
     "siddhi_traces_sampled_total": ("counter", "Traces sampled per app"),
+    "siddhi_compiles_total": (
+        "counter",
+        "XLA compiles per program component by cause "
+        "(observability/profiler.py taxonomy: first_compile, shape_change, "
+        "tail_variant_k, full_width_rebuild, deliver_set_change, "
+        "donation_mismatch) — alert on recompile storms"),
+    "siddhi_calibration_error_ratio": (
+        "gauge",
+        "EWMA-smoothed live/predicted ratio per calibration pair "
+        "(observability/calibration.py; 1.0 = the plan priced this "
+        "component exactly; kind label: prediction kind)"),
+    "siddhi_calibration_mispriced_total": (
+        "counter",
+        "Mispricing flags raised by the calibration ledger, by stable "
+        "reason code (selectivity_off_4x, wire_full_width_fallback, "
+        "unpredicted_recompile_cause, shared_state_refcount_collapsed)"),
+    "siddhi_slo_burn_rate": (
+        "gauge",
+        "Multi-window SLO burn rate per objective (observability/slo.py; "
+        "window label: fast/slow; 1.0 = consuming exactly the error "
+        "budget)"),
 }
 
 
@@ -324,6 +345,31 @@ def render_prometheus(reports: list[dict]) -> str:
                     body["siddhi_lateness_ms"], "siddhi_lateness_ms",
                     app, None, summ, stream=sid,
                 )
+        for n, ent in rep.get("compiles", {}).items():
+            for cause, v in sorted(ent.get("causes", {}).items()):
+                body["siddhi_compiles_total"].append(
+                    "siddhi_compiles_total"
+                    f"{_labels(app=app, component=n, cause=cause)} {v}"
+                )
+        calib = rep.get("calibration", {})
+        for ent in calib.get("pairs", []):
+            body["siddhi_calibration_error_ratio"].append(
+                "siddhi_calibration_error_ratio"
+                f"{_labels(app=app, kind=ent['kind'], component=ent['component'])}"
+                f" {ent['ratio']}"
+            )
+        for ent in calib.get("mispriced", []):
+            body["siddhi_calibration_mispriced_total"].append(
+                "siddhi_calibration_mispriced_total"
+                f"{_labels(app=app, reason=ent['reason'], component=ent['component'])}"
+                f" {ent['count']}"
+            )
+        for ent in rep.get("slo", {}).get("burn", []):
+            body["siddhi_slo_burn_rate"].append(
+                "siddhi_slo_burn_rate"
+                f"{_labels(app=app, objective=ent['objective'], component=ent['component'], window=ent['window'])}"
+                f" {ent['burn_rate']}"
+            )
         body["siddhi_traces_sampled_total"].append(
             "siddhi_traces_sampled_total"
             f"{_labels(app=app)} {rep.get('traces_sampled', 0)}"

@@ -1,0 +1,440 @@
+"""SLO burn-rate engine: `@app:slo(...)` evaluates multi-window burn rates.
+
+Hazelcast Jet's four-nines argument (PAPERS.md) is that stream engines
+must be *operated* against tail objectives, not just measured — the
+operational tool for that is the SRE multi-window burn-rate alert: an
+error budget (1 - objective) is "burning" at rate R when the bad-event
+fraction over a window is R times the allowed fraction. A fast window
+(window/12) catches sudden regressions in minutes; the slow window (the
+full budget window) catches slow leaks without paging on blips.
+
+    @app:slo(p99.latency.ms='50', error.rate='0.001',
+             window='1 hour', burn.fast='14', burn.slow='2')
+
+Objectives (at least one required):
+
+    p99.latency.ms=<ms>   latency samples above <ms> are bad; the implied
+                          objective is "99% of dispatches under <ms>"
+                          (allowed bad fraction 0.01)
+    error.rate=<frac>     handler errors per input event, allowed <frac>
+    shed.rate=<frac>      admission-shed events per offered event
+
+Options: `window` (budget window, default 1 hour), `burn.fast` /
+`burn.slow` (alert thresholds, SRE defaults 14.0 / 2.0), `interval`
+(evaluation cadence, default 1 sec).
+
+Alerts are CEP-native (the `@app:selfmon` precedent): the engine injects
+
+    SloAlertStream (component string, objective string,
+                    burn_rate double, budget_left double)
+
+and every evaluation tick in breach sends one row per burning
+(component, objective), so ordinary SiddhiQL subscribes to its own SLOs.
+Validation is SA139 — one rule set shared by the analyzer (reports every
+problem) and the runtime resolver (raises on the first), like SA125–SA134.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+SLO_STREAM_ID = "SloAlertStream"
+
+DEFAULT_WINDOW_MS = 3_600_000  # 1 hour budget window
+DEFAULT_BURN_FAST = 14.0  # SRE fast-burn page threshold
+DEFAULT_BURN_SLOW = 2.0  # SRE slow-burn ticket threshold
+DEFAULT_INTERVAL_MS = 1_000
+_MIN_INTERVAL_MS = 10
+_MIN_WINDOW_MS = 1_000
+# the fast window is 1/12 of the budget window (the 1h/5m SRE ratio)
+_FAST_DIVISOR = 12
+
+OBJ_P99_LATENCY = "p99.latency.ms"
+OBJ_ERROR_RATE = "error.rate"
+OBJ_SHED_RATE = "shed.rate"
+_OBJECTIVES = (OBJ_P99_LATENCY, OBJ_ERROR_RATE, OBJ_SHED_RATE)
+
+
+def slo_attrs():
+    """The injected alert stream's schema, shared by the runtime
+    (StreamSchema) and the analyzer (symbol table)."""
+    from siddhi_tpu.core.types import AttrType
+
+    return [
+        ("component", AttrType.STRING),
+        ("objective", AttrType.STRING),
+        ("burn_rate", AttrType.DOUBLE),
+        ("budget_left", AttrType.DOUBLE),
+    ]
+
+
+def _parse_time_ms(v, floor_ms: int) -> int | None:
+    """'1 hour' / '5 sec' / bare integer milliseconds -> ms, or None when
+    malformed or below `floor_ms`."""
+    from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+    s = str(v).strip()
+    try:
+        ms = int(s)
+    except ValueError:
+        try:
+            ms = SiddhiCompiler.parse_time_constant(s)
+        except Exception:
+            return None
+    return ms if ms >= floor_ms else None
+
+
+def _parse_positive_float(v) -> float | None:
+    try:
+        f = float(str(v).strip())
+    except ValueError:
+        return None
+    return f if f > 0.0 else None
+
+
+def _parse_fraction(v) -> float | None:
+    f = _parse_positive_float(v)
+    return f if f is not None and f < 1.0 else None
+
+
+@dataclass
+class SloConfig:
+    """Resolved `@app:slo` options (one per app)."""
+
+    objectives: dict = field(default_factory=dict)  # objective -> target
+    window_ms: int = DEFAULT_WINDOW_MS
+    burn_fast: float = DEFAULT_BURN_FAST
+    burn_slow: float = DEFAULT_BURN_SLOW
+    interval_ms: int = DEFAULT_INTERVAL_MS
+
+    @property
+    def fast_window_ms(self) -> int:
+        return max(1, self.window_ms // _FAST_DIVISOR)
+
+
+def iter_slo_annotation_problems(ann, defined_streams=()):
+    """Yield one message per `@app:slo` problem — THE validation rules,
+    shared by the runtime resolver (raises on the first) and the analyzer's
+    SA139 diagnostics (reports them all)."""
+    saw_objective = False
+    for k, v in ann.elements:
+        if k == OBJ_P99_LATENCY:
+            saw_objective = True
+            if _parse_positive_float(v) is None:
+                yield (
+                    f"@app:slo {OBJ_P99_LATENCY} '{v}' must be a positive "
+                    "latency threshold in milliseconds (e.g. '50')"
+                )
+        elif k in (OBJ_ERROR_RATE, OBJ_SHED_RATE):
+            saw_objective = True
+            if _parse_fraction(v) is None:
+                yield (
+                    f"@app:slo {k} '{v}' must be a fraction in (0, 1) "
+                    "(e.g. '0.001')"
+                )
+        elif k == "window":
+            if _parse_time_ms(v, _MIN_WINDOW_MS) is None:
+                yield (
+                    f"@app:slo window '{v}' must be a time constant of at "
+                    "least 1 sec (e.g. '1 hour')"
+                )
+        elif k in ("burn.fast", "burn.slow"):
+            if _parse_positive_float(v) is None:
+                yield (
+                    f"@app:slo {k} '{v}' must be a positive burn-rate "
+                    "threshold (e.g. '14')"
+                )
+        elif k == "interval":
+            if _parse_time_ms(v, _MIN_INTERVAL_MS) is None:
+                yield (
+                    f"@app:slo interval '{v}' must be a time constant of at "
+                    f"least {_MIN_INTERVAL_MS} millisec (e.g. '1 sec')"
+                )
+        else:
+            yield (
+                f"unknown @app:slo option '{k if k is not None else v}' "
+                f"(expected one of: {', '.join(_OBJECTIVES)}, window, "
+                "burn.fast, burn.slow, interval)"
+            )
+    if not saw_objective:
+        yield (
+            "@app:slo needs at least one objective "
+            f"({', '.join(_OBJECTIVES)})"
+        )
+    if SLO_STREAM_ID in defined_streams:
+        yield (
+            f"@app:slo reserves the stream name '{SLO_STREAM_ID}' "
+            "(the engine injects its definition)"
+        )
+
+
+def resolve_slo_annotation(ann, defined_streams=()) -> SloConfig:
+    """SloConfig for one app's `@app:slo` annotation. Raises
+    SiddhiAppCreationError on malformed options — the runtime analog of the
+    analyzer's SA139 diagnostic."""
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    for problem in iter_slo_annotation_problems(ann, defined_streams):
+        raise SiddhiAppCreationError(problem)
+    cfg = SloConfig()
+    for k, v in ann.elements:
+        if k == OBJ_P99_LATENCY:
+            cfg.objectives[k] = _parse_positive_float(v)
+        elif k in (OBJ_ERROR_RATE, OBJ_SHED_RATE):
+            cfg.objectives[k] = _parse_fraction(v)
+        elif k == "window":
+            cfg.window_ms = _parse_time_ms(v, _MIN_WINDOW_MS)
+        elif k == "burn.fast":
+            cfg.burn_fast = _parse_positive_float(v)
+        elif k == "burn.slow":
+            cfg.burn_slow = _parse_positive_float(v)
+        elif k == "interval":
+            cfg.interval_ms = _parse_time_ms(v, _MIN_INTERVAL_MS)
+    return cfg
+
+
+class SloEngine:
+    """Recurring scheduler target evaluating the app's SLOs and feeding
+    SloAlertStream (owned by SiddhiAppRuntime; the SelfMonitor shape).
+
+    Each tick appends one cumulative (t_ms, total, bad) snapshot per live
+    (objective, component) series to a pruned ring, then computes the
+    bad-event fraction over the fast and slow windows as deltas between
+    ring endpoints — so burn rates measure the *window*, not
+    process-lifetime averages."""
+
+    def __init__(self, runtime, config: SloConfig):
+        self.runtime = runtime
+        self.config = config
+        self.ticks = 0
+        self.alerts = 0  # alert rows emitted (introspection: slo health)
+        # (objective, component) -> list[(t_ms, total, bad)] cumulative ring
+        self._rings: dict = {}
+        self._burn: dict = {}  # last evaluation, for report()
+        # ONE stable target object: the scheduler dedups pending fires by
+        # id(target) (the SelfMonitor precedent)
+        self._target = self._fire
+
+    # ---- series collection -----------------------------------------------
+
+    def _series(self) -> list:
+        """Cumulative (objective, component, total, bad, allowed) tuples for
+        every live series. Never raises: a collection fault must not take
+        the scheduler down."""
+        rt = self.runtime
+        cfg = self.config
+        out: list = []
+        sm = rt.statistics_manager
+        target = cfg.objectives.get(OBJ_P99_LATENCY)
+        if target is not None and sm is not None:
+            thr_ns = int(target * 1e6)
+            for name, lt in list(sm.latency.items()):
+                if lt.samples:
+                    out.append((
+                        OBJ_P99_LATENCY, name, lt.samples,
+                        lt.hist.count_over(thr_ns), 0.01,
+                    ))
+        rate = cfg.objectives.get(OBJ_ERROR_RATE)
+        if rate is not None and sm is not None:
+            total_in = sum(
+                tt.count for name, tt in list(sm.throughput.items())
+                if name.startswith("stream.")
+            )
+            for name, et in list(sm.errors.items()):
+                if et.subscriber is None:  # aggregates only, like selfmon
+                    base = sm.throughput.get(name)
+                    total = base.count if base is not None else total_in
+                    out.append((
+                        OBJ_ERROR_RATE, name, max(total, et.count),
+                        et.count, rate,
+                    ))
+        rate = cfg.objectives.get(OBJ_SHED_RATE)
+        adm = getattr(rt, "_admission", None)
+        if rate is not None and adm is not None:
+            accepted = 0
+            if sm is not None:
+                accepted = sum(
+                    tt.count for name, tt in list(sm.throughput.items())
+                    if name.startswith("stream.")
+                )
+            out.append((
+                OBJ_SHED_RATE, "admission", accepted + adm.shed,
+                adm.shed, rate,
+            ))
+        return out
+
+    # ---- burn evaluation -------------------------------------------------
+
+    @staticmethod
+    def _window_burn(ring, now_ms, window_ms, allowed) -> float | None:
+        """Bad fraction over [now-window, now] divided by the allowed
+        fraction; None until the window holds any events."""
+        start = now_ms - window_ms
+        base = ring[0]
+        for snap in ring:
+            if snap[0] < start:
+                base = snap
+            else:
+                break
+        head = ring[-1]
+        d_total = head[1] - base[1]
+        d_bad = head[2] - base[2]
+        if d_total <= 0:
+            return None
+        return (d_bad / d_total) / allowed
+
+    def evaluate(self, now_ms: int) -> list[tuple]:
+        """Append snapshots, recompute burn rates, return alert rows
+        (component, objective, burn_rate, budget_left) for every series in
+        breach of either threshold."""
+        cfg = self.config
+        rows: list[tuple] = []
+        burn_out: dict = {}
+        live = set()
+        for objective, component, total, bad, allowed in self._series():
+            key = (objective, component)
+            live.add(key)
+            ring = self._rings.setdefault(key, [])
+            ring.append((now_ms, total, bad))
+            # prune to the slow window (+1 sample of history before it, so
+            # _window_burn always has a baseline at the window edge)
+            start = now_ms - cfg.window_ms
+            while len(ring) > 2 and ring[1][0] < start:
+                ring.pop(0)
+            fast = self._window_burn(
+                ring, now_ms, cfg.fast_window_ms, allowed
+            )
+            slow = self._window_burn(ring, now_ms, cfg.window_ms, allowed)
+            budget_left = (
+                max(0.0, round(1.0 - slow, 4)) if slow is not None else 1.0
+            )
+            burn_out[key] = {
+                "fast": round(fast, 4) if fast is not None else None,
+                "slow": round(slow, 4) if slow is not None else None,
+                "budget_left": budget_left,
+            }
+            breach = None
+            if fast is not None and fast >= cfg.burn_fast:
+                breach = fast
+            elif slow is not None and slow >= cfg.burn_slow:
+                breach = slow
+            if breach is not None:
+                rows.append((
+                    component, objective, float(round(breach, 4)),
+                    float(budget_left),
+                ))
+        # drop rings for series that disappeared (churn removed the query)
+        for key in list(self._rings):
+            if key not in live:
+                del self._rings[key]
+        self._burn = burn_out
+        return rows
+
+    # ---- scheduling ------------------------------------------------------
+
+    def start(self) -> None:
+        rt = self.runtime
+        rt._scheduler.start()
+        rt._scheduler.notify_at(
+            rt.clock() + self.config.interval_ms, self._target
+        )
+
+    def _fire(self, t_ms: int) -> None:
+        rt = self.runtime
+        if not rt._running:
+            return
+        try:
+            rows = self.evaluate(t_ms)
+            if rows:
+                # count BEFORE sending: subscribers observe delivery
+                # synchronously inside send_rows, and introspection read
+                # concurrently must never show fewer alerts than delivered
+                self.alerts += len(rows)
+                rt._junction(SLO_STREAM_ID).send_rows(
+                    [t_ms] * len(rows), rows, now=t_ms
+                )
+            self.ticks += 1
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "slo evaluation for app '%s' raised", rt.name
+            )
+        finally:
+            rt._scheduler.notify_at(
+                t_ms + self.config.interval_ms, self._target
+            )
+
+    # ---- surfaces --------------------------------------------------------
+
+    def report(self) -> dict:
+        """The `/slo(.json)` payload for one app."""
+        cfg = self.config
+        return {
+            "app": self.runtime.name,
+            "objectives": dict(cfg.objectives),
+            "window_ms": cfg.window_ms,
+            "fast_window_ms": cfg.fast_window_ms,
+            "burn_thresholds": {"fast": cfg.burn_fast, "slow": cfg.burn_slow},
+            "interval_ms": cfg.interval_ms,
+            "metered": self.runtime.statistics_manager is not None,
+            "burn": [
+                {
+                    "objective": objective,
+                    "component": component,
+                    **vals,
+                }
+                for (objective, component), vals in sorted(self._burn.items())
+            ],
+            "ticks": self.ticks,
+            "alerts": self.alerts,
+        }
+
+    def prometheus_section(self) -> dict:
+        """The `slo` section of StatisticsManager.report(), feeding
+        `siddhi_slo_burn_rate{app=,objective=}` (reporters.py)."""
+        burn = []
+        for (objective, component), vals in sorted(self._burn.items()):
+            for window in ("fast", "slow"):
+                if vals.get(window) is not None:
+                    burn.append({
+                        "objective": objective,
+                        "component": component,
+                        "window": window,
+                        "burn_rate": vals[window],
+                    })
+        return {"burn": burn}
+
+    def describe_state(self) -> dict:
+        return {
+            "interval_ms": self.config.interval_ms,
+            "window_ms": self.config.window_ms,
+            "objectives": sorted(self.config.objectives),
+            "ticks": self.ticks,
+            "alerts": self.alerts,
+        }
+
+
+def render_slo_text(reports: dict) -> str:
+    """Plain-text `/slo` rendering over manager.slo_reports()."""
+    lines = []
+    for app, rep in sorted(reports.items()):
+        obj = " ".join(
+            f"{k}={v}" for k, v in sorted(rep["objectives"].items())
+        )
+        lines.append(
+            f"app '{app}'  {obj}  window={rep['window_ms']}ms "
+            f"(fast={rep['fast_window_ms']}ms)  thresholds "
+            f"fast>={rep['burn_thresholds']['fast']} "
+            f"slow>={rep['burn_thresholds']['slow']}"
+        )
+        for b in rep.get("burn", []):
+            lines.append(
+                f"  {b['objective']} {b['component']}: "
+                f"fast={b['fast']} slow={b['slow']} "
+                f"budget_left={b['budget_left']}"
+            )
+        lines.append(
+            f"  ticks={rep['ticks']} alerts={rep['alerts']}"
+        )
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,218 @@
+"""SLO burn-rate engine (observability/slo.py): `@app:slo` option
+validation (runtime raise + analyzer SA139 share one rule set), the
+injected SloAlertStream subscribed from ordinary SiddhiQL, multi-window
+burn math, and the /slo surfaces."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.observability.slo import (
+    DEFAULT_BURN_FAST,
+    DEFAULT_BURN_SLOW,
+    DEFAULT_WINDOW_MS,
+    SloEngine,
+    iter_slo_annotation_problems,
+    resolve_slo_annotation,
+)
+from siddhi_tpu.query_api.annotation import Annotation
+
+
+def _ann(**opts):
+    a = Annotation("app:slo")
+    for k, v in opts.items():
+        a.elements.append((k.replace("_", "."), v))
+    return a
+
+
+class TestAnnotationRules:
+    def test_defaults(self):
+        cfg = resolve_slo_annotation(_ann(**{"p99_latency_ms": "50"}))
+        assert cfg.objectives == {"p99.latency.ms": 50.0}
+        assert cfg.window_ms == DEFAULT_WINDOW_MS
+        assert cfg.burn_fast == DEFAULT_BURN_FAST
+        assert cfg.burn_slow == DEFAULT_BURN_SLOW
+        assert cfg.fast_window_ms == DEFAULT_WINDOW_MS // 12
+
+    def test_full_config(self):
+        cfg = resolve_slo_annotation(_ann(
+            p99_latency_ms="5", error_rate="0.01", shed_rate="0.05",
+            window="10 min", **{"burn_fast": "10", "burn_slow": "1.5",
+                                "interval": "500 millisec"},
+        ))
+        assert cfg.objectives == {
+            "p99.latency.ms": 5.0, "error.rate": 0.01, "shed.rate": 0.05,
+        }
+        assert cfg.window_ms == 600_000
+        assert cfg.interval_ms == 500
+        assert (cfg.burn_fast, cfg.burn_slow) == (10.0, 1.5)
+
+    @pytest.mark.parametrize("opts", [
+        {"p99_latency_ms": "-1"},
+        {"error_rate": "2"},
+        {"shed_rate": "0"},
+        {"p99_latency_ms": "50", "window": "soon"},
+        {"p99_latency_ms": "50", "window": "10 millisec"},  # below 1 sec
+        {"p99_latency_ms": "50", "burn_fast": "x"},
+        {"p99_latency_ms": "50", "interval": "1 millisec"},
+        {"p99_latency_ms": "50", "bogus": "1"},
+        {"window": "1 hour"},  # no objective at all
+    ])
+    def test_each_malformed_option_raises(self, opts):
+        with pytest.raises(SiddhiAppCreationError):
+            resolve_slo_annotation(_ann(**opts))
+
+    def test_reserved_stream_name(self):
+        problems = list(iter_slo_annotation_problems(
+            _ann(p99_latency_ms="50"),
+            defined_streams=("SloAlertStream",),
+        ))
+        assert any("reserves the stream name" in p for p in problems)
+
+    def test_analyzer_reports_every_problem(self):
+        # one rule set: the analyzer yields them ALL (SA139), the resolver
+        # raises on the first — counts must agree
+        bad = _ann(p99_latency_ms="-1", error_rate="2", bogus="1")
+        assert len(list(iter_slo_annotation_problems(bad))) == 3
+
+
+class TestBurnMath:
+    def test_window_burn_is_windowed_not_lifetime(self):
+        # an early bad burst followed by clean traffic: the fast window
+        # must read 0 while the full window still charges the burst
+        ring = [(0, 0, 0), (5_000, 100, 100), (11_000, 1100, 100)]
+        recent = SloEngine._window_burn(
+            ring, now_ms=11_000, window_ms=2_000, allowed=0.01
+        )
+        assert recent == pytest.approx(0.0)
+        full = SloEngine._window_burn(
+            ring, now_ms=11_000, window_ms=100_000, allowed=0.01
+        )
+        assert full == pytest.approx((100 / 1100) / 0.01)
+
+    def test_empty_window_is_none(self):
+        assert SloEngine._window_burn(
+            [(0, 5, 0), (100, 5, 0)], 100, 50, 0.01
+        ) is None
+
+
+SLO_APP = """@app:statistics(reporter='none')
+@app:slo(p99.latency.ms='0.0001', window='2 sec',
+         burn.fast='1', burn.slow='1', interval='25 millisec')
+define stream S (v long);
+@info(name='q') from S select v insert into Out;
+@info(name='watch')
+from SloAlertStream[objective == 'p99.latency.ms']
+select component, objective, burn_rate, budget_left insert into Watched;
+"""
+
+
+class TestAlertStreamEndToEnd:
+    def test_burning_slo_fires_siddhiql_subscriber(self):
+        # acceptance: a 100 ns latency objective is in breach on any real
+        # dispatch, so the burn engine must emit SloAlertStream rows a
+        # plain SiddhiQL query consumes
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(SLO_APP)
+        alerts = []
+        rt.add_callback(
+            "watch", lambda ts, ins, rem: alerts.extend(ins or [])
+        )
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(8):
+            h.send((i,))
+        t0 = time.time()
+        while not alerts and time.time() - t0 < 10:
+            time.sleep(0.02)
+            h.send((99,))  # keep latency samples flowing
+        assert alerts, "slo burn alert must fire through SiddhiQL"
+        ev = alerts[0]
+        comps = {e.data[0] for e in alerts}
+        assert any(c.startswith("query.") for c in comps)
+        assert ev.data[1] == "p99.latency.ms"
+        assert ev.data[2] >= 1.0  # burn_rate at/above the breach threshold
+        assert 0.0 <= ev.data[3] <= 1.0  # budget_left
+        status = rt.snapshot_status()
+        assert status["slo"]["ticks"] >= 1
+        assert status["slo"]["alerts"] >= 1
+        mgr.shutdown()
+
+    def test_slo_http_and_prometheus_surfaces(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(SLO_APP)
+        alerts = []
+        rt.add_callback(
+            "watch", lambda ts, ins, rem: alerts.extend(ins or [])
+        )
+        rt.start()
+        h = rt.get_input_handler("S")
+        t0 = time.time()
+        while not alerts and time.time() - t0 < 10:
+            h.send((1,))
+            time.sleep(0.02)
+        port = mgr.serve_metrics(0)
+
+        def get(path):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ).read().decode()
+
+        rep = json.loads(get("/slo.json"))["SiddhiApp"]
+        assert rep["objectives"] == {"p99.latency.ms": 0.0001}
+        assert rep["window_ms"] == 2000
+        assert any(
+            b["slow"] is not None and b["slow"] >= 1.0
+            for b in rep["burn"]
+        )
+        text = get("/slo")
+        assert "p99.latency.ms" in text and "budget_left" in text
+        prom = mgr.prometheus_text()
+        assert "siddhi_slo_burn_rate{" in prom
+        mgr.shutdown()
+
+    def test_runtime_rejects_malformed_annotation(self):
+        mgr = SiddhiManager()
+        with pytest.raises(SiddhiAppCreationError):
+            mgr.create_siddhi_app_runtime("""
+            @app:slo(window='1 hour')
+            define stream S (v long);
+            from S select v insert into Out;
+            """)
+        mgr.shutdown()
+
+    def test_no_annotation_no_engine(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream S (v long);
+        @info(name='q') from S select v insert into Out;
+        """)
+        assert rt._slo is None
+        assert rt.slo_report() is None
+        assert "no slo-enabled apps" in mgr.slo_text()
+        mgr.shutdown()
+
+
+class TestAnalyzerIntegration:
+    def test_slo_app_lints_clean(self):
+        from siddhi_tpu.analysis.analyzer import analyze
+        from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+        res = analyze(SiddhiCompiler.parse(SLO_APP))
+        assert not res.errors, [d.message for d in res.errors]
+
+    def test_sa139_reported_per_problem(self):
+        from siddhi_tpu.analysis.analyzer import analyze
+        from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+        res = analyze(SiddhiCompiler.parse("""
+        @app:slo(p99.latency.ms='-1', bogus='1')
+        define stream S (v long);
+        from S select v insert into Out;
+        """))
+        codes = [d.code for d in res.errors]
+        assert codes.count("SA139") == 2

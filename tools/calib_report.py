@@ -1,0 +1,171 @@
+"""Calibration regression sentinel: diff two runs' calibration blobs.
+
+A calibration blob is the `/calibration.json` payload of one app (what
+`bench.py --leg calibration` puts under detail `calibration`, and what
+`runtime.calibration_report()` returns): per-(kind, component) prediction
+pairs with live values and error ratios, plus cumulative mispricing
+counters. This tool compares a CURRENT blob against a committed BASELINE
+and fails (exit 1) when the plan's pricing got measurably worse:
+
+  * prediction-error drift: a pair's |log(ratio)| grew by more than
+    --drift (default 0.69 ~= 2x) over the baseline's — the static model
+    now misprices something it used to price well;
+  * new unexplained-recompile flags: `unpredicted_recompile_cause`
+    mispricings that the baseline did not carry (any count regression on
+    that reason code);
+  * lost pairings: a prediction kind that paired live values in the
+    baseline no longer does (the meter went dark, or the join key drifted);
+  * p99 trajectory (optional): when both blobs carry `p99_detect_ms`
+    (bench detail), the current p99 must stay within --p99-slack (default
+    25%) of the baseline.
+
+Usage:
+    python tools/calib_report.py BASELINE.json CURRENT.json \
+        [--drift 0.69] [--p99-slack 0.25] [--json]
+
+Each input is either a bare calibration blob, a bench detail dict with a
+`calibration` key, or a full bench snapshot line (detail nested under
+`detail`). Exit 0 = calibrated as well as before.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+REASON_RECOMPILE = "unpredicted_recompile_cause"
+
+
+def _extract(doc: dict) -> tuple[dict, float | None]:
+    """(calibration blob, p99_detect_ms or None) from any supported input
+    shape."""
+    d = doc
+    if "detail" in d and isinstance(d["detail"], dict):
+        d = d["detail"]
+    p99 = d.get("p99_detect_ms")
+    if "calibration" in d and isinstance(d["calibration"], dict):
+        return d["calibration"], p99
+    if "pairs" in d:  # bare blob
+        return d, p99
+    raise SystemExit(
+        "input is not a calibration blob (no `pairs`/`calibration` key)"
+    )
+
+
+def _pair_index(blob: dict) -> dict:
+    return {
+        (p["kind"], p["component"]): p
+        for p in blob.get("pairs", ())
+    }
+
+
+def _abs_log_ratio(p: dict) -> float | None:
+    r = p.get("ratio_ewma")
+    if r is None:
+        r = p.get("ratio")
+    if r is None or r <= 0:
+        return None
+    return abs(math.log(r))
+
+
+def _recompile_count(blob: dict) -> int:
+    return sum(
+        m.get("count", 0)
+        for m in blob.get("mispriced", ())
+        if m.get("reason") == REASON_RECOMPILE
+    )
+
+
+def diff(baseline: dict, current: dict, drift: float,
+         p99_base=None, p99_cur=None, p99_slack: float = 0.25) -> dict:
+    base_pairs = _pair_index(baseline)
+    cur_pairs = _pair_index(current)
+    problems: list[str] = []
+    drifted: list[dict] = []
+    for key, bp in sorted(base_pairs.items()):
+        cp = cur_pairs.get(key)
+        kind, comp = key
+        if cp is None:
+            problems.append(f"pair vanished: {kind} {comp}")
+            continue
+        if bp.get("live") is not None and cp.get("live") is None:
+            problems.append(f"live meter went dark: {kind} {comp}")
+            continue
+        b_err, c_err = _abs_log_ratio(bp), _abs_log_ratio(cp)
+        if b_err is None or c_err is None:
+            continue
+        if c_err - b_err > drift:
+            drifted.append({
+                "kind": kind, "component": comp,
+                "baseline_abs_log_ratio": round(b_err, 4),
+                "current_abs_log_ratio": round(c_err, 4),
+            })
+            problems.append(
+                f"prediction error drifted: {kind} {comp} "
+                f"|log ratio| {b_err:.3f} -> {c_err:.3f}"
+            )
+    base_kinds = set(baseline.get("kinds_paired", ()))
+    cur_kinds = set(current.get("kinds_paired", ()))
+    for k in sorted(base_kinds - cur_kinds):
+        problems.append(f"prediction kind no longer pairs live: {k}")
+    rc_base, rc_cur = _recompile_count(baseline), _recompile_count(current)
+    if rc_cur > rc_base:
+        problems.append(
+            f"unexplained-recompile mispricings grew: {rc_base} -> {rc_cur}"
+        )
+    p99 = None
+    if p99_base is not None and p99_cur is not None and p99_base > 0:
+        p99 = {"baseline_ms": p99_base, "current_ms": p99_cur}
+        if p99_cur > p99_base * (1.0 + p99_slack):
+            problems.append(
+                f"p99 trajectory regressed: {p99_base:.2f} ms -> "
+                f"{p99_cur:.2f} ms (> +{p99_slack:.0%})"
+            )
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "drifted": drifted,
+        "kinds": {
+            "baseline": sorted(base_kinds), "current": sorted(cur_kinds),
+        },
+        "recompile_mispricings": {"baseline": rc_base, "current": rc_cur},
+        **({"p99": p99} if p99 else {}),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two calibration blobs; exit 1 on regression"
+    )
+    ap.add_argument("baseline")
+    ap.add_argument("current")
+    ap.add_argument("--drift", type=float, default=0.69,
+                    help="max |log(ratio)| growth per pair (default ~2x)")
+    ap.add_argument("--p99-slack", type=float, default=0.25,
+                    help="allowed fractional p99 growth (default 0.25)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full diff as JSON")
+    args = ap.parse_args(argv)
+    with open(args.baseline) as f:
+        base_blob, p99_b = _extract(json.load(f))
+    with open(args.current) as f:
+        cur_blob, p99_c = _extract(json.load(f))
+    res = diff(base_blob, cur_blob, args.drift, p99_b, p99_c,
+               args.p99_slack)
+    if args.json:
+        print(json.dumps(res, indent=2))
+    else:
+        for p in res["problems"]:
+            print(f"REGRESSION: {p}")
+        print(
+            f"{'OK' if res['ok'] else 'FAIL'}: "
+            f"{len(base_blob.get('pairs', ()))} baseline pairs, "
+            f"kinds {','.join(res['kinds']['current']) or '-'}"
+        )
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,10 +220,27 @@ def _run(blob: dict) -> int:
     assert plan["live"] and plan["nodes"] and plan["edges"], plan
     assert any(n["id"] == "query:q" for n in plan["nodes"]), plan["nodes"]
 
+    # plan-vs-actual calibration ledger: statistics are armed, so the app
+    # carries a ledger whose /calibration.json pairs static predictions
+    # (selectivity, state bytes, compiles) against the live meters
+    calib = json.loads(scrape(f"http://127.0.0.1:{port}/calibration.json"))
+    crep = calib["SiddhiApp"]
+    blob["calibration"] = crep
+    assert crep["generation"] >= 1, crep
+    assert crep["pairs"], "/calibration.json must carry prediction pairs"
+    assert crep["kinds_paired"], crep
+    calib_text = scrape(f"http://127.0.0.1:{port}/calibration")
+    assert "generation=" in calib_text
+    # /slo: this app declares no @app:slo, so the route reports the
+    # fallback rather than 404ing (scrapers probe every route)
+    slo_text = scrape(f"http://127.0.0.1:{port}/slo")
+    assert "no slo-enabled apps" in slo_text
+
     mgr.shutdown()
     print(
         f"metrics smoke OK: {samples} samples, {len(typed)} families, "
-        f"status + flight + lineage + roofline + profile + explain live"
+        f"status + flight + lineage + roofline + profile + explain + "
+        f"calibration live"
     )
     return 0
 
