@@ -850,6 +850,170 @@ def _leg_shard(n_shard: int, batch=4096, events=1_000_000) -> dict:
     return out
 
 
+# key-sharded STATEFUL workloads (`--leg shardstate`, parallel/keyshard.py):
+# the keys axis hashes group-by aggregation state and join window rings
+# across the mesh. Both sides of each A/B must deliver identical rows AND
+# an identical integer checksum (the byte-parity contract), and the
+# sharded group-by's per-device key ownership must sum to the total key
+# count. Integer aggregators only — float scans are reassociation-
+# sensitive under the owner mask and deliberately ineligible.
+SHARDSTATE_GROUPBY = """
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='q')
+        from StockStream
+        select symbol, sum(volume) as sv, min(volume) as mn, count() as c
+        group by symbol insert into Out;
+        """
+
+SHARDSTATE_JOIN = """
+        @app:joinCapacity(size='65536')
+        define stream StockStream (symbol string, price float, volume long);
+        define stream QuoteStream (symbol string, price float, volume long);
+        @info(name='q')
+        from StockStream#window.length(8) join QuoteStream#window.length(8)
+            on StockStream.symbol == QuoteStream.symbol
+        select StockStream.symbol as s, QuoteStream.price as qp,
+            StockStream.volume as av
+        insert into Out;
+        """
+
+
+def _make_keyed_data(n: int, n_keys: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    return {
+        "ts": np.arange(n, dtype=np.int64) + 1_700_000_000_000,
+        "symbol": rng.integers(1, n_keys + 1, size=n).astype(np.int32),
+        "price": rng.uniform(0.0, 100.0, size=n).astype(np.float32),
+        "volume": rng.integers(1, 1000, size=n).astype(np.int64),
+        "names": [f"K{i}" for i in range(n_keys)],
+    }
+
+
+def _leg_shardstate(n_shard: int, batch=4096, events=400_000) -> dict:
+    """Keyed-shard A/B (`--leg shardstate --shard N`): group-by-heavy and
+    join workloads run the same feed with SIDDHI_TPU_SHARD=N +
+    SIDDHI_TPU_SHARD_AXIS=keys and once unsharded. Reports per-workload
+    throughput and scaling, exact row/checksum parity, per-device key
+    ownership (must sum to the total), a key-count scaling sweep, and the
+    geomean scaling."""
+    import jax
+
+    from siddhi_tpu import SiddhiManager
+
+    out: dict = {
+        "shardstate_devices_requested": n_shard,
+        "shardstate_devices_visible": len(jax.devices()),
+        "shardstate_batch": batch,
+    }
+
+    def run(ql, data, sharded: bool, join_feed=False):
+        saved = {
+            k: os.environ.get(k)
+            for k in ("SIDDHI_TPU_SHARD", "SIDDHI_TPU_SHARD_AXIS")
+        }
+        os.environ["SIDDHI_TPU_SHARD"] = str(n_shard) if sharded else "0"
+        os.environ["SIDDHI_TPU_SHARD_AXIS"] = "keys"
+        try:
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(
+                f"@app:batch(size='{batch}')\n" + ql
+            )
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        _prime_interner(mgr, data["names"])
+        sink = [0, 0]  # rows, integer checksum
+
+        def cb(ts, ins, removed, _s=sink):
+            for e in ins or ():
+                _s[0] += 1
+                _s[1] += int(e.data[-1])
+        rt.add_callback("q", cb)
+        rt.start()
+        cols = {k: v for k, v in data.items() if k not in ("ts", "names")}
+        n = len(data["ts"])
+        if join_feed:
+            # prime the quote ring once so both sides probe identical state
+            qn = batch
+            rt.get_input_handler("QuoteStream").send_columns(
+                data["ts"][:qn], {k: v[:qn] for k, v in cols.items()}
+            )
+        h = rt.get_input_handler("StockStream")
+        warm = min(batch * 4, n)
+        h.send_columns(
+            data["ts"][:warm], {k: v[:warm] for k, v in cols.items()}
+        )
+        _truth_sync(rt)
+        sink[0] = sink[1] = 0
+        t0 = time.perf_counter()
+        h.send_columns(data["ts"], cols)
+        _truth_sync(rt)
+        dt = time.perf_counter() - t0
+        res = {
+            "mev_s": round(n / dt / 1e6, 3),
+            "rows": sink[0],
+            "checksum": sink[1],
+        }
+        qr = rt.queries["q"]
+        ks = getattr(qr, "_keyshard", None)
+        if ks is not None:
+            desc = ks.describe_state()
+            res["per_device_keys"] = desc.get("per_device_keys", [])
+            res["total_keys"] = desc.get("total_keys", 0)
+            res["skew"] = desc.get("skew")
+        res["join_sharded"] = bool(getattr(qr, "_joinshard", False))
+        rt.shutdown()
+        mgr.shutdown()
+        return res
+
+    scalings = []
+    for name, ql, join_feed in (
+        ("keyshard_groupby", SHARDSTATE_GROUPBY, False),
+        ("keyshard_join", SHARDSTATE_JOIN, True),
+    ):
+        data = _make_keyed_data(events, 8)
+        a = run(ql, data, sharded=False, join_feed=join_feed)
+        b = run(ql, data, sharded=True, join_feed=join_feed)
+        out[f"{name}_unsharded_mev_s"] = a["mev_s"]
+        out[f"{name}_sharded_mev_s"] = b["mev_s"]
+        out[f"{name}_scaling"] = round(b["mev_s"] / a["mev_s"], 3)
+        scalings.append(out[f"{name}_scaling"])
+        out[f"{name}_rows_match"] = a["rows"] == b["rows"]
+        out[f"{name}_checksum_match"] = a["checksum"] == b["checksum"]
+        out[f"{name}_checksum"] = b["checksum"]
+        if name == "keyshard_groupby":
+            out[f"{name}_per_device_keys"] = b.get("per_device_keys", [])
+            out[f"{name}_total_keys"] = b.get("total_keys", 0)
+            out[f"{name}_keys_sum_match"] = (
+                sum(b.get("per_device_keys", [])) == b.get("total_keys", -1)
+            )
+            out[f"{name}_skew"] = b.get("skew")
+        else:
+            out[f"{name}_join_sharded"] = b["join_sharded"]
+    # key-count sweep: same sharded group-by at rising key cardinality —
+    # occupancy spreads, throughput should hold or improve per key
+    sweep = {}
+    for n_keys in (8, 64, 512):
+        data = _make_keyed_data(min(events, 200_000), n_keys, seed=11)
+        b = run(SHARDSTATE_GROUPBY, data, sharded=True)
+        sweep[str(n_keys)] = {
+            "mev_s": b["mev_s"],
+            "total_keys": b.get("total_keys", 0),
+            "keys_sum_match": (
+                sum(b.get("per_device_keys", [])) == b.get("total_keys", -1)
+            ),
+        }
+    out["keyshard_key_sweep"] = sweep
+    out["shardstate_scaling_geomean"] = round(
+        math.exp(sum(math.log(max(s, 1e-9)) for s in scalings) / len(scalings)),
+        3,
+    ) if scalings else 0.0
+    return out
+
+
 # compact-wire-encoding workloads (`--leg wire`, core/wire.py): one
 # dictionary-heavy stream (low-cardinality interned symbols + a declared
 # qty range) and one delta-timestamp stream (monotone LONG seq). Each runs
@@ -1415,6 +1579,15 @@ def _run_leg(name: str, args) -> dict:
         batch = args.batch if getattr(args, "batch_explicit", True) else 4096
         return _leg_shard(
             args.shard, batch=batch, events=min(args.events, 1_000_000)
+        )
+    if name == "shardstate":
+        if not args.shard:
+            return {"shardstate_error": "pass --shard N (e.g. --shard 8 "
+                    "under XLA_FLAGS=--xla_force_host_platform_device_"
+                    "count=8)"}
+        batch = args.batch if getattr(args, "batch_explicit", True) else 4096
+        return _leg_shardstate(
+            args.shard, batch=batch, events=min(args.events, 400_000)
         )
     raise SystemExit(f"unknown leg {name!r}")
 

@@ -692,21 +692,43 @@ def compute_costs(app: SiddhiApp, sym=None, values=None) -> AppCostModel:
     K = max(2, K)
     model = AppCostModel(app.name, B, K)
 
-    # inferred wire hints subsume the declared @app:wire contracts (the
-    # analysis is seeded from them), so one map prices both
+    # declared @app:wire contracts price the wire even WITHOUT a value
+    # analysis, and their range hints become interval facts for tensor
+    # narrowing + filter selectivity below; inferred hints (seeded from
+    # the declared ones, so at least as tight) overlay both
     wire_hints: dict = {}
+    declared_facts: dict = {}
+    try:
+        from siddhi_tpu.analysis.values import ValueFact
+        from siddhi_tpu.core.wire import parse_wire_hints
+
+        declared = parse_wire_hints(
+            find_annotation(app.annotations, "app:wire")
+        )
+        wire_hints = dict(declared)
+        for (sid, col), hint in declared.items():
+            if hint[0] != "range":
+                continue
+            schema = sym.streams.get(sid)
+            atype = schema.get(col) if schema else None
+            declared_facts.setdefault(sid, {})[col] = ValueFact(
+                lo=int(hint[1]), hi=int(hint[2]), atype=atype
+            )
+    except Exception:  # pragma: no cover - defect guard
+        declared_facts = {}
     if values is not None:
         try:
             from siddhi_tpu.analysis.values import infer_wire_hints
 
-            wire_hints = infer_wire_hints(values, sym)
+            wire_hints.update(infer_wire_hints(values, sym))
         except Exception:  # pragma: no cover - defect guard
-            wire_hints = {}
+            pass
 
     produced = produced_streams(app)
     for qid, q, in_part in iter_query_entries(app):
         model.queries[qid] = _query_cost(
-            q, qid, app, sym, B, in_part, produced, values
+            q, qid, app, sym, B, in_part, produced, values,
+            declared_facts=declared_facts,
         )
 
     for sid, schema in sym.streams.items():
@@ -746,6 +768,7 @@ def _query_cost(
     in_partition: bool,
     produced: set,
     values=None,
+    declared_facts: Optional[dict] = None,
 ) -> QueryCost:
     stream = q.input_stream
     operators: list[OperatorCost] = []
@@ -755,10 +778,14 @@ def _query_cost(
     kind = "single"
 
     def stream_facts(sid: str) -> Optional[dict]:
-        if values is None:
-            return None
-        facts = values.facts_for(sid)
-        return facts or None
+        # declared @app:wire range facts as the base; the value analysis
+        # (when supplied) overlays them with its at-least-as-tight facts
+        base = dict(declared_facts.get(sid, {})) if declared_facts else {}
+        if values is not None:
+            facts = values.facts_for(sid)
+            if facts:
+                base.update(facts)
+        return base or None
 
     def step_causes(extra_shapes: int) -> dict:
         causes = {"first_compile": 1}

@@ -783,6 +783,16 @@ class SiddhiAppRuntime:
     def _wire_fuse_candidate(self, junction, ep) -> None:
         """Register a FuseEndpoint on `junction` — staged during a
         hot-deploy build, exactly like _wire_subscribe."""
+        devices, axis = self._shard_conf
+        if devices >= 2 and axis == "keys":
+            # keyed-sharded state (parallel/keyshard.py) steps under its
+            # own shard_map program: a fused chunk body would bypass it.
+            # Runtime analog of the planner's H_KEYSHARD blocker.
+            from siddhi_tpu.parallel.keyshard import keyed_shardable
+
+            ok, _why = keyed_shardable(ep.qr)
+            if ok:
+                return
         if self._staged_wiring is not None:
             self._staged_wiring.append(
                 lambda _j=junction, _e=ep: _j.fuse_candidates.append(_e)
@@ -1922,6 +1932,7 @@ class SiddhiAppRuntime:
                     wire_spec=spec, wire_enabled=self._wire_enabled,
                 )
         if self._shard is not None:
+            self._shard.rearm_keyshard()
             self._shard.rearm_routers()
         # re-pair the calibration ledger against the AST that just formed
         # these engines: churn splices and fused re-formations re-price

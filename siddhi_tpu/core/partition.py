@@ -87,6 +87,10 @@ class PartitionedQueryRuntime(QueryRuntime):
             group_capacity=group_capacity, tables={},
         )
         self.p = int(p_capacity)
+        # the DECLARED capacity: parallel/shard.py may pad self.p up to a
+        # multiple of the mesh size with dead lanes; the shared ptable (and
+        # so assign_slots' overflow threshold) stays at p_logical
+        self.p_logical = self.p
         self.key_of = key_of
         self.inner_publish = None  # set when inserting into an #inner stream
         self._pstep_outer = jax.jit(self._pstep_outer_impl, donate_argnums=(1,))
@@ -116,6 +120,11 @@ class PartitionedQueryRuntime(QueryRuntime):
         pk, pu, pn, slot, _grp, povf = assign_slots(
             ptable["keys"], ptable["used"], ptable["n"], keys, active
         )
+        # overflow remap: assign_slots' dead slot equals the ptable
+        # capacity (= p_logical); when the [P] axis is padded for mesh
+        # divisibility that index is a real (dead) lane, so push overflow
+        # past every lane
+        slot = jnp.where(slot >= self.p_logical, jnp.int32(self.p), slot)
         is_timer = batch.valid & (batch.kind == KIND_TIMER)
 
         def make_valid(p):

@@ -230,7 +230,14 @@ class SnapshotService:
 
         for qid, qr in rt.queries.items():
             if qr.state is not None:
-                out[f"query:{qid}"] = qr.state
+                ks = getattr(qr, "_keyshard", None)
+                if ks is not None:
+                    # canonical single-device form (parallel/keyshard.py):
+                    # mesh-size independent, so a restore re-hashes keys to
+                    # whatever mesh the restoring app runs on (rebalance)
+                    out[f"query:{qid}"] = ks.export_state(qr.state)
+                else:
+                    out[f"query:{qid}"] = qr.state
             rl = getattr(qr, "rate_limiter", None)
             if rl is not None:
                 # deep copy: the live buffers keep mutating once the process
@@ -253,7 +260,12 @@ class SnapshotService:
             if kind == "query":
                 qr = rt.queries.get(name)
                 if qr is not None:
-                    qr.state = _to_device(value)
+                    ks = getattr(qr, "_keyshard", None)
+                    if ks is not None:
+                        # re-hash the canonical group table onto THIS mesh
+                        qr.state = ks.import_state(value)
+                    else:
+                        qr.state = _to_device(value)
             elif kind == "rate":
                 qr = rt.queries.get(name)
                 rl = getattr(qr, "rate_limiter", None) if qr else None

@@ -304,6 +304,10 @@ class BaseQueryRuntime:
         # (core/ingest.py): called before every donated-state per-batch step
         # to split chain buffers a fused dispatch aliased across queries
         self._unshare_guard: Optional[Callable] = None
+        # armed by parallel/keyshard.py (@app:shard axis='keys'): the
+        # KeyShardedGroupExec that replaced self._step and owns the [D]
+        # state layout, occupancy gauges and the snapshot canonical form
+        self._keyshard = None
         # device-budget trackers (wired by the app runtime when statistics
         # are on): jitted-step dispatch time and host-blocking decode stalls
         self.device_step_tracker = None
@@ -820,6 +824,8 @@ class QueryRuntime(BaseQueryRuntime):
 
     def describe_state(self) -> dict:
         d = super().describe_state()
+        if self._keyshard is not None:
+            d["keyshard"] = self._keyshard.describe_state()
         win = self.chain.window
         if win is not None:
             # under the receive lock: the step donates the old state buffers,
@@ -906,7 +912,10 @@ class QueryRuntime(BaseQueryRuntime):
             self._unshare_guard()
         with self._receive_lock:
             if self.state is None:
-                self.state = self._fresh(self.init_state())
+                ks = self._keyshard
+                self.state = self._fresh(
+                    ks.init_state() if ks is not None else self.init_state()
+                )
             tstates = self._collect_table_states()
             timed = self._need_step_clock()
             if timed:

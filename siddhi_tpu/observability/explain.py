@@ -422,6 +422,16 @@ def _query_counters(
         pl = shard_rt.partitioned.get(qid)
         if pl is not None:
             counters["shard"] = pl
+        # key-sharded group-by / join state (parallel/keyshard.py): static
+        # placement plus the live per-device key-occupancy gauges
+        ks = shard_rt.keyshard.get(qid) or shard_rt.joins.get(qid)
+        if ks is not None:
+            entry = dict(ks)
+            qr = runtime.queries.get(qid)
+            ex = getattr(qr, "_keyshard", None) if qr is not None else None
+            if ex is not None:
+                entry.update(ex.describe_state())
+            counters["keyshard"] = entry
     # live lineage fan-in (observability/lineage.py): rendered even with
     # statistics off — @app:lineage has its own gate
     if runtime is not None:
@@ -519,6 +529,21 @@ def _fmt_counters(c: Optional[dict]) -> str:
             )
         else:
             parts.append(f"shard[off: {s.get('reason')}]")
+    if "keyshard" in c:
+        k = c["keyshard"]
+        if k.get("sharded", True):
+            extra = ""
+            if "per_device_keys" in k:
+                extra = (
+                    f" keys={k['per_device_keys']}"
+                    f" skew={k.get('skew')}"
+                )
+            parts.append(
+                f"keyshard[devices={k.get('devices')}"
+                f" axis={k.get('axis')}{extra}]"
+            )
+        else:
+            parts.append(f"keyshard[off: {k.get('reason')}]")
     if "wire" in c:
         w = c["wire"]
         encs = " ".join(

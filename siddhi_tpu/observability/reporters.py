@@ -167,6 +167,18 @@ _FAMILIES = {
         "gauge",
         "Per-device share of a batch-sharded junction's events, "
         "normalized so 1.0 = a perfectly even split across the mesh"),
+    "siddhi_keyshard_device_keys": (
+        "gauge",
+        "Group keys owned by each mesh device of a key-sharded query "
+        "(parallel/keyshard.py; device label: mesh position)"),
+    "siddhi_keyshard_occupancy": (
+        "gauge",
+        "Per-device group-table fill of a key-sharded query "
+        "(owned keys / group capacity)"),
+    "siddhi_keyshard_skew": (
+        "gauge",
+        "Key-ownership skew of a key-sharded query: max per-device keys "
+        "over the even-split mean (1.0 = perfectly balanced)"),
     "siddhi_watermark_ms": (
         "gauge",
         "Per-source-stream event-time watermark (max event time minus the "
@@ -309,6 +321,25 @@ def render_prometheus(reports: list[dict]) -> str:
                         f"{_labels(app=app, component=n, device=str(d))}"
                         f" {occ[d]}"
                     )
+            # key-sharded query entries (parallel/keyshard.py) carry
+            # per_device_keys instead of dispatch counters
+            kocc = ent.get("occupancy", []) if "per_device_keys" in ent else []
+            for d, v in enumerate(ent.get("per_device_keys", [])):
+                body["siddhi_keyshard_device_keys"].append(
+                    "siddhi_keyshard_device_keys"
+                    f"{_labels(app=app, component=n, device=str(d))} {v}"
+                )
+                if d < len(kocc):
+                    body["siddhi_keyshard_occupancy"].append(
+                        "siddhi_keyshard_occupancy"
+                        f"{_labels(app=app, component=n, device=str(d))}"
+                        f" {kocc[d]}"
+                    )
+            if "skew" in ent and "per_device_keys" in ent:
+                body["siddhi_keyshard_skew"].append(
+                    f"siddhi_keyshard_skew{_labels(app=app, component=n)}"
+                    f" {ent['skew']}"
+                )
         for n, ent in rep.get("pipeline", {}).items():
             body["siddhi_pipeline_occupancy"].append(
                 f"siddhi_pipeline_occupancy{_labels(app=app, component=n)}"

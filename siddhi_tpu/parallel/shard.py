@@ -58,8 +58,9 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 SHARD_ENV = "SIDDHI_TPU_SHARD"
+SHARD_AXIS_ENV = "SIDDHI_TPU_SHARD_AXIS"
 MAX_DEVICES = 64
-_AXES = ("auto", "part", "batch")
+_AXES = ("auto", "part", "batch", "keys")
 
 
 # ---------------------------------------------------------------------------
@@ -82,11 +83,28 @@ def shard_env_override() -> Optional[int]:
         return None
 
 
+def shard_axis_override() -> Optional[str]:
+    """Process-wide axis override (SIDDHI_TPU_SHARD_AXIS): one of the
+    `_AXES` names, or None to defer to the app's @app:shard annotation.
+    Lets CI drive the same app through every placement strategy."""
+    v = os.environ.get(SHARD_AXIS_ENV, "").strip().lower()
+    if not v:
+        return None
+    if v not in _AXES:
+        log.warning(
+            "ignoring malformed %s=%r (expected one of %s)",
+            SHARD_AXIS_ENV, v, ", ".join(_AXES),
+        )
+        return None
+    return v
+
+
 def iter_shard_annotation_problems(ann):
     """Yield one message per malformed `@app:shard` element — THE validation
     rules, shared by the runtime resolver (raises on the first) and the
     analyzer's SA129 diagnostics (reports them all), so the two can never
-    drift. Accepted shapes: @app:shard(devices='N'[, axis='part|batch|auto'])
+    drift. Accepted shapes:
+    @app:shard(devices='N'[, axis='part|batch|keys|auto'])
     or the sole-positional @app:shard('N')."""
     sole_positional = len(ann.elements) == 1 and ann.elements[0][0] is None
     for k, v in ann.elements:
@@ -136,6 +154,9 @@ def resolve_shard_annotation(ann) -> tuple[int, str]:
     env = shard_env_override()
     if env is not None:
         devices = env
+    env_axis = shard_axis_override()
+    if env_axis is not None:
+        axis = env_axis
     return devices, axis
 
 
@@ -442,18 +463,33 @@ def apply_partition_mesh(app_runtime, devices) -> dict:
                 # scoped out until the mesh contract covers their timers)
                 continue
             qid = qr.query_id
+            padded = 0
             if qr.p % D != 0:
-                log.warning(
-                    "query '%s': @app:partitionCapacity %d is not divisible "
-                    "by the shard device count %d; the partition axis stays "
-                    "on one device (set a multiple of %d)",
-                    qid, qr.p, D, D,
+                if qr.state is not None:
+                    # live [P] buffers can't be resized in place; only a
+                    # pre-first-event placement pads
+                    placed[qid] = {
+                        "sharded": False,
+                        "reason": (
+                            f"partitionCapacity {qr.p} % devices {D} != 0 "
+                            "with live state"
+                        ),
+                    }
+                    continue
+                # pad the [P] axis to the next multiple of D with DEAD
+                # slots: the shared ptable keeps its original capacity so
+                # key->slot allocation (and its overflow threshold) is
+                # untouched, and the padded lanes behave exactly like
+                # never-allocated lanes — timer rows run on fresh init
+                # state and emit nothing, so emissions stay byte-identical
+                target = -(-qr.p // D) * D
+                padded = target - qr.p
+                log.info(
+                    "query '%s': padding @app:partitionCapacity %d to %d "
+                    "(%d dead slot(s)) for the %d-device mesh",
+                    qid, qr.p, target, padded, D,
                 )
-                placed[qid] = {
-                    "sharded": False,
-                    "reason": f"partitionCapacity {qr.p} % devices {D} != 0",
-                }
-                continue
+                qr.p = target
             if mesh is None:
                 mesh = Mesh(np.array(devices), ("part",))
             shard = NamedSharding(mesh, P("part"))
@@ -478,6 +514,8 @@ def apply_partition_mesh(app_runtime, devices) -> dict:
                 "axis": "part",
                 "local_slots": qr.p // D,
             }
+            if padded:
+                placed[qid]["padded_slots"] = padded
     return placed
 
 
@@ -511,6 +549,8 @@ class ShardRuntime:
         self.devices = devs[:n]
         self.partitioned: dict = {}
         self.routers: dict = {}
+        self.keyshard: dict = {}
+        self.joins: dict = {}
 
     @property
     def n(self) -> int:
@@ -523,9 +563,26 @@ class ShardRuntime:
                 "available)", self.app.name, self.n,
             )
             return
-        if self.axis in ("auto", "part"):
+        if self.axis in ("auto", "part", "keys"):
             self.partitioned = apply_partition_mesh(self.app, self.devices)
+        self.rearm_keyshard()
         self.rearm_routers()
+
+    def rearm_keyshard(self) -> None:
+        """(Re)arm key-sharded group-by and join state (axis='keys' only —
+        parallel/keyshard.py). Called by apply() at start AND by the churn
+        splice after fused engines are rebuilt: a hot-deployed grouped
+        query (state still None) gets armed before its first event;
+        already-armed queries keep their live [D] state and jitted step."""
+        if self.n < 2 or self.axis != "keys":
+            return
+        from siddhi_tpu.parallel.keyshard import (
+            apply_join_mesh,
+            apply_keyshard,
+        )
+
+        self.keyshard.update(apply_keyshard(self.app, self.devices))
+        self.joins.update(apply_join_mesh(self.app, self.devices))
 
     def rearm_routers(self) -> None:
         """(Re)arm batch-axis routers on every eligible fused ingest
@@ -571,4 +628,14 @@ class ShardRuntime:
             d["streams"] = {
                 sid: r.describe_state() for sid, r in self.routers.items()
             }
+        if self.keyshard:
+            ks = {}
+            for qid, info in self.keyshard.items():
+                qr = self.app.queries.get(qid)
+                ex = getattr(qr, "_keyshard", None)
+                live = ex.describe_state() if ex is not None else {}
+                ks[qid] = {**info, **live}
+            d["keyshard"] = ks
+        if self.joins:
+            d["joins"] = dict(self.joins)
         return d

@@ -71,6 +71,18 @@ CASES = {
         insert into Out;
         """,
     ),
+    # windowless running aggregation with exact (integer) aggregators —
+    # the query class the keys axis actually key-shards
+    "keyed_group_by": (
+        WM + """
+        define stream S (sym string, price double, vol long);
+        @info(name='q')
+        from S
+        select sym, sum(vol) as v, count() as n, max(vol) as hi
+        group by sym
+        insert into Out;
+        """,
+    ),
 }
 
 
@@ -120,11 +132,16 @@ def _run_case(ql, disorder: bool):
 
 
 @pytest.mark.parametrize("fuse", ["1", "0"])
-@pytest.mark.parametrize("shard", ["8", "0"])
+@pytest.mark.parametrize("shard", ["8", "8:keys", "0"])
 @pytest.mark.parametrize("case", sorted(CASES))
 def test_disorder_parity(case, fuse, shard, monkeypatch):
     monkeypatch.setenv("SIDDHI_TPU_FUSE", fuse)
-    monkeypatch.setenv("SIDDHI_TPU_SHARD", shard)
+    devices, _, axis = shard.partition(":")
+    monkeypatch.setenv("SIDDHI_TPU_SHARD", devices)
+    if axis:
+        monkeypatch.setenv("SIDDHI_TPU_SHARD_AXIS", axis)
+    else:
+        monkeypatch.delenv("SIDDHI_TPU_SHARD_AXIS", raising=False)
     (ql,) = CASES[case]
     ordered, _ = _run_case(ql, disorder=False)
     shuffled, status = _run_case(ql, disorder=True)

@@ -375,14 +375,17 @@ class TestPartitionMesh:
         assert len(sharded) > 800
         assert sharded == unsharded
 
-    def test_indivisible_capacity_stays_unsharded(self, monkeypatch):
-        # 32 % 6 != 0: the partition axis stays on one device, recorded
-        # with a reason, and results are unchanged
+    def test_indivisible_capacity_pads_to_mesh(self, monkeypatch):
+        # 32 % 6 != 0: the [P] axis is padded to 36 (6 local slots per
+        # device) with dead slots that no key ever hashes to a live
+        # position of — results byte-match the unsharded run
         monkeypatch.setenv("SIDDHI_TPU_SHARD", "6")
         sharded, status = _run_partitioned("", steps=8)
         placed = status["shard"]["partitioned"]["q"]
-        assert placed["sharded"] is False
-        assert "32 % devices 6" in placed["reason"]
+        assert placed == {
+            "sharded": True, "devices": 6, "axis": "part",
+            "local_slots": 6, "padded_slots": 4,
+        }
         monkeypatch.setenv("SIDDHI_TPU_SHARD", "0")
         unsharded, _ = _run_partitioned("", steps=8)
         assert sharded == unsharded
