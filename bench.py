@@ -1543,6 +1543,104 @@ def _leg_disorder(events: int) -> dict:
     }
 
 
+def _leg_blackbox(events: int, batch: int) -> dict:
+    """A/B cost of the always-on black-box recorder (ISSUE 20): the SAME
+    columnar feed runs with `@app:blackbox` armed and unarmed, reporting
+    the recorder's throughput overhead (ring writes are preallocated
+    column copies — the FlightRecorder budget), then fires a synthetic
+    incident and replays the frozen bundle in-process, reporting whether
+    the replay reproduced the live emissions byte-identical."""
+    import tempfile
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.observability.blackbox import (
+        attach_emission_collector, emissions_checksum, replay_incident,
+    )
+
+    n = max(4_096, min(int(events), 400_000))
+    base = 1_700_000_000_000
+    rng = np.random.default_rng(11)
+    ts = base + np.arange(n, dtype=np.int64) * 3
+    price = np.round(rng.uniform(5.0, 100.0, n), 2)
+    vol = rng.integers(1, 500, n).astype(np.int64)
+    ql = """
+    @app:name('bbbench')
+    {ann}
+    define stream S (price double, vol long);
+    @info(name='q')
+    from S[price > 20.0]#window.length(64)
+    select sum(price) as total, count() as cnt insert into Out;
+    """
+
+    def run(armed: bool, bb_dir: str) -> dict:
+        ann = (
+            f"@app:blackbox(window='30 sec', triggers='crash', "
+            f"ring='65536', keep='2', dir='{bb_dir}')" if armed else ""
+        )
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql.format(ann=ann))
+        rows = [0]
+        rt.add_callback("Out", lambda evs: rows.__setitem__(
+            0, rows[0] + len(evs)
+        ))
+        rt.start()
+        h = rt.get_input_handler("S")
+        t0 = time.perf_counter()
+        for i in range(0, n, batch):
+            h.send_columns(
+                ts[i:i + batch],
+                {"price": price[i:i + batch], "vol": vol[i:i + batch]},
+            )
+        wall = time.perf_counter() - t0
+        out = {
+            "events_per_s": n / wall if wall > 0 else 0.0,
+            "rows": rows[0],
+        }
+        if armed:
+            iid = rt._blackbox.fire("crash", "bench synthetic")
+            out["incident"] = iid
+            out["bundle"] = rt.incidents()[-1]["path"] if iid else None
+        mgr.shutdown()
+        return out
+
+    with tempfile.TemporaryDirectory(prefix="bench_blackbox_") as d:
+        off = run(False, d)
+        on = run(True, d)
+        parity = False
+        replay_rows = 0
+        if on.get("bundle"):
+            # the synthetic incident's ring only holds the last `ring`
+            # rows; replay that tail against a fresh live run of the tail
+            replay = replay_incident(on["bundle"])
+            tail = min(n, 65536)
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(ql.format(ann=""))
+            ref = attach_emission_collector(rt)
+            rt.start()
+            rt.get_input_handler("S").send_columns(
+                ts[n - tail:],
+                {"price": price[n - tail:], "vol": vol[n - tail:]},
+            )
+            mgr.shutdown()
+            replay_rows = sum(len(v) for v in replay.emissions.values())
+            parity = (
+                replay.emissions == ref
+                and replay.checksum() == emissions_checksum(ref)
+            )
+    ratio = (
+        on["events_per_s"] / off["events_per_s"]
+        if off["events_per_s"] else 0.0
+    )
+    return {
+        "blackbox": round(on["events_per_s"], 1),
+        "blackbox_off_events_per_s": round(off["events_per_s"], 1),
+        "blackbox_overhead_ratio": round(ratio, 3),
+        "blackbox_rows_match": on["rows"] == off["rows"],
+        "blackbox_replay_rows": replay_rows,
+        "blackbox_replay_parity": parity,
+    }
+
+
 def _run_leg(name: str, args) -> dict:
     if name in WORKLOADS or name.endswith("_delivered"):
         v = _leg_throughput(name, args.events, args.batch)
@@ -1560,6 +1658,8 @@ def _run_leg(name: str, args) -> dict:
         return _leg_calibration()
     if name == "verify_cases":
         return _leg_verify()
+    if name == "blackbox":
+        return _leg_blackbox(args.events, args.batch)
     if name == "disorder":
         return _leg_disorder(args.events)
     if name == "verify":

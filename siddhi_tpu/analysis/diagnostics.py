@@ -94,6 +94,9 @@ CODES: dict[str, str] = {
     "SA139": "malformed @app:slo annotation: unknown option, invalid "
              "objective/window/burn threshold, no objective at all, or a "
              "user definition of the reserved SloAlertStream",
+    "SA140": "invalid @app:blackbox annotation (bad window / unknown "
+             "trigger / bad keep or ring / bad checkpoint.interval or "
+             "debounce / unknown option)",
     # typing
     "SA201": "incompatible comparison operand types",
     "SA202": "arithmetic on a non-numeric operand",

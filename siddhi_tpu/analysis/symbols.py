@@ -213,6 +213,24 @@ def _check_supervision_annotations(
             diags.append(Diagnostic(code, problem))
 
 
+def _check_blackbox_annotation(app: SiddhiApp, diags: list[Diagnostic]) -> None:
+    """Validate `@app:blackbox(window='...', triggers='...', keep='N',
+    ring='N', dir='...', checkpoint.interval='...', debounce='...')` — the
+    black-box incident recorder. One SA140 per malformed element, using
+    the SAME rule set the runtime resolver raises on
+    (observability/blackbox.py iter_blackbox_annotation_problems), so the
+    two can never drift."""
+    ann = find_annotation(app.annotations, "app:blackbox")
+    if ann is None:
+        return
+    from siddhi_tpu.observability.blackbox import (
+        iter_blackbox_annotation_problems,
+    )
+
+    for problem in iter_blackbox_annotation_problems(ann):
+        diags.append(Diagnostic("SA140", problem))
+
+
 def _apply_selfmon_annotation(
     app: SiddhiApp, sym: SymbolTable, diags: list[Diagnostic]
 ) -> None:
@@ -389,5 +407,6 @@ def build_symbols(app: SiddhiApp, diags: list[Diagnostic]) -> SymbolTable:
     _check_wire_annotation(app, sym, diags)
     _check_watermark_annotation(app, diags)
     _check_supervision_annotations(app, diags)
+    _check_blackbox_annotation(app, diags)
 
     return sym

@@ -152,6 +152,10 @@ class AdmissionController:
         self.shed = 0
         self.blocked_ms = 0.0
         self.rejected = 0
+        # black-box trigger hook (observability/blackbox.py): called with
+        # ('admission', detail) when events are shed; None = one attribute
+        # check (the recorder's debounce absorbs shed bursts)
+        self.on_incident = None
 
     # ---- token bucket ----------------------------------------------------
 
@@ -241,6 +245,14 @@ class AdmissionController:
         # queued events destroyed to make room were admitted once — they
         # count as shed too, or the meter under-reports the loss
         self.shed += dropped + queued_shed
+        if dropped + queued_shed:
+            oi = self.on_incident
+            if oi is not None:
+                oi(
+                    "admission",
+                    f"shed {dropped + queued_shed} events "
+                    f"(policy={policy}, total_shed={self.shed})",
+                )
         if policy == "shed_oldest":
             # keep the TAIL: the freshest events survive
             return dropped, n

@@ -198,6 +198,24 @@ class SiddhiAppRuntime:
             if self._lineage_cfg is not None
             else None
         )
+        # black-box incident recorder: @app:blackbox(window, triggers,
+        # keep, ...) (observability/blackbox.py; malformed options raise
+        # here — the runtime analog of the analyzer's SA140). Resolved
+        # BEFORE any junction construction so _junction() arms a seq-lane
+        # ring on every junction, the lineage precedent.
+        from siddhi_tpu.observability.blackbox import (
+            BlackboxRecorder,
+            resolve_blackbox_annotation,
+        )
+
+        self._blackbox_cfg = resolve_blackbox_annotation(
+            find_annotation(app.annotations, "app:blackbox")
+        )
+        self._blackbox = (
+            BlackboxRecorder(self, self._blackbox_cfg)
+            if self._blackbox_cfg is not None
+            else None
+        )
         # first-class sharded execution: @app:shard(devices='N', axis=...)
         # / SIDDHI_TPU_SHARD (parallel/shard.py; malformed options raise
         # here — the runtime analog of the analyzer's SA129). Resolved now,
@@ -243,6 +261,8 @@ class SiddhiAppRuntime:
             self._admission = AdmissionController(
                 self.name, resolve_admission_annotation(aa)
             )
+            if self._blackbox is not None:  # shed spikes freeze incidents
+                self._admission.on_incident = self._blackbox.fire
         # supervision health hook (core/supervision.AppHealth), installed by
         # Supervisor.attach(); _junction() wires it onto every junction
         self._health = None
@@ -767,6 +787,10 @@ class SiddhiAppRuntime:
             # multi-hop resolution can walk any chain
             if self._lineage_cfg is not None:
                 j.enable_lineage(self._lineage_cfg.capacity)
+            # @app:blackbox arms a seq-lane incident ring on EVERY junction
+            # — the incident bundle must carry every stream's last window
+            if self._blackbox is not None:
+                self._blackbox.arm(j)
             self.junctions[stream_id] = j
         return j
 
@@ -1702,6 +1726,8 @@ class SiddhiAppRuntime:
             status["admission"] = self._admission.describe_state()
         if self._autopersist is not None:
             status["autopersist"] = self._autopersist.describe_state()
+        if self._blackbox is not None:
+            status["blackbox"] = self._blackbox.describe_state()
         health = getattr(self, "_health", None)
         if health is not None:
             status["health"] = health.describe_state()
@@ -1739,6 +1765,26 @@ class SiddhiAppRuntime:
             for sid, j in list(self.junctions.items())
             if j.flight is not None
         }
+
+    # ---- black box & incident replay (observability/blackbox.py) ----------
+
+    def incidents(self) -> list[dict]:
+        """Incident bundles frozen by this runtime's black-box recorder,
+        oldest first (empty when @app:blackbox is not armed)."""
+        if self._blackbox is None:
+            return []
+        return self._blackbox.incident_index()
+
+    def replay_incident(self, bundle, debug: bool = False, streams=None):
+        """Deterministically replay an incident bundle (dict or path):
+        rebuild the app from the bundle's retained AST under
+        @app:playback, restore the pinned checkpoint, and re-feed the
+        recorded rings in arrival order. With `debug=True` the returned
+        IncidentReplay holds a live runtime with a SiddhiDebugger
+        attached and feeding deferred to the caller."""
+        from siddhi_tpu.observability.blackbox import replay_incident
+
+        return replay_incident(bundle, debug=debug, streams=streams)
 
     # ---- lineage & provenance (observability/lineage.py) ------------------
 
@@ -2090,6 +2136,11 @@ class SiddhiAppRuntime:
                 )
             else:
                 self._autopersist.start()
+        # @app:blackbox checkpoint pinner: pin the first base checkpoint
+        # and re-pin every checkpoint.interval (default: window) so ring +
+        # checkpoint always cover a coherent replayable interval
+        if self._blackbox is not None:
+            self._blackbox.start()
         # lifecycle ordering (reference: SiddhiAppRuntime.start:353-394):
         # sinks connect before sources so no event finds a dead egress;
         # triggers and sources begin last, into fully-wired queries

@@ -654,6 +654,10 @@ class FusedJunctionIngest:
             fl = self.junction.flight
             if ok and fl is not None:
                 fl.record_columns(ts_arr, cols, n)
+            bb = self.junction.blackbox
+            if ok and bb is not None:
+                # black-box ring: same once-per-commit contract
+                bb.record_columns(ts_arr, cols, n)
             la = self.junction.lineage
             if ok and la is not None:
                 # lineage stamp: the fused commit is this send's one

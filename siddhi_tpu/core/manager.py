@@ -245,8 +245,10 @@ class SiddhiManager:
         `/status.json`), flight-recorder rings (`/flight`), the continuous
         profiler (`/profile`), EXPLAIN ANALYZE plans (`/explain`,
         `/explain.json`), the plan-vs-actual calibration ledger
-        (`/calibration`, `/calibration.json`), and SLO burn rates (`/slo`,
-        `/slo.json`) for EVERY app runtime registered on this manager. Idempotent: a second call
+        (`/calibration`, `/calibration.json`), SLO burn rates (`/slo`,
+        `/slo.json`), and black-box incident bundles (`/incidents`,
+        `/incidents/<id>.json`) for EVERY app runtime registered on this
+        manager. Idempotent: a second call
         returns the already-bound port. Pass port=0 for an ephemeral port;
         the bound port is returned either way."""
         if self._metrics_server is not None:
@@ -336,6 +338,37 @@ class SiddhiManager:
                 "# TYPE siddhi_churn_total counter\n"
                 + "\n".join(churn_lines) + "\n"
             )
+        # black-box families (observability/blackbox.py): incident counts
+        # per armed trigger + per-stream ring totals
+        from siddhi_tpu.observability.reporters import render_raw_family
+
+        inc_lines, ring_lines = [], []
+        for name, rt in list(self._runtimes.items()):
+            bb = getattr(rt, "_blackbox", None)
+            if bb is None:
+                continue
+            for trig, v in sorted(bb.incidents_total.items()):
+                inc_lines.append(
+                    f'siddhi_incidents_total{{app="{name}",trigger="{trig}"}}'
+                    f" {v}"
+                )
+            for sid, j in list(rt.junctions.items()):
+                if j.blackbox is not None:
+                    ring_lines.append(
+                        "siddhi_blackbox_ring_events"
+                        f'{{app="{name}",stream="{sid}"}} '
+                        f"{j.blackbox.describe_state()['total']}"
+                    )
+        text += render_raw_family(
+            "siddhi_incidents_total", "counter",
+            "Black-box incident bundles frozen, per armed trigger",
+            inc_lines,
+        )
+        text += render_raw_family(
+            "siddhi_blackbox_ring_events", "counter",
+            "Events recorded into each stream's black-box ring",
+            ring_lines,
+        )
         return text
 
     def profile_reports(self) -> list:
@@ -434,6 +467,46 @@ class SiddhiManager:
         from siddhi_tpu.observability.introspect import render_status
 
         return render_status(self.snapshot_status())
+
+    def incidents(self) -> dict:
+        """Every @app:blackbox-armed app's frozen incident bundles:
+        app -> {"incidents": {trigger: count}, "bundles": [...]} — served
+        as `/incidents(.json)` by `serve_metrics()`."""
+        out = {}
+        for name, rt in list(self._runtimes.items()):
+            bb = getattr(rt, "_blackbox", None)
+            if bb is None:
+                continue
+            out[name] = {
+                "incidents": dict(bb.incidents_total),
+                "bundles": bb.incident_index(),
+            }
+        return out
+
+    def incident_detail(self, incident_id: str):
+        """JSON-safe summary of one frozen bundle by id (checkpoint bytes
+        and pickled AST elided) — `/incidents/<id>.json`; None when no
+        recorder on this manager knows the id."""
+        from siddhi_tpu.observability.blackbox import (
+            bundle_summary,
+            load_bundle,
+        )
+
+        for rt in list(self._runtimes.values()):
+            bb = getattr(rt, "_blackbox", None)
+            if bb is None:
+                continue
+            for rec in bb.incident_index():
+                if rec["id"] == incident_id:
+                    try:
+                        return bundle_summary(load_bundle(rec["path"]))
+                    except Exception as e:
+                        return {
+                            "id": incident_id,
+                            "error": f"{type(e).__name__}: {e}",
+                            "path": rec["path"],
+                        }
+        return None
 
     def flight_records(self) -> dict:
         """Every app's recorded flight rings: app -> stream -> [(ts, row)]."""

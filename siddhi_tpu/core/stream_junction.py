@@ -80,6 +80,14 @@ class StreamJunction:
         # keeps the last N decodable, opt-in via @app:lineage; None = one
         # attribute check on the hot path (same contract as flight)
         self.lineage = None
+        # black-box incident ring (observability.blackbox.BlackboxRing):
+        # seq-stamped ring of the last events through this junction,
+        # opt-in via @app:blackbox; None = one attribute check on the hot
+        # path (same contract as flight/lineage). on_incident is the
+        # recorder's trigger hook — called with (trigger, detail) on
+        # dispatch failures and unguarded crashes.
+        self.blackbox = None
+        self.on_incident: Callable[[str, str], None] | None = None
         # user hook for subscriber failures (reference: the pluggable
         # Disruptor ExceptionHandler, SiddhiAppRuntime.java:664)
         self.exception_handler: Callable[[Exception], None] | None = None
@@ -123,6 +131,16 @@ class StreamJunction:
 
         self.lineage = LineageArena(self.schema, self.interner, size)
 
+    def enable_blackbox(self, size: int, counter) -> None:
+        """Attach a black-box incident ring of the last `size` events,
+        seq-stamped from the app-wide arrival `counter`. Idempotent for an
+        unchanged size (recorded history must survive re-arming)."""
+        if self.blackbox is not None and self.blackbox.size == int(size):
+            return
+        from siddhi_tpu.observability.blackbox import BlackboxRing
+
+        self.blackbox = BlackboxRing(self.schema, self.interner, size, counter)
+
     def describe_state(self) -> dict:
         """Cheap live-state snapshot (no device reads): queue depth, wiring,
         async worker health, fused/pipeline engagement, flight ring."""
@@ -148,6 +166,8 @@ class StreamJunction:
             d["flight"] = self.flight.describe_state()
         if self.lineage is not None:
             d["lineage"] = self.lineage.describe_state()
+        if self.blackbox is not None:
+            d["blackbox"] = self.blackbox.describe_state()
         return d
 
     def subscribe(self, fn: Subscriber, name: str | None = None) -> None:
@@ -326,6 +346,19 @@ class StreamJunction:
         )
         if self.on_error_stats is not None:
             self.on_error_stats(1)
+        oi = self.on_incident
+        if (
+            oi is not None
+            and self.exception_handler is None
+            and self.fault_policy is None
+        ):
+            # unowned worker poison = crash incident (same ownership rule
+            # as the supervisor health signal below)
+            oi(
+                "crash",
+                f"{who} for stream '{self.schema.stream_id}': "
+                f"{type(exc).__name__}: {exc}",
+            )
         nf = self.on_fatal
         if (
             nf is not None
@@ -411,6 +444,9 @@ class StreamJunction:
             fl = self.flight
             if fl is not None:
                 fl.record_batch(batch)
+            bb = self.blackbox
+            if bb is not None:
+                bb.record_batch(batch)
             la = self.lineage
             seq_range = None
             if la is not None:
@@ -523,8 +559,16 @@ class StreamJunction:
                     fn(batch, now)
                 except Exception as e:
                     if not guarded:
-                        # unguarded: a fatal health signal for the
-                        # supervisor, then on to the sender
+                        # unguarded: freeze a crash incident and raise a
+                        # fatal health signal for the supervisor, then on
+                        # to the sender
+                        oi = self.on_incident
+                        if oi is not None:
+                            oi(
+                                "crash",
+                                f"stream '{self.schema.stream_id}' dispatch "
+                                f"to {name}: {type(e).__name__}: {e}",
+                            )
                         nf = self.on_fatal
                         if nf is not None:
                             nf(e, f"dispatch to {name}")
@@ -581,6 +625,14 @@ class StreamJunction:
         import logging
 
         log = logging.getLogger(__name__)
+        oi = self.on_incident
+        if oi is not None:  # black box: a dispatch failure is an incident
+            oi(
+                "dispatch_error",
+                f"stream '{self.schema.stream_id}'"
+                + (f" subscriber {subscriber}" if subscriber else "")
+                + f": {type(exc).__name__}: {exc}",
+            )
         if self.on_error_stats is not None:
             self.on_error_stats(1)
         factory = self.error_stats_factory

@@ -320,11 +320,20 @@ class AppHealth:
         self._notify = notify  # Supervisor wake-up
         self.fatal = collections.deque(maxlen=32)  # (ts_ms, who, error)
         self.flagged = False
+        # black-box trigger hook (observability/blackbox.py), wired by
+        # Supervisor.attach when the app is @app:blackbox-armed: a fatal
+        # signal freezes a crash incident before the restart tears the
+        # runtime (and its rings) down. The recorder's debounce absorbs
+        # the overlap with the junction-level crash hook.
+        self.on_incident = None
 
     def mark_fatal(self, exc: BaseException, who: str) -> None:
         if failures_owned():
             return  # an upstream on.error policy will capture this failure
         try:
+            oi = self.on_incident
+            if oi is not None:
+                oi("crash", f"{who}: {type(exc).__name__}: {exc}")
             self.fatal.append(
                 (int(time.time() * 1000), who, f"{type(exc).__name__}: {exc}")
             )
@@ -339,6 +348,15 @@ class AppHealth:
             "fatal_signals": len(self.fatal),
             "last_fatal": list(self.fatal)[-1] if self.fatal else None,
         }
+
+
+def _incident_tag(rt) -> str:
+    """` [incident <id>]` when the crashed runtime froze a black-box
+    bundle for this episode — stamped into the supervisor's restart
+    records so /status.json links a crash to its post-mortem on disk."""
+    bb = getattr(rt, "_blackbox", None)
+    iid = getattr(bb, "last_incident_id", None) if bb is not None else None
+    return f" [incident {iid}]" if iid else ""
 
 
 def _probe_runtime(rt) -> Optional[str]:
@@ -443,6 +461,11 @@ class Supervisor:
             self._attempts.pop(rt.name, None)
             self._crash_seen_ms.pop(rt.name, None)
         health = AppHealth(rt.name, self._wake)
+        bb = getattr(rt, "_blackbox", None)
+        if bb is not None:
+            # a fatal signal freezes a crash incident bundle before the
+            # restart tears the rings down (observability/blackbox.py)
+            health.on_incident = bb.fire
         self._health[rt.name] = health
         rt._health = health
         for j in list(rt.junctions.values()):
@@ -530,7 +553,9 @@ class Supervisor:
         if policy.policy == "never":
             self._down.pop(name, None)
             self.gave_up[name] = f"policy=never ({reason})"
-            self.events.append((now_ms, name, f"not restarted: {reason}"))
+            self.events.append(
+                (now_ms, name, f"not restarted: {reason}{_incident_tag(rt)}")
+            )
             log.error(
                 "supervisor: app '%s' crashed (%s); @app:restart policy is "
                 "'never' — leaving it down", name, reason,
@@ -552,7 +577,9 @@ class Supervisor:
             self.gave_up[name] = (
                 f"max.attempts={policy.max_attempts} exhausted ({reason})"
             )
-            self.events.append((now_ms, name, f"gave up: {reason}"))
+            self.events.append(
+                (now_ms, name, f"gave up: {reason}{_incident_tag(rt)}")
+            )
             log.error(
                 "supervisor: app '%s' crashed (%s) but its restart budget "
                 "(max.attempts=%d) is exhausted — leaving it down",
@@ -588,7 +615,11 @@ class Supervisor:
             # _running liveness probe, so nothing else would re-trigger)
             self._down[name] = f"{type(e).__name__}: {e}"
             self.events.append(
-                (now_ms, name, f"restart failed: {type(e).__name__}: {e}")
+                (
+                    now_ms, name,
+                    f"restart failed: {type(e).__name__}: {e}"
+                    f"{_incident_tag(rt)}",
+                )
             )
             log.exception("supervisor: restart of app '%s' failed", name)
             return
@@ -596,7 +627,9 @@ class Supervisor:
         # this crash episode is over: the next crash is a fresh sighting
         self._crash_seen_ms.pop(name, None)
         self.restarts[name] = self.restarts.get(name, 0) + 1
-        self.events.append((now_ms, name, f"restarted: {reason}"))
+        self.events.append(
+            (now_ms, name, f"restarted: {reason}{_incident_tag(rt)}")
+        )
 
     def _do_restart(self, name: str, rt) -> None:
         """shutdown -> rebuild from the retained AST -> restore the last

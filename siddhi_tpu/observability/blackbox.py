@@ -1,0 +1,748 @@
+"""Black-box incident recorder & deterministic time-travel replay.
+
+`@app:blackbox(window='30 sec', triggers='slo,crash,dispatch_error,
+calibration,admission', keep='8')` arms a continuous flight-data recorder
+over the whole app: every junction gets a preallocated columnar ring (the
+FlightRecorder arena, plus a parallel seq lane stamped from one app-wide
+arrival counter so multi-stream interleave is recoverable), and a base
+checkpoint is re-pinned through the snapshot SPI every `window` so ring +
+checkpoint always cover a coherent interval. When an armed trigger fires —
+an SLO burn alert, an unguarded crash, a junction dispatch failure, a
+calibration mispricing transition, an admission shed — the recorder
+freezes a versioned **incident bundle** to disk: the trigger and its
+wall/event-time marks, the pinned checkpoint bytes, every ring's contents
+since the pin in global arrival order, and the app's live observability
+surfaces (`/status.json`, `/profile`, `/calibration.json`, `explain()`).
+
+`replay_incident(bundle)` is the other half: rebuild the app from the
+bundle's retained AST under `@app:playback`, restore the checkpoint,
+re-feed the source-stream rings in recorded seq order on the event-time
+clock, and reproduce the live run's emissions byte-identical (the
+order-preservation guarantees of the fused/sharded paths make this
+CI-diffable under FUSE/SHARD/WIRE). `debug=True` attaches the
+`core/debugger.py` step debugger to the rebuilt runtime so the exact
+query terminal that misbehaved can be breakpointed mid-replay.
+
+Zero-overhead contract: without the annotation every hook site pays one
+`is None` check (the flight/lineage/faults precedent). Retention: `keep`
+bundles per app, evicted oldest-first, so disk use is bounded.
+
+Replay scope: streams fed by queries (insert-into targets), engine-fed
+streams (selfmon/slo alerts), and fault streams are recorded for
+diagnosis but NOT re-fed — the replayed queries regenerate them; only
+external source streams are replayed. Apps whose emissions depend on
+wall-clock timers past the freeze point, or on live meter values
+(SelfMonitorStream/SloAlertStream consumers), fall outside the
+byte-identical contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import pickle
+import re
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from siddhi_tpu.observability.flight import FlightRecorder, _MAX_FLIGHT_SIZE
+
+logger = logging.getLogger(__name__)
+
+TRIGGERS = ("slo", "crash", "dispatch_error", "calibration", "admission")
+
+DEFAULT_WINDOW_MS = 30_000
+DEFAULT_KEEP = 8
+DEFAULT_RING = 4096
+DEFAULT_DEBOUNCE_MS = 1_000
+
+BUNDLE_VERSION = 1
+BLACKBOX_DIR_ENV = "SIDDHI_TPU_BLACKBOX_DIR"
+
+# annotations that must not survive into a replay runtime: the recorder
+# itself (no recursive incidents), admission (must not shed replayed
+# rows), statistics (no second metrics port), persist/restart (no store,
+# no supervisor), and any pre-existing playback config (replaced by ours)
+_STRIP_FOR_REPLAY = (
+    "app:blackbox",
+    "app:statistics",
+    "app:admission",
+    "app:persist",
+    "app:restart",
+    "app:playback",
+)
+
+
+# ---------------------------------------------------------------------------
+# annotation: one shared rule set (analyzer SA140 + runtime resolver)
+# ---------------------------------------------------------------------------
+
+
+def _time_ms(v) -> Optional[int]:
+    from siddhi_tpu.compiler.siddhi_compiler import SiddhiCompiler
+
+    try:
+        return SiddhiCompiler.parse_time_constant(str(v))
+    except Exception:
+        return None
+
+
+def iter_blackbox_annotation_problems(ann):
+    """Yield one message per malformed `@app:blackbox` element — THE
+    validation rules, shared by the runtime resolver (raises on the first)
+    and the analyzer's SA140 diagnostics (reports them all)."""
+    for k, v in ann.elements:
+        if k == "window" or k == "checkpoint.interval":
+            ms = _time_ms(v)
+            if ms is None or ms < 1000:
+                yield (
+                    f"@app:blackbox {k} '{v}' must be a time constant of "
+                    "at least 1 sec"
+                )
+        elif k == "debounce":
+            ms = _time_ms(v)
+            if ms is None:
+                yield (
+                    f"@app:blackbox debounce '{v}' must be a time constant"
+                )
+        elif k == "triggers":
+            names = [t.strip() for t in str(v).split(",") if t.strip()]
+            if not names:
+                yield "@app:blackbox triggers must name at least one trigger"
+            for t in names:
+                if t not in TRIGGERS:
+                    yield (
+                        f"unknown @app:blackbox trigger '{t}' (expected a "
+                        f"subset of {', '.join(TRIGGERS)})"
+                    )
+        elif k == "keep":
+            try:
+                ok = 1 <= int(v) <= 4096
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                yield (
+                    f"@app:blackbox keep '{v}' must be an integer in 1..4096"
+                )
+        elif k == "ring":
+            try:
+                ok = 1 <= int(v) <= _MAX_FLIGHT_SIZE
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                yield (
+                    f"@app:blackbox ring '{v}' must be an integer in "
+                    f"1..{_MAX_FLIGHT_SIZE}"
+                )
+        elif k == "dir":
+            if not str(v).strip():
+                yield "@app:blackbox dir must be a non-empty path"
+        else:
+            yield (
+                f"unknown @app:blackbox option '{k if k is not None else v}'"
+                " (expected window, triggers, keep, ring, dir, "
+                "checkpoint.interval, debounce)"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class BlackboxConfig:
+    window_ms: int = DEFAULT_WINDOW_MS
+    triggers: tuple = TRIGGERS
+    keep: int = DEFAULT_KEEP
+    ring: int = DEFAULT_RING
+    dir: Optional[str] = None
+    checkpoint_interval_ms: Optional[int] = None  # None -> window_ms
+    debounce_ms: int = DEFAULT_DEBOUNCE_MS
+
+    @property
+    def interval_ms(self) -> int:
+        return self.checkpoint_interval_ms or self.window_ms
+
+
+def resolve_blackbox_annotation(ann) -> Optional[BlackboxConfig]:
+    """BlackboxConfig from `@app:blackbox` (None when absent). Raises
+    SiddhiAppCreationError on the first malformed option — the runtime
+    analog of the analyzer's SA140 diagnostic."""
+    if ann is None:
+        return None
+    from siddhi_tpu.core.errors import SiddhiAppCreationError
+
+    for problem in iter_blackbox_annotation_problems(ann):
+        raise SiddhiAppCreationError(problem)
+    kw: dict = {}
+    for k, v in ann.elements:
+        if k == "window":
+            kw["window_ms"] = _time_ms(v)
+        elif k == "checkpoint.interval":
+            kw["checkpoint_interval_ms"] = _time_ms(v)
+        elif k == "debounce":
+            kw["debounce_ms"] = _time_ms(v)
+        elif k == "triggers":
+            kw["triggers"] = tuple(
+                t.strip() for t in str(v).split(",") if t.strip()
+            )
+        elif k == "keep":
+            kw["keep"] = int(v)
+        elif k == "ring":
+            kw["ring"] = int(v)
+        elif k == "dir":
+            kw["dir"] = str(v)
+    return BlackboxConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# rings
+# ---------------------------------------------------------------------------
+
+
+class SeqCounter:
+    """App-wide arrival counter: each recorded row takes one monotone seq
+    id, so multi-stream ring contents interleave deterministically at
+    replay."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def take(self, n: int) -> int:
+        with self._lock:
+            base = self.value
+            self.value += n
+            return base
+
+
+class BlackboxRing(FlightRecorder):
+    """FlightRecorder arena plus a parallel int64 seq lane. The seq block
+    for a batch is taken from the shared counter inside `_write` (under
+    the ring lock), so seq order equals recorded order per stream and the
+    global counter totally orders rows across streams."""
+
+    def __init__(self, schema, interner, size: int, counter: SeqCounter):
+        super().__init__(schema, interner, size)
+        self._seq = np.zeros((self.size,), np.int64)
+        self._counter = counter
+
+    def _write(self, ts, kind, cols, n: int) -> None:
+        if n <= 0:
+            return
+        base = self._counter.take(n)
+        seqs = np.arange(base, base + n, dtype=np.int64)
+        if n > self.size:  # only the tail survives; match the parent trim
+            seqs = seqs[n - self.size:]
+        h = self._head
+        super()._write(ts, kind, cols, n)
+        m = seqs.shape[0]
+        first = min(m, self.size - h)
+        self._seq[h:h + first] = seqs[:first]
+        if first < m:
+            self._seq[:m - first] = seqs[first:]
+
+    def sequenced_events(self, min_seq: int = 0) -> list[tuple]:
+        """Decode rows with seq >= min_seq, oldest first, as
+        (seq, timestamp, data_tuple) triples."""
+        from siddhi_tpu.core.event import rows_from_arrays
+
+        with self._lock:
+            n = min(self._count, self.size)
+            if n == 0:
+                return []
+            order = (np.arange(n) + (self._head - n)) % self.size
+            ts = self._ts[order].copy()
+            kind = self._kind[order].copy()
+            seq = self._seq[order].copy()
+            cols = {name: a[order].copy() for name, a in self._cols.items()}
+        keep = np.nonzero(seq >= min_seq)[0]
+        if keep.size == 0:
+            return []
+        ts, kind, seq = ts[keep], kind[keep], seq[keep]
+        cols = {k: v[keep] for k, v in cols.items()}
+        triples = rows_from_arrays(
+            self.schema, ts, kind, cols, int(keep.size), self.interner
+        )
+        return [
+            (int(s), int(t), tuple(d))
+            for s, (t, _k, d) in zip(seq, triples)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# the recorder
+# ---------------------------------------------------------------------------
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", name)
+
+
+class BlackboxRecorder:
+    """Continuous recorder for one runtime: arms a BlackboxRing on every
+    junction as it is created, re-pins a base checkpoint every
+    `checkpoint.interval` (default: `window`) on the app scheduler, and
+    freezes incident bundles when armed triggers fire."""
+
+    def __init__(self, runtime, config: BlackboxConfig):
+        self.runtime = runtime
+        self.config = config
+        self.seq = SeqCounter()
+        self.incidents_total = {t: 0 for t in config.triggers}
+        self.bundles: list[dict] = []  # newest last, JSON-safe records
+        self.last_incident_id: Optional[str] = None
+        self.pins = 0
+        self.suppressed = 0  # fires swallowed by the debounce
+        self._ordinal = 0
+        self._fired_at: dict = {}  # trigger -> last fire wall ms (debounce)
+        self._pin: Optional[dict] = None
+        self._lock = threading.Lock()
+        self._target = self._tick  # stable identity for the scheduler
+
+    # ---- arming ---------------------------------------------------------
+
+    def arm(self, junction) -> None:
+        junction.enable_blackbox(self.config.ring, self.seq)
+        junction.on_incident = self.fire
+
+    def start(self) -> None:
+        """Pin the first checkpoint and schedule the re-pinner (the
+        AutoPersist/SelfMonitor recurring-target idiom)."""
+        rt = self.runtime
+        try:
+            self.pin_checkpoint()
+        except Exception:
+            logger.warning(
+                "blackbox: initial checkpoint pin failed", exc_info=True
+            )
+        rt._scheduler.start()
+        rt._scheduler.notify_at(
+            rt.clock() + self.config.interval_ms, self._target
+        )
+
+    def _tick(self, t_ms: int) -> None:
+        rt = self.runtime
+        if not rt._running:
+            return
+        try:
+            self.pin_checkpoint()
+        except Exception:
+            logger.warning("blackbox: checkpoint pin failed", exc_info=True)
+        finally:
+            if rt._running:
+                rt._scheduler.notify_at(
+                    rt.clock() + self.config.interval_ms, self._target
+                )
+
+    def pin_checkpoint(self) -> None:
+        """Snapshot the full app state and mark the arrival counter under
+        the process lock, so the checkpoint and the seq watermark agree:
+        every row with seq >= the mark arrived after this state."""
+        rt = self.runtime
+        with rt._process_lock:
+            data = rt.snapshot_service.full_snapshot()
+            mark = self.seq.value
+        pin = {
+            "wall_ms": int(time.time() * 1000),
+            "event_ms": int(rt.clock()),
+            "seq_mark": mark,
+            "data": data,
+        }
+        with self._lock:
+            self._pin = pin
+            self.pins += 1
+
+    # ---- triggers -------------------------------------------------------
+
+    def fire(self, trigger: str, detail: str = "") -> Optional[str]:
+        """One-line trigger hook: freeze an incident bundle unless the
+        trigger is unarmed or inside the debounce interval. Never raises
+        (the callers are hot/error paths); returns the bundle id or None."""
+        if trigger not in self.incidents_total:
+            return None
+        now = int(time.time() * 1000)
+        with self._lock:
+            last = self._fired_at.get(trigger)
+            if last is not None and now - last < self.config.debounce_ms:
+                self.suppressed += 1
+                return None
+            self._fired_at[trigger] = now
+        try:
+            return self._freeze(trigger, str(detail), now)
+        except Exception:
+            logger.warning(
+                "blackbox: failed to freeze %s incident", trigger,
+                exc_info=True,
+            )
+            return None
+
+    # ---- freezing -------------------------------------------------------
+
+    def _dir(self) -> str:
+        d = (
+            self.config.dir
+            or os.environ.get(BLACKBOX_DIR_ENV)
+            or "incidents"
+        )
+        return os.path.abspath(d)
+
+    def _freeze(self, trigger: str, detail: str, wall_ms: int) -> str:
+        rt = self.runtime
+        with self._lock:
+            pin = self._pin
+            self._ordinal += 1
+            ordinal = self._ordinal
+        min_seq = pin["seq_mark"] if pin is not None else 0
+        rings = {}
+        for sid, j in list(rt.junctions.items()):
+            bb = j.blackbox
+            if bb is None:
+                continue
+            rings[sid] = {
+                "schema": [(n, str(t)) for n, t in j.schema.attrs],
+                "events": bb.sequenced_events(min_seq=min_seq),
+                "state": bb.describe_state(),
+            }
+
+        def _safe(f):
+            try:
+                return f()
+            except Exception as e:  # a broken surface must not block the dump
+                return {"error": f"{type(e).__name__}: {e}"}
+
+        event_ms = int(rt.clock())
+        iid = f"{wall_ms}_{ordinal:03d}_{trigger}"
+        bundle = {
+            "version": BUNDLE_VERSION,
+            "id": iid,
+            "app": rt.name,
+            "trigger": trigger,
+            "detail": detail,
+            "wall_ms": wall_ms,
+            "event_ms": event_ms,
+            "checkpoint": {
+                "wall_ms": pin["wall_ms"] if pin else None,
+                "event_ms": pin["event_ms"] if pin else None,
+                "seq_mark": min_seq,
+                "data": pin["data"] if pin else None,
+            },
+            "rings": rings,
+            "app_ast": pickle.dumps(rt.app),
+            "surfaces": {
+                "status": _safe(rt.snapshot_status),
+                "profile": _safe(rt.profile_report),
+                "calibration": _safe(rt.calibration_report),
+                "explain": _safe(rt.explain_plan),
+            },
+            "config": {
+                "window_ms": self.config.window_ms,
+                "triggers": list(self.config.triggers),
+                "keep": self.config.keep,
+                "ring": self.config.ring,
+            },
+        }
+        path = self._write_bundle(bundle)
+        record = {
+            "id": iid,
+            "app": rt.name,
+            "trigger": trigger,
+            "detail": detail,
+            "wall_ms": wall_ms,
+            "event_ms": event_ms,
+            "path": path,
+            "events": sum(len(r["events"]) for r in rings.values()),
+        }
+        with self._lock:
+            self.incidents_total[trigger] += 1
+            self.bundles.append(record)
+            del self.bundles[: -self.config.keep]
+            self.last_incident_id = iid
+        logger.warning(
+            "blackbox: incident %s frozen (trigger=%s detail=%s) -> %s",
+            iid, trigger, detail, path,
+        )
+        return iid
+
+    def _write_bundle(self, bundle: dict) -> str:
+        d = self._dir()
+        os.makedirs(d, exist_ok=True)
+        prefix = f"incident_{_sanitize(self.runtime.name)}_"
+        path = os.path.join(d, f"{prefix}{bundle['id']}.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(bundle, f)
+        os.replace(tmp, path)
+        # oldest-first eviction over this app's bundles: disk use stays
+        # bounded at `keep` bundles even across restarts
+        mine = sorted(
+            fn for fn in os.listdir(d)
+            if fn.startswith(prefix) and fn.endswith(".pkl")
+        )
+        for fn in mine[: max(0, len(mine) - self.config.keep)]:
+            try:
+                os.remove(os.path.join(d, fn))
+            except OSError:
+                pass
+        return path
+
+    # ---- surfaces -------------------------------------------------------
+
+    def incident_index(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self.bundles]
+
+    def describe_state(self) -> dict:
+        with self._lock:
+            return {
+                "window_ms": self.config.window_ms,
+                "triggers": list(self.config.triggers),
+                "keep": self.config.keep,
+                "ring": self.config.ring,
+                "dir": self._dir(),
+                "pins": self.pins,
+                "suppressed": self.suppressed,
+                "incidents": dict(self.incidents_total),
+                "bundles": [
+                    {k: r[k] for k in ("id", "trigger", "wall_ms", "path")}
+                    for r in self.bundles
+                ],
+            }
+
+    def stream_counters(self, stream_id: str) -> Optional[dict]:
+        """The explain() stream-node payload:
+        blackbox[window=30s rings=N incidents=K]."""
+        rt = self.runtime
+        j = rt.junctions.get(stream_id)
+        bb = j.blackbox if j is not None else None
+        if bb is None:
+            return None
+        rings = sum(
+            1 for jj in rt.junctions.values() if jj.blackbox is not None
+        )
+        return {
+            "window_ms": self.config.window_ms,
+            "rings": rings,
+            "incidents": sum(self.incidents_total.values()),
+            "events": bb.describe_state()["total"],
+        }
+
+
+# ---------------------------------------------------------------------------
+# bundles on disk
+# ---------------------------------------------------------------------------
+
+
+def load_bundle(path: str) -> dict:
+    with open(path, "rb") as f:
+        bundle = pickle.load(f)
+    v = bundle.get("version")
+    if v != BUNDLE_VERSION:
+        raise ValueError(
+            f"incident bundle version {v!r} is not supported "
+            f"(expected {BUNDLE_VERSION})"
+        )
+    return bundle
+
+
+def bundle_summary(bundle: dict) -> dict:
+    """JSON-safe view of a bundle (checkpoint bytes and pickled AST
+    elided) — what `/incidents/<id>.json` serves."""
+    cp = bundle.get("checkpoint") or {}
+    return {
+        "version": bundle.get("version"),
+        "id": bundle.get("id"),
+        "app": bundle.get("app"),
+        "trigger": bundle.get("trigger"),
+        "detail": bundle.get("detail"),
+        "wall_ms": bundle.get("wall_ms"),
+        "event_ms": bundle.get("event_ms"),
+        "checkpoint": {
+            "wall_ms": cp.get("wall_ms"),
+            "event_ms": cp.get("event_ms"),
+            "seq_mark": cp.get("seq_mark"),
+            "bytes": len(cp.get("data") or b""),
+        },
+        "rings": {
+            sid: {
+                "schema": r.get("schema"),
+                "events": len(r.get("events") or []),
+                "state": r.get("state"),
+            }
+            for sid, r in (bundle.get("rings") or {}).items()
+        },
+        "surfaces": bundle.get("surfaces"),
+        "config": bundle.get("config"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+
+def _source_streams(app) -> set:
+    """Stream ids a replay must re-feed: externally-fed streams only.
+    Query outputs are regenerated by the replayed queries, fault streams
+    (`!S`) and engine-fed monitor streams are produced by the engine."""
+    fed = set()
+    for elem in app.execution_elements:
+        qs = getattr(elem, "queries", None)
+        queries = qs if qs is not None else [elem]
+        for q in queries:
+            out = getattr(q, "output_stream", None)
+            target = getattr(out, "target", "")
+            if target:
+                fed.add(target)
+    sources = set()
+    for sid in app.stream_definitions:
+        if sid in fed or sid.startswith("!") or sid.startswith("#"):
+            continue
+        sources.add(sid)
+    return sources
+
+
+def _replay_app(bundle: dict):
+    """The bundle's retained AST, re-annotated for deterministic replay:
+    strip recorder/admission/statistics/supervision, add @app:playback."""
+    from siddhi_tpu.query_api.annotation import Annotation
+
+    app = pickle.loads(bundle["app_ast"])
+    strip = set(_STRIP_FOR_REPLAY)
+    app.annotations = [
+        a for a in app.annotations if a.name.lower() not in strip
+    ]
+    app.annotations.append(Annotation("app:playback"))
+    return app
+
+
+def attach_emission_collector(rt, streams=None) -> dict:
+    """Register stream callbacks that append `(timestamp, data_tuple)`
+    rows per stream — one canonical shape for both the live run and the
+    replay, so emissions diff byte-identical. Engine-fed monitor streams
+    (live meter values, never deterministic) are excluded by default."""
+    from siddhi_tpu.observability.selfmon import SELFMON_STREAM_ID
+    from siddhi_tpu.observability.slo import SLO_STREAM_ID
+
+    skip = {SELFMON_STREAM_ID, SLO_STREAM_ID}
+    if streams is None:
+        streams = [
+            sid for sid in rt.stream_schemas
+            if sid not in skip and not sid.startswith("#")
+        ]
+    out: dict = {sid: [] for sid in streams}
+
+    def _mk(sid):
+        rows = out[sid]
+
+        def _cb(events):
+            rows.extend((int(e[0]), tuple(e[1])) for e in events)
+
+        return _cb
+
+    for sid in streams:
+        rt.add_callback(sid, _mk(sid))
+    return out
+
+
+def emissions_checksum(emissions: dict) -> str:
+    """sha256 over the canonical repr of per-stream emission rows — the
+    CI diff key for the byte-identical replay contract."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for sid in sorted(emissions):
+        h.update(sid.encode())
+        for ts, data in emissions[sid]:
+            h.update(repr((ts, data)).encode())
+    return h.hexdigest()
+
+
+class IncidentReplay:
+    """A rebuilt, checkpoint-restored runtime ready to re-feed the
+    bundle's rings. `feed()` drives the replay; `emissions` collects
+    per-stream rows; `debugger` is a SiddhiDebugger when requested."""
+
+    def __init__(self, bundle: dict, debug: bool = False, streams=None):
+        from siddhi_tpu.core.manager import SiddhiManager
+
+        self.bundle = bundle
+        self.manager = SiddhiManager()
+        self.runtime = self.manager.create_siddhi_app_runtime(
+            _replay_app(bundle)
+        )
+        self.debugger = self.runtime.debug() if debug else None
+        self.emissions = attach_emission_collector(self.runtime, streams)
+        data = (bundle.get("checkpoint") or {}).get("data")
+        if data:
+            self.runtime.restore(data)
+        self.runtime.start()
+        self.events_fed = 0
+        self._fed = False
+
+    def feed(self) -> dict:
+        """Re-feed source-stream ring rows in global seq order on the
+        playback clock, then advance event time to the freeze mark so
+        event-time timers up to the incident fire. Returns emissions."""
+        if self._fed:
+            return self.emissions
+        self._fed = True
+        rt = self.runtime
+        sources = _source_streams(rt.app)
+        rows = []
+        for sid, ring in (self.bundle.get("rings") or {}).items():
+            if sid not in sources:
+                continue
+            for seq, ts, data in ring["events"]:
+                rows.append((seq, sid, ts, data))
+        rows.sort(key=lambda r: r[0])
+        self.events_fed = len(rows)
+        handlers: dict = {}
+        i = 0
+        while i < len(rows):  # contiguous same-stream runs keep seq order
+            j = i
+            sid = rows[i][1]
+            while j < len(rows) and rows[j][1] == sid:
+                j += 1
+            h = handlers.get(sid)
+            if h is None:
+                h = handlers[sid] = rt.get_input_handler(sid)
+            h.send_many(
+                [r[3] for r in rows[i:j]],
+                timestamps=[r[2] for r in rows[i:j]],
+            )
+            i = j
+        event_ms = self.bundle.get("event_ms")
+        clock = getattr(rt, "_playback_clock", None)
+        if event_ms is not None and clock is not None:
+            clock.advance(int(event_ms))
+        return self.emissions
+
+    def checksum(self) -> str:
+        return emissions_checksum(self.emissions)
+
+    def close(self) -> None:
+        try:
+            self.manager.shutdown()
+        except Exception:
+            pass
+
+
+def replay_incident(bundle, debug: bool = False, streams=None):
+    """Deterministically replay an incident bundle (a dict, or a path to
+    one on disk). Default: feed everything, shut the replay runtime down,
+    return the IncidentReplay (emissions/checksum populated). With
+    `debug=True` the runtime is left live with a SiddhiDebugger attached
+    and NOT yet fed — set breakpoints, then call `.feed()` (from a worker
+    thread if you intend to step) and `.close()` yourself."""
+    if isinstance(bundle, str):
+        bundle = load_bundle(bundle)
+    replay = IncidentReplay(bundle, debug=debug, streams=streams)
+    if not debug:
+        try:
+            replay.feed()
+        finally:
+            replay.close()
+    return replay

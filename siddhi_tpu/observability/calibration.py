@@ -293,6 +293,9 @@ class CalibrationLedger:
                 now_active.add(fkey)
                 if fkey not in self._active:
                     self.mispriced[fkey] = self.mispriced.get(fkey, 0) + 1
+                    bb = getattr(self.runtime, "_blackbox", None)
+                    if bb is not None:  # mispricing transition = incident
+                        bb.fire("calibration", f"{reason} at {component}")
             entry = {
                 "kind": kind,
                 "component": component,

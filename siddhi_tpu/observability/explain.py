@@ -297,6 +297,13 @@ def build_plan(app, runtime=None) -> dict:
                         "buffered": d["buffered"],
                         "late": d["late_total"],
                     }
+            # black-box recorder (observability/blackbox.py): ring totals
+            # + app-wide incident count on every armed stream node
+            bb = getattr(runtime, "_blackbox", None)
+            if bb is not None:
+                bbc = bb.stream_counters(sid)
+                if bbc is not None:
+                    counters["blackbox"] = bbc
         if ct is not None:
             comp = ct.component(fused_component)
             if comp is not None:
@@ -566,6 +573,13 @@ def _fmt_counters(c: Optional[dict]) -> str:
             f"lineage[fan-in avg={li.get('avg_inputs_per_output')} "
             f"max={li.get('max_inputs_per_output')} "
             f"outputs={li.get('outputs')}]"
+        )
+    if "blackbox" in c:
+        bb = c["blackbox"]
+        w_ms = bb.get("window_ms") or 0
+        parts.append(
+            f"blackbox[window={w_ms / 1000:g}s rings={bb.get('rings')} "
+            f"incidents={bb.get('incidents')}]"
         )
     if "compile" in c:
         comp = c["compile"]

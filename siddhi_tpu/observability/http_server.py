@@ -24,6 +24,11 @@ Routes:
   /slo            SLO burn rates per objective, human-readable text
   /slo.json       multi-window burn rates + budget left per @app:slo
                   objective (observability/slo.py)
+  /incidents(.json)  black-box incident index per app: frozen bundle ids,
+                  triggers and on-disk paths (observability/blackbox.py)
+  /incidents/<id>.json  one bundle's JSON-safe summary: trigger, marks,
+                  checkpoint coverage, ring contents sizes, captured
+                  status/profile/calibration/explain surfaces
 
 Started by `manager.serve_metrics(port)` (idempotent; port 0 picks an
 ephemeral port and returns it). No dependency beyond the stdlib — the
@@ -111,6 +116,21 @@ class MetricsServer:
                         body = json.dumps(
                             outer.manager.slo_reports(), default=str
                         ).encode()
+                        ctype = "application/json"
+                    elif path in ("/incidents", "/incidents.json"):
+                        body = json.dumps(
+                            outer.manager.incidents(), default=str
+                        ).encode()
+                        ctype = "application/json"
+                    elif path.startswith("/incidents/"):
+                        iid = path[len("/incidents/"):]
+                        if iid.endswith(".json"):
+                            iid = iid[: -len(".json")]
+                        detail = outer.manager.incident_detail(iid)
+                        if detail is None:
+                            self.send_error(404)
+                            return
+                        body = json.dumps(detail, default=str).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)

@@ -240,6 +240,20 @@ def _summary_lines(out, family, app, component, summ, **extra) -> None:
     )
 
 
+def render_raw_family(name: str, ftype: str, help_text: str,
+                      lines: list[str]) -> str:
+    """One manager-owned exposition family from pre-rendered sample lines
+    (supervisor/admission/churn/incident counters live outside the per-app
+    statistics registries so they meter apps with statistics OFF too).
+    Empty when there are no samples — absent families must not appear."""
+    if not lines:
+        return ""
+    return (
+        f"# HELP {name} {help_text}\n# TYPE {name} {ftype}\n"
+        + "\n".join(lines) + "\n"
+    )
+
+
 def render_prometheus(reports: list[dict]) -> str:
     """Render the Prometheus text exposition for a list of `report()` dicts
     (one per app). Families are emitted once each with HELP/TYPE headers."""

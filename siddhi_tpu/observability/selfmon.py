@@ -182,6 +182,17 @@ class SelfMonitor:
             out.append((
                 "autopersist", "failures", float(ap.failures), 0.0
             ))
+        # black-box recorder: incident counts are CEP-queryable, so an app
+        # can alert on its own post-mortems (observability/blackbox.py)
+        bb = getattr(rt, "_blackbox", None)
+        if bb is not None:
+            out.append((
+                "blackbox", "incidents",
+                float(sum(bb.incidents_total.values())), 0.0,
+            ))
+            out.append((
+                "blackbox", "checkpoint_pins", float(bb.pins), 0.0
+            ))
         return out
 
     # ---- scheduling ------------------------------------------------------

@@ -353,6 +353,14 @@ class SloEngine:
                 rt._junction(SLO_STREAM_ID).send_rows(
                     [t_ms] * len(rows), rows, now=t_ms
                 )
+                bb = rt._blackbox
+                if bb is not None:  # an SLO burn is a black-box incident
+                    bb.fire(
+                        "slo",
+                        "; ".join(
+                            f"{r[0]}/{r[1]}" for r in rows[:4]
+                        ),
+                    )
             self.ticks += 1
         except Exception:
             import logging

@@ -1,0 +1,276 @@
+"""Black-box incident recorder + deterministic replay
+(observability/blackbox.py; ISSUE 20).
+
+Covers the acceptance gates: zero overhead with the annotation absent
+(one is-None gate per site), trigger -> frozen bundle with a coherent
+ring + checkpoint interval, byte-identical replay (exact rows and
+checksums, including from a mid-feed checkpoint pin), oldest-first
+`keep` eviction with bounded disk, debounce suppression, unarmed
+triggers as no-ops, and the observability surfaces (snapshot_status,
+explain, Prometheus families, manager.incidents / incident_detail).
+"""
+
+import glob
+import os
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.errors import SiddhiAppCreationError
+from siddhi_tpu.observability.blackbox import (
+    attach_emission_collector,
+    emissions_checksum,
+    load_bundle,
+    replay_incident,
+)
+from siddhi_tpu.testing import faults
+
+APP = """
+@app:name('bb')
+@app:blackbox(window='30 sec',
+              triggers='slo,crash,dispatch_error,calibration,admission',
+              keep='4', dir='{d}')
+@OnError(action='LOG')
+define stream S (symbol string, price float, volume int);
+@info(name='q')
+from S[price > 10.0]#window.length(8)
+select symbol, sum(volume) as v, avg(price) as ap insert into Out;
+"""
+
+
+def _boot(tmp_path, app=APP):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(app.format(d=tmp_path))
+    return mgr, rt
+
+
+def _feed(rt, n=24, t0=1_700_000_000_000):
+    h = rt.get_input_handler("S")
+    rows = [("ABC" if i % 2 else "XYZ", 5.0 + i * 1.5, i + 1)
+            for i in range(n)]
+    h.send_many(rows, timestamps=[t0 + i * 20 for i in range(n)])
+    return rows
+
+
+class TestZeroOverhead:
+    def test_no_annotation_means_none_everywhere(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+        define stream S (symbol string, price float);
+        @info(name='q') from S select symbol insert into Out;
+        """)
+        rt.start()
+        assert rt._blackbox is None
+        for j in rt.junctions.values():
+            assert j.blackbox is None
+            assert j.on_incident is None
+        assert rt.incidents() == []
+        assert "blackbox" not in rt.snapshot_status()
+        mgr.shutdown()
+
+    def test_bad_annotation_rejected(self):
+        mgr = SiddhiManager()
+        for bad in ("window='soon'", "triggers='meteor'", "keep='0'",
+                    "ring='x'", "bogus='1'"):
+            with pytest.raises(SiddhiAppCreationError):
+                mgr.create_siddhi_app_runtime(f"""
+                @app:blackbox({bad})
+                define stream S (symbol string);
+                from S select symbol insert into Out;
+                """)
+        mgr.shutdown()
+
+
+class TestTriggers:
+    def test_dispatch_fault_freezes_bundle(self, tmp_path):
+        mgr, rt = _boot(tmp_path)
+        rt.start()
+        _feed(rt)
+        faults.install(
+            faults.parse_plan("seed=5;junction_dispatch@S:times=1")
+        )
+        try:
+            rt.get_input_handler("S").send(
+                ("POISON", 1.0, 0), timestamp=1_700_000_001_000
+            )
+        finally:
+            faults.uninstall()
+        idx = rt.incidents()
+        assert len(idx) == 1
+        inc = idx[0]
+        assert inc["trigger"] == "dispatch_error"
+        assert inc["app"] == "bb"
+        assert "InjectedFault" in inc["detail"]
+        assert os.path.isfile(inc["path"])
+        assert inc["events"] == 25  # full S ring captured since the pin
+        bundle = load_bundle(inc["path"])
+        assert bundle["id"] == inc["id"]
+        assert bundle["checkpoint"]["seq_mark"] == 0
+        assert len(bundle["rings"]["S"]["events"]) == 25
+        assert bundle["surfaces"]["status"]["app"] == "bb"
+        mgr.shutdown()
+
+    def test_unarmed_trigger_is_noop_and_debounce_suppresses(self, tmp_path):
+        mgr, rt = _boot(tmp_path, APP.replace(
+            "triggers='slo,crash,dispatch_error,calibration,admission'",
+            "triggers='crash'",
+        ))
+        rt.start()
+        _feed(rt, n=4)
+        bb = rt._blackbox
+        assert bb.fire("slo", "not armed") is None  # unarmed trigger
+        assert rt.incidents() == []
+        assert bb.fire("crash", "first") is not None
+        assert bb.fire("crash", "inside debounce") is None
+        assert bb.suppressed == 1
+        assert len(rt.incidents()) == 1
+        mgr.shutdown()
+
+    def test_admission_shed_fires_incident(self, tmp_path):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(f"""
+        @app:name('bbadm')
+        @app:blackbox(triggers='admission', keep='2', dir='{tmp_path}')
+        @app:admission(policy='shed_newest', rate.limit='100')
+        define stream S (v int);
+        @info(name='q') from S select v insert into Out;
+        """)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send_many([(i,) for i in range(500)])
+        idx = rt.incidents()
+        assert idx and idx[0]["trigger"] == "admission"
+        assert "shed" in idx[0]["detail"]
+        mgr.shutdown()
+
+
+class TestReplay:
+    def test_replay_byte_identical(self, tmp_path):
+        mgr, rt = _boot(tmp_path)
+        live = attach_emission_collector(rt)
+        rt.start()
+        _feed(rt, n=32)
+        assert rt._blackbox.fire("crash", "synthetic") is not None
+        inc = rt.incidents()[-1]
+        mgr.shutdown()
+
+        replay = replay_incident(inc["path"])
+        assert replay.events_fed == 32
+        assert replay.emissions == live
+        assert replay.checksum() == emissions_checksum(live)
+
+    def test_replay_from_midfeed_pin_restores_state(self, tmp_path):
+        # re-pin the checkpoint mid-feed: the bundle then carries only the
+        # post-pin ring rows plus the pinned state, and the replay must
+        # regenerate exactly the live run's post-pin emissions — sums and
+        # averages over a window SPANNING the pin prove the restore
+        mgr, rt = _boot(tmp_path)
+        live = attach_emission_collector(rt)
+        rt.start()
+        _feed(rt, n=20)
+        pre_out = len(live["Out"])
+        rt._blackbox.pin_checkpoint()
+        assert rt._blackbox.pins == 2  # start() + manual
+        _feed(rt, n=20, t0=1_700_000_100_000)
+        assert rt._blackbox.fire("crash", "post-pin") is not None
+        inc = rt.incidents()[-1]
+        # only post-pin rows in the bundle: 20 source rows (plus the Out
+        # rows the collector subscription makes the Out junction publish)
+        assert len(load_bundle(inc["path"])["rings"]["S"]["events"]) == 20
+        tail = {
+            "S": live["S"][20:],
+            "Out": live["Out"][pre_out:],
+        }
+        mgr.shutdown()
+
+        replay = replay_incident(inc["path"])
+        assert replay.events_fed == 20
+        assert replay.emissions == tail
+        assert replay.checksum() == emissions_checksum(tail)
+
+
+class TestRetention:
+    def test_keep_evicts_oldest_first(self, tmp_path):
+        mgr, rt = _boot(tmp_path, APP.replace("keep='4'", "keep='2'"))
+        rt.start()
+        _feed(rt, n=4)
+        bb = rt._blackbox
+        # distinct triggers sidestep the per-trigger debounce
+        ids = [bb.fire(t, "evict me") for t in
+               ("crash", "slo", "calibration")]
+        assert all(ids)
+        on_disk = sorted(glob.glob(str(tmp_path / "incident_bb_*.pkl")))
+        assert len(on_disk) == 2, on_disk
+        assert not any(ids[0] in p for p in on_disk)  # oldest gone
+        assert [r["id"] for r in rt.incidents()] == ids[1:]
+        mgr.shutdown()
+
+
+class TestSurfaces:
+    def test_status_explain_prometheus_and_manager_routes(self, tmp_path):
+        mgr, rt = _boot(tmp_path)
+        rt.start()
+        _feed(rt, n=6)
+        iid = rt._blackbox.fire("crash", "surface check")
+        status = rt.snapshot_status()["blackbox"]
+        assert status["incidents"]["crash"] == 1
+        assert status["pins"] >= 1
+        assert status["bundles"][0]["id"] == iid
+
+        plan = rt.explain_plan()
+        s_node = next(
+            n for n in plan["nodes"] if n["id"] == "stream:S"
+        )
+        assert s_node["counters"]["blackbox"]["incidents"] == 1
+        assert "blackbox[window=30s" in rt.explain()
+
+        text = mgr.prometheus_text()
+        assert 'siddhi_incidents_total{app="bb",trigger="crash"} 1' in text
+        assert 'siddhi_blackbox_ring_events{app="bb",stream="S"} 6' in text
+
+        inc = mgr.incidents()["bb"]
+        assert inc["incidents"]["crash"] == 1
+        assert inc["bundles"][0]["id"] == iid
+        detail = mgr.incident_detail(iid)
+        assert detail["trigger"] == "crash"
+        assert detail["rings"]["S"]["events"] == 6
+        assert detail["checkpoint"]["bytes"] > 0
+        assert mgr.incident_detail("nope") is None
+        mgr.shutdown()
+
+    def test_supervisor_restart_record_carries_incident_id(self, tmp_path):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(f"""
+        @app:name('bbsup')
+        @app:blackbox(triggers='crash', keep='2', dir='{tmp_path}')
+        @app:restart(policy='on-failure', max.attempts='1',
+                     backoff='10 millisec')
+        define stream S (v int);
+        @info(name='q') from S select v insert into Out;
+        """)
+        sup = mgr.supervise(poll_interval_s=0.05)
+        rt.start()
+        rt.get_input_handler("S").send_many([(i,) for i in range(4)])
+        faults.install(
+            faults.parse_plan("seed=9;junction_dispatch@S:times=1")
+        )
+        try:
+            with pytest.raises(Exception):
+                rt.get_input_handler("S").send((99,))
+        finally:
+            faults.uninstall()
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+            "restarted:" in what for _ts, _app, what in list(sup.events)
+        ):
+            time.sleep(0.05)
+        restarts = [
+            what for _ts, _app, what in list(sup.events)
+            if "restarted:" in what
+        ]
+        assert restarts, list(sup.events)
+        # the crash froze a bundle; its id rides the restart record so
+        # /status.json links the crash to its post-mortem
+        assert "[incident " in restarts[0], restarts
+        mgr.shutdown()

@@ -1,0 +1,173 @@
+"""First dedicated suite for the step debugger (core/debugger.py):
+breakpoints at query IN/OUT terminals, next()/play() stepping, state
+inspection while blocked — and the ISSUE 20 wiring: a SiddhiDebugger
+attached to an incident replay (`replay_incident(..., debug=True)`), so
+the exact query terminal that misbehaved can be breakpointed mid-replay
+while the time machine re-feeds the recorded rings."""
+
+import threading
+import time
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.debugger import QueryTerminal
+from siddhi_tpu.observability.blackbox import (
+    attach_emission_collector,
+    replay_incident,
+)
+
+APP = """
+define stream S (symbol string, price float);
+@info(name='q')
+from S[price > 10.0]#window.length(4)
+select symbol, sum(price) as total insert into Out;
+"""
+
+
+def _wait(pred, timeout=5.0):
+    t0 = time.time()
+    while not pred() and time.time() - t0 < timeout:
+        time.sleep(0.02)
+    return pred()
+
+
+class TestBreakpoints:
+    def test_in_breakpoint_blocks_then_next_steps(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(APP)
+        got = []
+        rt.add_callback(
+            "Out", lambda evs: got.extend(tuple(e[1]) for e in evs)
+        )
+        dbg = rt.debug()
+        hits = []
+        dbg.set_debugger_callback(
+            lambda events, qid, term, d: hits.append(
+                (qid, term.value, [tuple(e[1]) for e in events])
+            )
+        )
+        dbg.acquire_break_point("q", QueryTerminal.IN)
+        rt.start()
+        h = rt.get_input_handler("S")
+
+        def sender():
+            for i in range(3):
+                h.send(("T", 20.0 + i))
+
+        t = threading.Thread(target=sender)
+        t.start()
+        assert _wait(lambda: dbg._blocked.is_set())
+        assert hits == [("q", "IN", [("T", 20.0)])]
+        assert got == []  # blocked at IN: nothing processed yet
+        dbg.next()  # step: runs until the NEXT event hits the breakpoint
+        assert _wait(lambda: len(hits) == 2)
+        assert got == [("T", 20.0)]
+        dbg.next()
+        assert _wait(lambda: len(hits) == 3)
+        dbg.release_all_break_points()
+        dbg.next()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert got == [("T", 20.0), ("T", 41.0), ("T", 63.0)]
+        mgr.shutdown()
+
+    def test_out_breakpoint_sees_emitted_rows(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(APP)
+        dbg = rt.debug()
+        hits = []
+        dbg.set_debugger_callback(
+            lambda events, qid, term, d: hits.append((term.value, events))
+        )
+        dbg.acquire_break_point("q", QueryTerminal.OUT)
+        rt.start()
+        t = threading.Thread(
+            target=lambda: rt.get_input_handler("S").send(("T", 50.0))
+        )
+        t.start()
+        assert _wait(lambda: dbg._blocked.is_set())
+        term, events = hits[0]
+        assert term == "OUT"
+        assert tuple(events[0][1]) == ("T", 50.0)  # sum over one event
+        dbg.play()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        mgr.shutdown()
+
+    def test_state_inspection_while_blocked(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(APP)
+        dbg = rt.debug()
+        dbg.acquire_break_point("q", QueryTerminal.OUT)
+        rt.start()
+        h = rt.get_input_handler("S")
+        t = threading.Thread(target=lambda: [
+            h.send(("T", 20.0)), h.send(("T", 30.0)),
+        ])
+        t.start()
+        assert _wait(lambda: dbg._blocked.is_set())
+        state = dbg.get_query_state("q")
+        assert state is not None  # window state inspectable mid-block
+        dbg.next()
+        assert _wait(lambda: dbg._blocked.is_set())
+        dbg.release_all_break_points()
+        dbg.next()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        mgr.shutdown()
+
+
+class TestReplayDebugging:
+    def test_breakpoint_mid_incident_replay(self, tmp_path):
+        # live run: record, freeze an incident
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(f"""
+        @app:name('bbdbg')
+        @app:blackbox(triggers='crash', keep='2', dir='{tmp_path}')
+        {APP}
+        """)
+        live = attach_emission_collector(rt)
+        rt.start()
+        rt.get_input_handler("S").send_many(
+            [("T", 20.0 + i) for i in range(6)],
+            timestamps=[1_700_000_000_000 + i * 10 for i in range(6)],
+        )
+        iid = rt._blackbox.fire("crash", "debug replay")
+        assert iid is not None
+        path = rt.incidents()[-1]["path"]
+        mgr.shutdown()
+
+        # replay with the step debugger attached: NOT fed yet — arm
+        # breakpoints, feed from a worker thread, step mid-replay
+        replay = replay_incident(path, debug=True)
+        dbg = replay.debugger
+        assert dbg is not None
+        t = threading.Thread(target=replay.feed, daemon=True)
+        try:
+            hits = []
+            dbg.set_debugger_callback(
+                lambda events, qid, term, d: hits.append(term.value)
+            )
+            dbg.acquire_break_point("q", QueryTerminal.IN)
+            dbg.acquire_break_point("q", QueryTerminal.OUT)
+            t.start()
+            assert _wait(lambda: dbg._blocked.is_set())
+            assert hits == ["IN"]  # replay paused at the query terminal
+            assert replay.emissions["Out"] == []  # nothing emitted yet
+            dbg.next()  # step IN -> OUT: the batch is processed, blocked
+            assert _wait(lambda: len(hits) == 2 and dbg._blocked.is_set())
+            assert hits == ["IN", "OUT"]
+            # state inspection mid-replay, at the misbehaving terminal
+            assert dbg.get_query_state("q") is not None
+            dbg.release_all_break_points()
+            dbg.next()
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+            # once released, the replay completes byte-identical
+            assert replay.emissions == live
+        finally:
+            # unblock the feed thread even on assertion failure, or the
+            # parked worker wedges interpreter shutdown
+            dbg.release_all_break_points()
+            dbg.next()
+            t.join(timeout=5.0)
+            replay.close()

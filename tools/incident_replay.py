@@ -1,0 +1,77 @@
+"""Deterministic time-travel replay of a black-box incident bundle (CLI).
+
+Loads a frozen incident bundle (observability/blackbox.py), rebuilds the
+app from the bundle's retained AST under `@app:playback`, restores the
+pinned checkpoint, re-feeds every source-stream ring in recorded seq
+order on the event-time clock, and prints one JSON object:
+
+    {"id": ..., "app": ..., "trigger": ..., "detail": ...,
+     "events_fed": N, "emissions": {stream: [[ts, [row...]], ...]},
+     "checksum": "<sha256 over the emission set>"}
+
+The emissions are byte-identical to what the live run emitted over the
+bundle's covered interval (the replay determinism contract — see README
+"Black box & incident replay"), so CI diffs this output against the live
+recorder's collected rows to prove the time machine works. Exit 0 = the
+replay ran to completion; any divergence is the CALLER's diff to make
+(tools/incident_smoke.py, tier1.yml "Incident replay parity").
+
+Usage:
+    python tools/incident_replay.py BUNDLE.pkl [--json OUT] [--quiet]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="path to an incident_*.pkl bundle")
+    ap.add_argument(
+        "--json", dest="out", default=None,
+        help="also write the JSON payload to this path",
+    )
+    ap.add_argument(
+        "--quiet", action="store_true",
+        help="suppress stdout (use with --json)",
+    )
+    args = ap.parse_args(argv)
+
+    from siddhi_tpu.observability.blackbox import (
+        load_bundle, replay_incident,
+    )
+
+    bundle = load_bundle(args.bundle)
+    replay = replay_incident(bundle)
+    payload = {
+        "id": bundle["id"],
+        "app": bundle["app"],
+        "trigger": bundle["trigger"],
+        "detail": bundle["detail"],
+        "events_fed": replay.events_fed,
+        "emissions": {
+            sid: [[ts, list(row)] for ts, row in rows]
+            for sid, rows in sorted(replay.emissions.items())
+        },
+        "checksum": replay.checksum(),
+    }
+    text = json.dumps(payload, indent=1, default=str)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+    if not args.quiet:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,12 @@ a resolvable provenance chain from a known window emission back to decoded
 input events, and live roofline gauges — wire bytes/event + h2d MB/s — in
 the exposition and `/profile`), `/profile` (≥1 compile event with a cause
 and wall time after ingest, plus chunk waterfalls), and `/explain` +
-`/explain.json` (a non-empty live-annotated plan). Exit 0 = pass.
+`/explain.json` (a non-empty live-annotated plan). A second app arms
+`@app:blackbox` and a seeded dispatch fault freezes an incident: the
+`/incidents(.json)` + `/incidents/<id>.json` routes must list it with
+its trigger and bundle path, and the `siddhi_incidents_total` /
+`siddhi_blackbox_ring_events` families must ride `/metrics`. Exit 0 =
+pass.
 
 With SMOKE_JSON_OUT=<path> the scraped payloads (profile, explain plan,
 status) are written there as one JSON blob — tier1.yml uploads it as a
@@ -236,11 +241,64 @@ def _run(blob: dict) -> int:
     slo_text = scrape(f"http://127.0.0.1:{port}/slo")
     assert "no slo-enabled apps" in slo_text
 
+    # black-box incident recorder: a second app arms @app:blackbox, a
+    # one-shot junction_dispatch fault seeds a dispatch_error incident,
+    # and /incidents(.json) + /incidents/<id>.json must list it with its
+    # trigger and bundle path (observability/blackbox.py)
+    import tempfile
+
+    from siddhi_tpu.testing import faults
+
+    bb_dir = tempfile.mkdtemp(prefix="metrics_smoke_bb_")
+    rt2 = mgr.create_siddhi_app_runtime(f"""
+    @app:name('bbapp')
+    @app:blackbox(window='30 sec', triggers='dispatch_error,crash',
+                  keep='2', dir='{bb_dir}')
+    @OnError(action='LOG')
+    define stream B (symbol string, price float);
+    @info(name='qb')
+    from B[price > 10] select symbol, price insert into BOut;
+    """)
+    rt2.start()
+    hb = rt2.get_input_handler("B")
+    for i in range(8):
+        hb.send(("X", 20.0 + i))
+    faults.install(faults.parse_plan("seed=3;junction_dispatch@B:times=1"))
+    try:
+        hb.send(("POISON", 1.0))
+    finally:
+        faults.uninstall()
+    inc_list = json.loads(scrape(f"http://127.0.0.1:{port}/incidents.json"))
+    blob["incidents"] = inc_list
+    bb = inc_list["bbapp"]
+    assert bb["incidents"]["dispatch_error"] == 1, bb
+    assert bb["bundles"], "/incidents.json must list the frozen bundle"
+    entry = bb["bundles"][-1]
+    assert entry["trigger"] == "dispatch_error", entry
+    assert entry["path"] and os.path.isfile(entry["path"]), entry
+    detail = json.loads(
+        scrape(f"http://127.0.0.1:{port}/incidents/{entry['id']}.json")
+    )
+    blob["incident_detail"] = detail
+    assert detail["id"] == entry["id"], detail
+    assert detail["trigger"] == "dispatch_error", detail
+    assert detail["rings"]["B"]["events"] == 9, detail["rings"]
+    inc_text = scrape(f"http://127.0.0.1:{port}/incidents")
+    assert entry["id"] in inc_text
+    # the two blackbox Prometheus families ride the manager exposition
+    text2 = scrape(f"http://127.0.0.1:{port}/metrics")
+    assert (
+        'siddhi_incidents_total{app="bbapp",trigger="dispatch_error"} 1'
+        in text2
+    ), "incident counter family missing"
+    assert "siddhi_blackbox_ring_events" in text2
+    assert 'stream="B"' in text2
+
     mgr.shutdown()
     print(
         f"metrics smoke OK: {samples} samples, {len(typed)} families, "
         f"status + flight + lineage + roofline + profile + explain + "
-        f"calibration live"
+        f"calibration + incidents live"
     )
     return 0
 
